@@ -1,0 +1,14 @@
+"""seldon_core_tpu: a TPU-native model-serving framework.
+
+Capability parity target: seldon-core v0.1.x (see SURVEY.md). The reference
+deploys every inference-graph node as its own container and wires them with
+per-request HTTP/gRPC (reference: engine/.../PredictiveUnitBean.java). Here the
+whole graph lives in ONE process per host: model nodes are jit-compiled JAX
+functions resident in TPU HBM, graph fan-out/aggregation compiles into a single
+XLA program when pure, and cross-chip communication is XLA collectives over a
+`jax.sharding.Mesh` instead of a pod-to-pod RPC mesh.
+"""
+
+from seldon_core_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,34 @@
+from seldon_core_tpu.core.message import (
+    DefaultData,
+    Feedback,
+    Meta,
+    RequestResponse,
+    SeldonMessage,
+    Status,
+    StatusFlag,
+)
+from seldon_core_tpu.core.codec_json import (
+    feedback_from_json,
+    feedback_to_json,
+    message_from_json,
+    message_to_json,
+)
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.puid import new_puid
+
+__all__ = [
+    "APIException",
+    "DefaultData",
+    "ErrorCode",
+    "Feedback",
+    "Meta",
+    "RequestResponse",
+    "SeldonMessage",
+    "Status",
+    "StatusFlag",
+    "feedback_from_json",
+    "feedback_to_json",
+    "message_from_json",
+    "message_to_json",
+    "new_puid",
+]

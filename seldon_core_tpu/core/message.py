@@ -1,0 +1,197 @@
+"""Typed message model — the framework-wide data contract.
+
+Parity target: the reference wire contract in
+``/root/reference/proto/prediction.proto:12-69`` (SeldonMessage / DefaultData /
+Tensor / Meta / Status / Feedback). Design difference: instead of a protobuf
+``Tensor{shape,values-as-double}`` that every hop re-serialises, ``DefaultData``
+holds a live ``numpy``/``jax.Array`` so a message can flow through an in-process
+graph — and onto the TPU — with zero copies. Codecs (JSON / proto) live in
+``codec_json.py`` / ``codec_proto.py`` and only run at the process edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+Array = Any  # np.ndarray | jax.Array — kept loose so core has no jax import cost
+
+
+class StatusFlag(enum.IntEnum):
+    SUCCESS = 0
+    FAILURE = 1
+
+
+@dataclass(frozen=True)
+class Status:
+    """Mirrors reference Status (prediction.proto:46-57)."""
+
+    code: int = 200
+    info: str = ""
+    reason: str = ""
+    status: StatusFlag = StatusFlag.SUCCESS
+
+
+class DataKind(enum.Enum):
+    """Which wire form DefaultData serialises back to (tensor vs ndarray)."""
+
+    TENSOR = "tensor"
+    NDARRAY = "ndarray"
+
+
+@dataclass(frozen=True)
+class DefaultData:
+    """Named tensor payload (reference prediction.proto:23-34).
+
+    ``array`` is the single in-memory representation; ``kind`` only records
+    which JSON/proto encoding the client used so responses round-trip in the
+    same form (the reference keeps Tensor and ListValue as distinct oneof arms).
+    """
+
+    names: tuple[str, ...] = ()
+    array: Array | None = None
+    kind: DataKind = DataKind.TENSOR
+
+    def with_array(self, array: Array, names: Sequence[str] | None = None) -> "DefaultData":
+        return DefaultData(
+            names=tuple(names) if names is not None else self.names,
+            array=array,
+            kind=self.kind,
+        )
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        if self.array is None:
+            return ()
+        return tuple(int(d) for d in self.array.shape)
+
+
+@dataclass(frozen=True)
+class Meta:
+    """Request metadata (reference prediction.proto:36-40).
+
+    ``routing`` records, per graph-node name, which child index a ROUTER chose
+    (-1 = all children). Feedback replays down exactly this recorded path —
+    the bandit-learning loop depends on it (reference
+    PredictiveUnitBean.sendFeedback:126-154).
+    """
+
+    puid: str = ""
+    tags: Mapping[str, Any] = field(default_factory=dict)
+    routing: Mapping[str, int] = field(default_factory=dict)
+    # requestPath: nodeName -> model image (we use runtime id); additive over the
+    # reference's Meta, used for tracing (SURVEY §5.1: puid as trace id).
+    request_path: Mapping[str, str] = field(default_factory=dict)
+
+    def merged_with(self, other: "Meta") -> "Meta":
+        """Merge rule from reference PredictiveUnitBean.mergeMeta:252-264:
+        tags are union-merged (child wins on conflict), puid preserved from the
+        request, routing entries accumulate."""
+        return Meta(
+            puid=self.puid or other.puid,
+            tags={**self.tags, **other.tags},
+            routing={**self.routing, **other.routing},
+            request_path={**self.request_path, **other.request_path},
+        )
+
+
+@dataclass(frozen=True)
+class SeldonMessage:
+    """The one message type every graph node consumes and produces
+    (reference prediction.proto:12-21). Exactly one of data/bin_data/str_data
+    /json_data is set (oneof semantics); ``data`` is the TPU fast path.
+    """
+
+    data: DefaultData | None = None
+    bin_data: bytes | None = None
+    str_data: str | None = None
+    json_data: Any | None = None  # forward-compat arm (later seldon versions)
+    meta: Meta = field(default_factory=Meta)
+    status: Status | None = None
+
+    def __post_init__(self) -> None:
+        set_arms = [
+            x is not None for x in (self.data, self.bin_data, self.str_data, self.json_data)
+        ]
+        if sum(set_arms) > 1:
+            raise ValueError("SeldonMessage: at most one data arm may be set (oneof)")
+
+    # -- convenience constructors -------------------------------------------------
+    @staticmethod
+    def from_array(
+        array: Array,
+        names: Sequence[str] = (),
+        meta: Meta | None = None,
+        kind: DataKind = DataKind.TENSOR,
+    ) -> "SeldonMessage":
+        return SeldonMessage(
+            data=DefaultData(names=tuple(names), array=array, kind=kind),
+            meta=meta or Meta(),
+        )
+
+    @staticmethod
+    def failure(code: int, reason: str, info: str = "") -> "SeldonMessage":
+        return SeldonMessage(
+            status=Status(code=code, info=info, reason=reason, status=StatusFlag.FAILURE)
+        )
+
+    # -- accessors ---------------------------------------------------------------
+    @property
+    def array(self) -> Array | None:
+        return self.data.array if self.data is not None else None
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return self.data.names if self.data is not None else ()
+
+    def with_array(self, array: Array, names: Sequence[str] | None = None) -> "SeldonMessage":
+        """Functional update of the payload, preserving meta/kind."""
+        base = self.data if self.data is not None else DefaultData()
+        return dataclasses.replace(self, data=base.with_array(array, names))
+
+    def with_meta(self, meta: Meta) -> "SeldonMessage":
+        return dataclasses.replace(self, meta=meta)
+
+    def is_failure(self) -> bool:
+        return self.status is not None and self.status.status == StatusFlag.FAILURE
+
+
+@dataclass(frozen=True)
+class Feedback:
+    """Reward feedback (reference prediction.proto:59-64)."""
+
+    request: SeldonMessage | None = None
+    response: SeldonMessage | None = None
+    reward: float = 0.0
+    truth: SeldonMessage | None = None
+
+
+@dataclass(frozen=True)
+class RequestResponse:
+    """Audit-log pair (reference prediction.proto:66-69; Kafka payload C17)."""
+
+    request: SeldonMessage | None = None
+    response: SeldonMessage | None = None
+
+
+def messages_arrays(messages: Sequence[SeldonMessage]) -> list[Array]:
+    """Extract payload arrays from a list of messages (combiner input),
+    failing loudly on non-tensor arms."""
+    out = []
+    for i, m in enumerate(messages):
+        if m.array is None:
+            raise ValueError(f"message {i} has no tensor payload")
+        out.append(m.array)
+    return out
+
+
+def np_array(msg: SeldonMessage) -> np.ndarray:
+    """Payload as a host numpy array (device arrays transfer)."""
+    a = msg.array
+    if a is None:
+        raise ValueError("message has no tensor payload")
+    return np.asarray(a)

@@ -1,0 +1,24 @@
+"""Prediction-UID generation.
+
+Parity: reference engine PredictionService (engine/.../service/
+PredictionService.java:52-57,71-78) generates a 130-bit SecureRandom integer
+rendered in base32 and assigns it when a request has no puid. Same contract
+here: 130 bits, base32 (RFC 4648 lowercase, no padding), assigned-if-missing.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+_ALPHABET = "0123456789abcdefghijklmnopqrstuv"  # base32, matches Java BigInteger.toString(32)
+
+
+def new_puid(bits: int = 130) -> str:
+    n = secrets.randbits(bits)
+    if n == 0:
+        return "0"
+    digits = []
+    while n:
+        digits.append(_ALPHABET[n & 31])
+        n >>= 5
+    return "".join(reversed(digits))

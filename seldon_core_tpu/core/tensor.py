@@ -1,0 +1,82 @@
+"""Tensor bridge: host numpy <-> TPU device arrays, dtype policy, padding.
+
+Parity role: the reference's only numeric kernel is ND4J conversion glue
+(engine/.../predictors/PredictorUtils.java — Tensor<->ndarray<->INDArray).
+Here the equivalent is numpy<->jax with an explicit TPU dtype policy and a
+zero-ish-copy device path (np.frombuffer on the wire buffer -> device_put).
+
+TPU notes: float64 (the reference wire dtype) is emulated and slow on TPU;
+we compute in float32 (or bfloat16 where the model opts in) and only widen
+back to float64 at the JSON edge.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+_JAX = None
+
+
+def _jax():
+    global _JAX
+    if _JAX is None:
+        import jax
+
+        _JAX = jax
+    return _JAX
+
+
+def to_device(array: np.ndarray, sharding: Any | None = None) -> Any:
+    """Host array -> device, optionally with a NamedSharding (multi-chip)."""
+    jax = _jax()
+    if sharding is not None:
+        return jax.device_put(array, sharding)
+    return jax.device_put(array)
+
+
+def to_host(array: Any) -> np.ndarray:
+    return np.asarray(array)
+
+
+def cast_policy(array: np.ndarray, dtype: Any = np.float32) -> np.ndarray:
+    if array.dtype == dtype:
+        return array
+    return array.astype(dtype)
+
+
+def pad_batch(array: np.ndarray, target_batch: int, axis: int = 0) -> tuple[np.ndarray, int]:
+    """Pad ``axis`` up to ``target_batch`` with zeros; returns (padded, valid_n).
+
+    Shape bucketing is the TPU answer to variable request sizes: XLA compiles
+    one program per bucket instead of one per observed shape (SURVEY §7 hard
+    parts: 'variable batch ... on TPU they are the problem')."""
+    n = array.shape[axis]
+    if n > target_batch:
+        raise ValueError(f"batch {n} exceeds bucket {target_batch}")
+    if n == target_batch:
+        return array, n
+    pad_width = [(0, 0)] * array.ndim
+    pad_width[axis] = (0, target_batch - n)
+    return np.pad(array, pad_width), n
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int | None:
+    """Smallest bucket >= n, or None if n exceeds the largest bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+def default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Power-of-two buckets up to max_batch: 1,2,4,...  At most
+    log2(max)+1 compiled programs per model."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)

@@ -1,0 +1,11 @@
+from seldon_core_tpu.engine.units import Unit, PythonClassUnit, UnitRegistry, default_registry
+from seldon_core_tpu.engine.executor import GraphExecutor, build_executor
+
+__all__ = [
+    "GraphExecutor",
+    "PythonClassUnit",
+    "Unit",
+    "UnitRegistry",
+    "build_executor",
+    "default_registry",
+]

@@ -1,0 +1,194 @@
+"""Built-in graph units (no container needed).
+
+Parity: reference in-engine implementations (SURVEY C5) —
+SimpleModelUnit.java (constant logits test stub), SimpleRouterUnit.java
+(always child 0), RandomABTestUnit.java (seeded A/B split, param ``ratioA``,
+seed 1337), AverageCombinerUnit.java (element-wise mean ensemble) — plus two
+TPU-native additions: EPSILON_GREedy bandit router (BASELINE full-DAG config)
+and JAX_MODEL (a model-zoo model resident in HBM).
+
+The AverageCombiner is where TPU-first pays: in the reference an N-model
+ensemble is N containers + N RPCs + a Java mean; here the combiner is
+``jnp.mean(stack, 0)`` and — via engine/fused.py — the whole ensemble
+compiles into ONE XLA program with the models' matmuls batched for the MXU.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.engine.units import ROUTE_ALL, Unit, UnitRegistry
+from seldon_core_tpu.graph.spec import PredictiveUnit, PredictiveUnitImplementation
+
+
+class SimpleModelUnit(Unit):
+    """Constant-output test model (reference SimpleModelUnit.java:24-53:
+    values [[0.1, 0.9, 0.5]], classNames c0,c1,c2; its 20 ms sleep is exposed
+    as an optional `delay_ms` parameter instead of being hard-coded)."""
+
+    VALUES = np.asarray([[0.1, 0.9, 0.5]], dtype=np.float32)
+    CLASS_NAMES = ("c0", "c1", "c2")
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        delay_ms = float(self.params.get("delay_ms", 0.0))
+        if delay_ms > 0:
+            import asyncio
+
+            await asyncio.sleep(delay_ms / 1000.0)
+        batch = 1
+        if msg.array is not None and np.asarray(msg.array).ndim >= 1:
+            batch = int(np.asarray(msg.array).shape[0])
+        out = np.repeat(self.VALUES, batch, axis=0)
+        return msg.with_array(out, self.CLASS_NAMES)
+
+
+class SimpleRouterUnit(Unit):
+    """Always routes to child 0 (reference SimpleRouterUnit.java)."""
+
+    async def route(self, msg: SeldonMessage) -> int:
+        return 0
+
+
+class RandomABTestUnit(Unit):
+    """Seeded A/B split (reference RandomABTestUnit.java:29-53).
+
+    Parameter ``ratioA`` = probability of child 0; RNG seeded 1337 so the
+    routing sequence is deterministic and testable (reference
+    RandomABTestUnitInternalTest asserts routes 1,0,1 under the seed).
+    The reference has a known latent bug it FIXMEs at :46 (unordered keySet);
+    we index children positionally, which fixes it while keeping behavior.
+    """
+
+    SEED = 1337
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        self.ratio_a = float(self.params.get("ratioA", 0.5))
+        self._rng = random.Random(self.SEED)
+        self._lock = threading.Lock()
+
+    async def route(self, msg: SeldonMessage) -> int:
+        if len(self.spec.children) < 2:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_ABTEST,
+                f"RANDOM_ABTEST '{self.name}' needs 2 children, has {len(self.spec.children)}",
+            )
+        with self._lock:
+            draw = self._rng.random()
+        return 0 if draw < self.ratio_a else 1
+
+
+class EpsilonGreedyRouter(Unit):
+    """Multi-armed bandit router (TPU-native addition; the BASELINE 'full DAG'
+    config calls for an epsilon-greedy router, which the reference only ships
+    as an example container image, not in-engine).
+
+    Parameters: ``epsilon`` (exploration rate, default 0.1), ``seed``.
+    State (per-arm pull counts + mean rewards) is host-side and mutated by
+    send_feedback — deliberately OUTSIDE the jitted graph (SURVEY §7 hard
+    parts: bandit state mutates while predict is pure/compiled). State is
+    picklable so persistence/ can checkpoint it (reference C19 contract).
+    """
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        self.epsilon = float(self.params.get("epsilon", 0.1))
+        self._rng = random.Random(int(self.params.get("seed", 0)) or None)
+        n = max(len(spec.children), 1)
+        self.counts = [0] * n
+        self.rewards = [0.0] * n
+        self._lock = threading.Lock()
+
+    async def route(self, msg: SeldonMessage) -> int:
+        n = len(self.spec.children)
+        if n == 0:
+            raise APIException(ErrorCode.ENGINE_INVALID_ROUTING, "router has no children")
+        with self._lock:
+            if self._rng.random() < self.epsilon:
+                return self._rng.randrange(n)
+            means = [
+                self.rewards[i] / self.counts[i] if self.counts[i] else float("inf")
+                for i in range(n)
+            ]
+            return int(max(range(n), key=means.__getitem__))
+
+    async def send_feedback(self, feedback: Feedback, routing: int) -> None:
+        if routing < 0 or routing >= len(self.counts):
+            return
+        with self._lock:
+            self.counts[routing] += 1
+            self.rewards[routing] += feedback.reward
+
+    # persistence hooks (persistence/persister.py)
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+class AverageCombinerUnit(Unit):
+    """Element-wise mean ensemble (reference AverageCombinerUnit.java:53-76).
+    Shape mismatch across children is an error (reference AverageCombinerTest
+    asserts this)."""
+
+    async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        if not msgs:
+            raise APIException(ErrorCode.ENGINE_INVALID_RESPONSE, "combiner got no inputs")
+        arrays = []
+        shape = None
+        for m in msgs:
+            if m.array is None:
+                raise APIException(
+                    ErrorCode.ENGINE_INVALID_RESPONSE, "combiner child returned no tensor"
+                )
+            a = np.asarray(m.array)
+            if shape is None:
+                shape = a.shape
+            elif a.shape != shape:
+                raise APIException(
+                    ErrorCode.ENGINE_INVALID_RESPONSE,
+                    f"combiner shape mismatch: {a.shape} vs {shape}",
+                )
+            arrays.append(a)
+        mean = np.mean(np.stack(arrays, axis=0), axis=0)
+        return msgs[0].with_array(mean)
+
+    def as_pure_fn(self):
+        import jax.numpy as jnp
+
+        def fn(params, xs):  # xs: tuple of child outputs
+            return jnp.mean(jnp.stack(xs, axis=0), axis=0)
+
+        return fn, None
+
+
+def register_builtins(registry: UnitRegistry) -> None:
+    registry.register(
+        PredictiveUnitImplementation.SIMPLE_MODEL, lambda spec, ctx: SimpleModelUnit(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.SIMPLE_ROUTER, lambda spec, ctx: SimpleRouterUnit(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.RANDOM_ABTEST, lambda spec, ctx: RandomABTestUnit(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.AVERAGE_COMBINER, lambda spec, ctx: AverageCombinerUnit(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.EPSILON_GREEDY, lambda spec, ctx: EpsilonGreedyRouter(spec)
+    )
+    # JAX_MODEL is registered by models/zoo.py (needs the model registry).
+    from seldon_core_tpu.models.zoo import make_jax_model_unit
+
+    registry.register(PredictiveUnitImplementation.JAX_MODEL, make_jax_model_unit)

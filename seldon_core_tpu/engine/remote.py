@@ -1,0 +1,157 @@
+"""Remote-unit escape hatch: call an external microservice for a graph node.
+
+Parity: reference engine InternalPredictionService.java:90-285 — dispatch of
+transform_input/route/aggregate/transform_output/send_feedback to a per-node
+container over REST (form-encoded ``json=`` payload, :216-285) or gRPC.
+Differences by design: connections are pooled and channels cached per
+endpoint (the reference creates a NEW gRPC ManagedChannel per call, :211-214 —
+SURVEY flags it as a perf hazard not to replicate), and the whole thing is
+asyncio instead of blocking RestTemplate.
+
+Internal REST API paths/payloads match docs/reference/internal-api.md so an
+unmodified reference model container (wrappers/python) plugs in directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.core.codec_json import (
+    feedback_to_dict,
+    message_from_dict,
+    message_to_dict,
+)
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.engine.units import ROUTE_ALL, Unit
+from seldon_core_tpu.graph.spec import EndpointType, PredictiveUnit
+
+GRPC_DEADLINE_S = 5.0  # reference InternalPredictionService.java:77
+
+
+class _RestSession:
+    """Shared pooled aiohttp session (lazy, one per process)."""
+
+    _session = None
+
+    @classmethod
+    async def get(cls):
+        import aiohttp
+
+        if cls._session is None or cls._session.closed:
+            cls._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=GRPC_DEADLINE_S),
+                connector=aiohttp.TCPConnector(limit=150),  # reference pool size
+            )
+        return cls._session
+
+
+class RemoteUnit(Unit):
+    """Graph unit whose methods execute in an external service."""
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        ep = spec.endpoint
+        if ep is None or not ep.service_port:
+            raise ValueError(f"RemoteUnit '{spec.name}' needs an endpoint")
+        self.endpoint = ep
+        self._grpc_channel = None  # cached (never per-call)
+
+    # ----------------------------------------------------------- REST path
+    async def _rest_call(self, path: str, payload: dict) -> SeldonMessage:
+        session = await _RestSession.get()
+        url = f"http://{self.endpoint.service_host}:{self.endpoint.service_port}{path}"
+        # reference wire quirk kept for compatibility: body is form-encoded
+        # with the message under a `json=` field (microservice.py:44-52)
+        data = {"json": json.dumps(payload)}
+        try:
+            async with session.post(url, data=data) as resp:
+                body = await resp.text()
+                if resp.status != 200:
+                    raise APIException(
+                        ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                        f"{url} -> {resp.status}: {body[:300]}",
+                    )
+        except APIException:
+            raise
+        except Exception as e:  # noqa: BLE001 - network errors normalised
+            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, f"{url}: {e}") from e
+        try:
+            return message_from_dict(json.loads(body))
+        except (json.JSONDecodeError, APIException) as e:
+            raise APIException(ErrorCode.ENGINE_INVALID_RESPONSE, str(e)) from e
+
+    # ----------------------------------------------------------- gRPC path
+    def _grpc_stub(self, stub_cls):
+        import grpc
+
+        if self._grpc_channel is None:
+            target = f"{self.endpoint.service_host}:{self.endpoint.service_port}"
+            self._grpc_channel = grpc.aio.insecure_channel(target)
+        return stub_cls(self._grpc_channel)
+
+    async def _grpc_call(self, method: str, request_pb) -> SeldonMessage:
+        from seldon_core_tpu.proto import prediction_pb2_grpc as pb_grpc
+        from seldon_core_tpu.core.codec_proto import message_from_proto
+
+        stub = self._grpc_stub(pb_grpc.GenericStub)
+        try:
+            reply = await getattr(stub, method)(request_pb, timeout=GRPC_DEADLINE_S)
+        except Exception as e:  # noqa: BLE001
+            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, f"gRPC {method}: {e}") from e
+        return message_from_proto(reply)
+
+    def _to_proto(self, msg: SeldonMessage):
+        from seldon_core_tpu.core.codec_proto import message_to_proto
+
+        return message_to_proto(msg)
+
+    # ------------------------------------------------------------- methods
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if self.endpoint.type == EndpointType.GRPC:
+            return await self._grpc_call("TransformInput", self._to_proto(msg))
+        # MODEL containers expose /predict; TRANSFORMER ones /transform-input.
+        # The reference tries per unit type (InternalPredictionService:132-161);
+        # we use the unit type to pick the path.
+        from seldon_core_tpu.graph.spec import PredictiveUnitType
+
+        path = "/predict" if self.spec.type == PredictiveUnitType.MODEL else "/transform-input"
+        return await self._rest_call(path, message_to_dict(msg))
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        if self.endpoint.type == EndpointType.GRPC:
+            return await self._grpc_call("TransformOutput", self._to_proto(msg))
+        return await self._rest_call("/transform-output", message_to_dict(msg))
+
+    async def route(self, msg: SeldonMessage) -> int:
+        if self.endpoint.type == EndpointType.GRPC:
+            reply = await self._grpc_call("Route", self._to_proto(msg))
+        else:
+            reply = await self._rest_call("/route", message_to_dict(msg))
+        arr = reply.array
+        if arr is None:
+            raise APIException(ErrorCode.ENGINE_INVALID_RESPONSE, "router returned no data")
+        return int(np.asarray(arr).reshape(-1)[0])
+
+    async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        if self.endpoint.type == EndpointType.GRPC:
+            from seldon_core_tpu.core.codec_proto import message_list_to_proto
+
+            return await self._grpc_call("Aggregate", message_list_to_proto(msgs))
+        payload = {"seldonMessages": [message_to_dict(m) for m in msgs]}
+        return await self._rest_call("/aggregate", payload)
+
+    async def send_feedback(self, feedback: Feedback, routing: int) -> None:
+        if self.endpoint.type == EndpointType.GRPC:
+            from seldon_core_tpu.core.codec_proto import feedback_to_proto
+
+            await self._grpc_call("SendFeedback", feedback_to_proto(feedback))
+            return
+        await self._rest_call("/send-feedback", feedback_to_dict(feedback))
+
+    async def close(self) -> None:
+        if self._grpc_channel is not None:
+            await self._grpc_channel.close()

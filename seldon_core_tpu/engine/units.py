@@ -1,0 +1,189 @@
+"""Graph-unit runtime interface + adapters.
+
+Parity: the reference's unit abstraction is PredictiveUnitImpl
+(engine/.../predictors/PredictiveUnitImpl.java) with five methods dispatched
+either to built-ins or over RPC to a per-node container
+(InternalPredictionService.java:90-214). Here a unit is an in-process object;
+the RPC hop exists only as the RemoteUnit escape hatch (engine/remote.py) for
+non-TPU nodes.
+
+Default method semantics (reference PredictiveUnitBean.java:174-221):
+- transform_input/transform_output: identity unless the unit implements them
+  (for MODEL units transform_input IS predict);
+- route: -1 = fan out to all children;
+- aggregate: pass-through for a single child output, error for many (only
+  COMBINERs aggregate);
+- send_feedback: no-op unless the unit learns (routers).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Awaitable, Callable, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
+from seldon_core_tpu.graph.spec import (
+    Parameter,
+    PredictiveUnit,
+    PredictiveUnitImplementation,
+    parameters_dict,
+)
+
+ROUTE_ALL = -1
+
+
+async def _maybe_await(value):
+    if inspect.isawaitable(value):
+        return await value
+    return value
+
+
+class Unit:
+    """Base graph unit: identity transforms, fan-out routing, no learning."""
+
+    def __init__(self, spec: PredictiveUnit):
+        self.spec = spec
+        self.name = spec.name
+        self.params: dict[str, Any] = parameters_dict(spec.parameters)
+
+    # readiness — aggregated into the server /ready (reference engine boots
+    # models at container start; our models may load weights lazily)
+    def ready(self) -> bool:
+        return True
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        return msg
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        return msg
+
+    async def route(self, msg: SeldonMessage) -> int:
+        return ROUTE_ALL
+
+    async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        if len(msgs) == 1:
+            return msgs[0]
+        raise APIException(
+            ErrorCode.ENGINE_INVALID_ROUTING,
+            f"unit '{self.name}' received {len(msgs)} child outputs but does not aggregate",
+        )
+
+    async def send_feedback(self, feedback: Feedback, routing: int) -> None:
+        return None
+
+    # hook for the fused compiler (engine/fused.py): a unit that can express
+    # itself as a pure jax function returns (fn, params_pytree); others None.
+    def as_pure_fn(self):
+        return None
+
+
+class PythonClassUnit(Unit):
+    """Adapter for duck-typed user model classes — the reference's
+    wrappers/python contract (microservice.py / model_microservice.py etc.):
+
+        class MyModel:
+            def predict(self, X, feature_names): ...
+            def route(self, X, feature_names): ...
+            def aggregate(self, Xs, feature_names_list): ...
+            def transform_input/transform_output(self, X, feature_names): ...
+            def send_feedback(self, X, feature_names, routing, reward, truth): ...
+            class_names / feature_names attributes optional
+
+    Methods may be sync or async. Arrays in/out are numpy (host) — this is the
+    compatibility tier; TPU-resident models use models/base.JaxModelUnit.
+    """
+
+    def __init__(self, spec: PredictiveUnit, user_object: Any):
+        super().__init__(spec)
+        self.user = user_object
+
+    def _names_out(self, fallback: Sequence[str]) -> tuple[str, ...]:
+        cn = getattr(self.user, "class_names", None)
+        return tuple(cn) if cn is not None else tuple(fallback)
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        fn = getattr(self.user, "predict", None) or getattr(self.user, "transform_input", None)
+        if fn is None:
+            return msg
+        x = np.asarray(msg.array)
+        out = await _maybe_await(fn(x, list(msg.names)))
+        return msg.with_array(np.asarray(out), self._names_out(msg.names))
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        fn = getattr(self.user, "transform_output", None)
+        if fn is None:
+            return msg
+        out = await _maybe_await(fn(np.asarray(msg.array), list(msg.names)))
+        return msg.with_array(np.asarray(out), self._names_out(msg.names))
+
+    async def route(self, msg: SeldonMessage) -> int:
+        fn = getattr(self.user, "route", None)
+        if fn is None:
+            return ROUTE_ALL
+        out = await _maybe_await(fn(np.asarray(msg.array), list(msg.names)))
+        arr = np.asarray(out)
+        return int(arr.reshape(-1)[0])
+
+    async def aggregate(self, msgs: Sequence[SeldonMessage]) -> SeldonMessage:
+        fn = getattr(self.user, "aggregate", None)
+        if fn is None:
+            return await super().aggregate(msgs)
+        xs = [np.asarray(m.array) for m in msgs]
+        names = [list(m.names) for m in msgs]
+        out = await _maybe_await(fn(xs, names))
+        base = msgs[0]
+        return base.with_array(np.asarray(out), self._names_out(base.names))
+
+    async def send_feedback(self, feedback: Feedback, routing: int) -> None:
+        fn = getattr(self.user, "send_feedback", None)
+        if fn is None:
+            return
+        req = feedback.request
+        x = np.asarray(req.array) if req is not None and req.array is not None else None
+        names = list(req.names) if req is not None else []
+        truth = (
+            np.asarray(feedback.truth.array)
+            if feedback.truth is not None and feedback.truth.array is not None
+            else None
+        )
+        await _maybe_await(fn(x, names, routing, feedback.reward, truth))
+
+
+UnitFactory = Callable[[PredictiveUnit, dict], Unit]
+
+
+class UnitRegistry:
+    """implementation -> factory map (reference PredictorConfigBean
+    nodeImplementationMap:77-83), extensible with user implementations."""
+
+    def __init__(self) -> None:
+        self._factories: dict[str, UnitFactory] = {}
+
+    def register(self, impl: PredictiveUnitImplementation | str, factory: UnitFactory) -> None:
+        key = impl.value if isinstance(impl, PredictiveUnitImplementation) else impl
+        self._factories[key] = factory
+
+    def create(self, spec: PredictiveUnit, context: dict) -> Unit | None:
+        if spec.implementation is None:
+            return None
+        key = spec.implementation.value
+        factory = self._factories.get(key)
+        if factory is None:
+            return None
+        return factory(spec, context)
+
+
+_default_registry: UnitRegistry | None = None
+
+
+def default_registry() -> UnitRegistry:
+    global _default_registry
+    if _default_registry is None:
+        from seldon_core_tpu.engine import builtin  # late import: avoids cycle
+
+        _default_registry = UnitRegistry()
+        builtin.register_builtins(_default_registry)
+    return _default_registry

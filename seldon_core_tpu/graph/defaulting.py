@@ -1,0 +1,96 @@
+"""Deployment defaulting — pure function, mirroring the pure half of the
+reference operator's ``defaulting()``
+(cluster-manager/.../k8s/SeldonDeploymentOperatorImpl.java:187-322):
+
+- every unit with a type but no methods gets the type-implied methods;
+- MODEL-type units backed by a container get an endpoint wired to sequential
+  ports from a base (reference PU base port 9000,
+  ClusterManagerProperites.getPuContainerPortBase);
+- units with a built-in implementation get no endpoint (in-process);
+- TPU additions: a default mesh ({"data": n_local_devices}) and batch buckets
+  derived from max_batch.
+
+Kubernetes-side defaulting (probes, lifecycle hooks, engine sidecar env) lives
+in operator/resources.py — kept out of here so this stays a pure spec->spec
+function testable against JSON fixtures (reference test style:
+SeldonDeploymentDefaultingTest.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from seldon_core_tpu.core.tensor import default_buckets
+from seldon_core_tpu.graph.spec import (
+    BUILTIN_IMPLEMENTATIONS,
+    TYPE_METHODS,
+    Endpoint,
+    EndpointType,
+    PredictiveUnit,
+    PredictiveUnitImplementation,
+    SeldonDeployment,
+    TpuSpec,
+)
+
+PU_PORT_BASE = 9000  # reference ClusterManagerProperites.getPuContainerPortBase
+
+
+def _has_builtin_impl(unit: PredictiveUnit) -> bool:
+    return (
+        unit.implementation is not None
+        and unit.implementation != PredictiveUnitImplementation.UNKNOWN_IMPLEMENTATION
+        and unit.implementation in BUILTIN_IMPLEMENTATIONS
+    )
+
+
+def _default_unit(
+    unit: PredictiveUnit, container_names: set[str], port_alloc: dict[str, int]
+) -> PredictiveUnit:
+    update: dict = {}
+    if unit.type is not None and not unit.methods:
+        update["methods"] = list(TYPE_METHODS.get(unit.type, ()))
+    needs_endpoint = (
+        not _has_builtin_impl(unit)
+        and unit.name in container_names
+        and (unit.endpoint is None or unit.endpoint.service_port == 0)
+    )
+    if needs_endpoint:
+        port = PU_PORT_BASE + len(port_alloc)
+        port_alloc[unit.name] = port
+        etype = unit.endpoint.type if unit.endpoint else EndpointType.REST
+        update["endpoint"] = Endpoint(service_host="localhost", service_port=port, type=etype)
+    children = [_default_unit(c, container_names, port_alloc) for c in unit.children]
+    if children != list(unit.children):
+        update["children"] = children
+    if not update:
+        return unit
+    return unit.model_copy(update=update)
+
+
+def default_deployment(dep: SeldonDeployment, n_devices: int | None = None) -> SeldonDeployment:
+    """Return a defaulted copy; input is never mutated."""
+    if n_devices is None:
+        try:
+            import jax
+
+            n_devices = jax.local_device_count()
+        except Exception:  # noqa: BLE001 - defaulting must work without a backend
+            n_devices = 1
+
+    new_predictors = []
+    for pred in dep.spec.predictors:
+        container_names = {c.name for c in pred.componentSpec.containers}
+        port_alloc: dict[str, int] = {}
+        graph = _default_unit(pred.graph, container_names, port_alloc)
+        tpu = pred.tpu
+        tpu_update: dict = {}
+        if not tpu.mesh:
+            tpu_update["mesh"] = {"data": n_devices}
+        if not tpu.batch_buckets:
+            tpu_update["batch_buckets"] = list(default_buckets(tpu.max_batch))
+        if tpu_update:
+            tpu = tpu.model_copy(update=tpu_update)
+        new_predictors.append(pred.model_copy(update={"graph": graph, "tpu": tpu}))
+
+    spec = dep.spec.model_copy(update={"predictors": new_predictors})
+    return dep.model_copy(update={"spec": spec})

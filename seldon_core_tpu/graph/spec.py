@@ -1,0 +1,226 @@
+"""Inference-graph + deployment schema.
+
+Parity target: /root/reference/proto/seldon_deployment.proto:10-124
+(SeldonDeployment / DeploymentSpec / PredictorSpec / PredictiveUnit /
+Endpoint / Parameter) — same field names and enums so reference CR JSON
+(e.g. examples/models/sklearn_iris/sklearn_iris_deployment.json) parses
+directly. TPU-first additions are isolated in ``TpuSpec``: mesh shape and
+sharding axes for the compiled graph, batch buckets, and dtype — concepts
+the reference (one container per node, k8s replicas for scale) has no
+analogue for.
+
+Implemented with pydantic for free JSON-schema validation; models are frozen
+(specs are immutable config, runtime state lives in engine/).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class PredictiveUnitType(str, enum.Enum):
+    UNKNOWN_TYPE = "UNKNOWN_TYPE"
+    ROUTER = "ROUTER"
+    COMBINER = "COMBINER"
+    MODEL = "MODEL"
+    TRANSFORMER = "TRANSFORMER"
+    OUTPUT_TRANSFORMER = "OUTPUT_TRANSFORMER"
+
+
+class PredictiveUnitImplementation(str, enum.Enum):
+    UNKNOWN_IMPLEMENTATION = "UNKNOWN_IMPLEMENTATION"
+    SIMPLE_MODEL = "SIMPLE_MODEL"
+    SIMPLE_ROUTER = "SIMPLE_ROUTER"
+    RANDOM_ABTEST = "RANDOM_ABTEST"
+    AVERAGE_COMBINER = "AVERAGE_COMBINER"
+    # TPU-native additions beyond the reference's four built-ins:
+    EPSILON_GREEDY = "EPSILON_GREEDY"  # bandit router (BASELINE config 5)
+    JAX_MODEL = "JAX_MODEL"  # in-process jitted model from the model zoo
+
+
+class PredictiveUnitMethod(str, enum.Enum):
+    TRANSFORM_INPUT = "TRANSFORM_INPUT"
+    TRANSFORM_OUTPUT = "TRANSFORM_OUTPUT"
+    ROUTE = "ROUTE"
+    AGGREGATE = "AGGREGATE"
+    SEND_FEEDBACK = "SEND_FEEDBACK"
+
+
+class EndpointType(str, enum.Enum):
+    REST = "REST"
+    GRPC = "GRPC"
+
+
+class ParameterType(str, enum.Enum):
+    INT = "INT"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    STRING = "STRING"
+    BOOL = "BOOL"
+
+
+class _Spec(BaseModel):
+    model_config = ConfigDict(frozen=True, populate_by_name=True, extra="ignore")
+
+
+class Endpoint(_Spec):
+    service_host: str = ""
+    service_port: int = 0
+    type: EndpointType = EndpointType.REST
+
+
+class Parameter(_Spec):
+    name: str
+    value: str
+    type: ParameterType = ParameterType.STRING
+
+    def typed_value(self) -> Any:
+        """Typed parse, mirroring reference PredictiveUnitState
+        .deserializeParameters (engine) / parse_parameters
+        (wrappers/python/microservice.py:119-133)."""
+        if self.type == ParameterType.INT:
+            return int(self.value)
+        if self.type in (ParameterType.FLOAT, ParameterType.DOUBLE):
+            return float(self.value)
+        if self.type == ParameterType.BOOL:
+            return self.value.strip().lower() in ("true", "1", "yes")
+        return self.value
+
+
+def parameters_dict(params: list["Parameter"]) -> dict[str, Any]:
+    return {p.name: p.typed_value() for p in params}
+
+
+class PredictiveUnit(_Spec):
+    name: str
+    children: list["PredictiveUnit"] = Field(default_factory=list)
+    type: Optional[PredictiveUnitType] = None
+    implementation: Optional[PredictiveUnitImplementation] = None
+    methods: list[PredictiveUnitMethod] = Field(default_factory=list)
+    endpoint: Optional[Endpoint] = None
+    parameters: list[Parameter] = Field(default_factory=list)
+
+    def walk(self):
+        """Pre-order traversal of the unit tree."""
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+class TpuSpec(_Spec):
+    """TPU-native execution config for a predictor (no reference analogue).
+
+    The reference scales with k8s replicas; here a predictor is compiled onto
+    a device mesh. ``mesh`` maps logical axis name -> size (e.g. {"data": 8}
+    for pure batch sharding on v5e-8, {"data": 2, "model": 4} for TP)."""
+
+    mesh: dict[str, int] = Field(default_factory=dict)
+    batch_buckets: list[int] = Field(default_factory=list)  # [] -> derived from max_batch
+    max_batch: int = 64
+    batch_timeout_ms: float = 3.0
+    dtype: str = "float32"  # computation dtype: float32 | bfloat16
+    # donation only pays when output aliases input shape (e.g. transformers);
+    # classifier heads change shape, so default off
+    donate_input: bool = False
+
+
+class ContainerSpec(_Spec):
+    """Minimal PodTemplateSpec-container equivalent: what the operator needs to
+    wire a MODEL unit to its runtime (reference uses full k8s v1.Container;
+    we keep image/name/env + a model_uri for weight loading)."""
+
+    name: str
+    image: str = ""
+    env: dict[str, str] = Field(default_factory=dict)
+    model_uri: str = ""  # checkpoint path for JAX_MODEL units
+
+
+class ComponentSpec(_Spec):
+    containers: list[ContainerSpec] = Field(default_factory=list)
+
+
+class PredictorSpec(_Spec):
+    name: str
+    graph: PredictiveUnit
+    componentSpec: ComponentSpec = Field(default_factory=ComponentSpec)
+    replicas: int = 1
+    annotations: dict[str, str] = Field(default_factory=dict)
+    tpu: TpuSpec = Field(default_factory=TpuSpec)
+
+
+class DeploymentSpec(_Spec):
+    name: str = ""
+    predictors: list[PredictorSpec] = Field(default_factory=list)
+    oauth_key: str = ""
+    oauth_secret: str = ""
+    annotations: dict[str, str] = Field(default_factory=dict)
+
+
+class PredictorStatus(_Spec):
+    name: str
+    status: str = ""
+    description: str = ""
+    replicas: int = 0
+    replicasAvailable: int = 0
+
+
+class DeploymentStatus(_Spec):
+    state: str = ""
+    description: str = ""
+    predictorStatus: list[PredictorStatus] = Field(default_factory=list)
+
+
+class ObjectMeta(_Spec):
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+    resourceVersion: str = ""
+
+
+class SeldonDeployment(_Spec):
+    """The CRD-equivalent resource (reference seldon_deployment.proto:10-16;
+    CRD group machinelearning.seldon.io/v1alpha1, kind SeldonDeployment)."""
+
+    apiVersion: str = "machinelearning.seldon.io/v1alpha1"
+    kind: str = "SeldonDeployment"
+    metadata: ObjectMeta = Field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = Field(default_factory=DeploymentSpec)
+    status: Optional[DeploymentStatus] = None
+
+    @staticmethod
+    def from_dict(obj: dict) -> "SeldonDeployment":
+        return SeldonDeployment.model_validate(obj)
+
+    def to_dict(self) -> dict:
+        return self.model_dump(mode="json", exclude_none=True)
+
+
+# Methods implied by each unit type — reference PredictorConfigBean
+# type->methods map (engine/.../predictors/PredictorConfigBean.java:44-72).
+TYPE_METHODS: dict[PredictiveUnitType, tuple[PredictiveUnitMethod, ...]] = {
+    PredictiveUnitType.MODEL: (PredictiveUnitMethod.TRANSFORM_INPUT,),
+    PredictiveUnitType.TRANSFORMER: (PredictiveUnitMethod.TRANSFORM_INPUT,),
+    PredictiveUnitType.OUTPUT_TRANSFORMER: (PredictiveUnitMethod.TRANSFORM_OUTPUT,),
+    PredictiveUnitType.ROUTER: (
+        PredictiveUnitMethod.ROUTE,
+        PredictiveUnitMethod.SEND_FEEDBACK,
+    ),
+    PredictiveUnitType.COMBINER: (PredictiveUnitMethod.AGGREGATE,),
+}
+
+# Implementations hard-wired in-engine (no microservice/container needed) —
+# reference PredictorConfigBean nodeImplementationMap:77-83 plus our additions.
+BUILTIN_IMPLEMENTATIONS = frozenset(
+    {
+        PredictiveUnitImplementation.SIMPLE_MODEL,
+        PredictiveUnitImplementation.SIMPLE_ROUTER,
+        PredictiveUnitImplementation.RANDOM_ABTEST,
+        PredictiveUnitImplementation.AVERAGE_COMBINER,
+        PredictiveUnitImplementation.EPSILON_GREEDY,
+        PredictiveUnitImplementation.JAX_MODEL,
+    }
+)

@@ -1,0 +1,88 @@
+"""Deployment validation — pure function.
+
+Parity: reference operator ``validate()``
+(cluster-manager/.../k8s/SeldonDeploymentOperatorImpl.java:325-364):
+- every non-builtin unit must name an existing container (:325-347);
+- every unit must have a type or explicit methods (:356-364);
+plus structural rules the reference enforces implicitly at runtime:
+- predictor names unique; unit names unique within a graph;
+- ROUTER/COMBINER must have children, COMBINER >= 1 child;
+- oauth_key/secret both-or-neither;
+- TPU additions: mesh sizes positive, batch buckets sorted ascending,
+  dtype in {float32, bfloat16}.
+Raises ValidationError listing every problem (not just the first) — fixture
+-JSON test style per SeldonDeploymentValidationTest.java.
+"""
+
+from __future__ import annotations
+
+from seldon_core_tpu.graph.spec import (
+    BUILTIN_IMPLEMENTATIONS,
+    PredictiveUnit,
+    PredictiveUnitImplementation,
+    PredictiveUnitType,
+    SeldonDeployment,
+)
+
+
+class ValidationError(ValueError):
+    def __init__(self, problems: list[str]):
+        self.problems = problems
+        super().__init__("; ".join(problems))
+
+
+def _validate_unit(
+    unit: PredictiveUnit, container_names: set[str], seen: set[str], problems: list[str]
+) -> None:
+    if unit.name in seen:
+        problems.append(f"duplicate unit name '{unit.name}' in graph")
+    seen.add(unit.name)
+
+    has_builtin = (
+        unit.implementation is not None
+        and unit.implementation != PredictiveUnitImplementation.UNKNOWN_IMPLEMENTATION
+        and unit.implementation in BUILTIN_IMPLEMENTATIONS
+    )
+    has_endpoint = unit.endpoint is not None and unit.endpoint.service_port != 0
+    if not has_builtin and not has_endpoint and unit.name not in container_names:
+        problems.append(
+            f"unit '{unit.name}' has no implementation and no matching container"
+        )
+    if unit.type is None and not unit.methods and unit.implementation is None:
+        problems.append(f"unit '{unit.name}' must have a type, methods, or implementation")
+
+    if unit.type == PredictiveUnitType.COMBINER and not unit.children:
+        problems.append(f"COMBINER '{unit.name}' must have children")
+    if unit.type == PredictiveUnitType.ROUTER and not unit.children:
+        problems.append(f"ROUTER '{unit.name}' must have children")
+
+    for c in unit.children:
+        _validate_unit(c, container_names, seen, problems)
+
+
+def validate_deployment(dep: SeldonDeployment) -> None:
+    problems: list[str] = []
+    if not dep.spec.predictors:
+        problems.append("deployment must have at least one predictor")
+    names = [p.name for p in dep.spec.predictors]
+    if len(set(names)) != len(names):
+        problems.append("predictor names must be unique")
+    if bool(dep.spec.oauth_key) != bool(dep.spec.oauth_secret):
+        problems.append("oauth_key and oauth_secret must be set together")
+
+    for pred in dep.spec.predictors:
+        container_names = {c.name for c in pred.componentSpec.containers}
+        _validate_unit(pred.graph, container_names, set(), problems)
+        if pred.replicas < 0:
+            problems.append(f"predictor '{pred.name}' replicas must be >= 0")
+        for axis, size in pred.tpu.mesh.items():
+            if size <= 0:
+                problems.append(f"predictor '{pred.name}' mesh axis '{axis}' must be > 0")
+        bb = pred.tpu.batch_buckets
+        if bb and bb != sorted(bb):
+            problems.append(f"predictor '{pred.name}' batch_buckets must be ascending")
+        if pred.tpu.dtype not in ("float32", "bfloat16", "float16"):
+            problems.append(f"predictor '{pred.name}' dtype '{pred.tpu.dtype}' unsupported")
+
+    if problems:
+        raise ValidationError(problems)

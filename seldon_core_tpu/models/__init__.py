@@ -1,0 +1,4 @@
+from seldon_core_tpu.models.base import JaxModelUnit, ModelRuntime
+from seldon_core_tpu.models.zoo import get_model, list_models, register_model
+
+__all__ = ["JaxModelUnit", "ModelRuntime", "get_model", "list_models", "register_model"]

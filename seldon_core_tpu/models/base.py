@@ -1,0 +1,148 @@
+"""TPU model runtime: params resident in HBM, jitted apply, shape buckets.
+
+This is the TPU replacement for the reference's model microservice
+(wrappers/python/model_microservice.py): instead of a Flask/gRPC process per
+model whose predict() runs wherever the container lands, a ModelRuntime keeps
+the weights on device (replicated or sharded over a Mesh) and serves predict
+as a jit-compiled XLA call per batch bucket.
+
+XLA notes:
+- one compiled program per (bucket, dtype) — buckets bound recompilation;
+- params are device_put once with a NamedSharding (replicated by default,
+  tensor-parallel if the model provides a param_sharding rule);
+- inputs are padded host-side to the bucket then device_put with the batch
+  axis sharded over the mesh "data" axis — on v5e-8 a bucket-512 ResNet batch
+  lands 64-per-chip with XLA inserting no collectives until the loss-less
+  output gather.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.core.message import SeldonMessage
+from seldon_core_tpu.core.tensor import bucket_for, default_buckets, pad_batch
+from seldon_core_tpu.engine.units import Unit
+from seldon_core_tpu.graph.spec import PredictiveUnit
+
+ApplyFn = Callable[[Any, jax.Array], jax.Array]
+
+
+class ModelRuntime:
+    """One model loaded onto the device mesh.
+
+    apply_fn(params, x[batch, ...]) -> y[batch, ...] must be pure/jittable.
+    """
+
+    def __init__(
+        self,
+        apply_fn: ApplyFn,
+        params: Any,
+        *,
+        mesh: Mesh | None = None,
+        data_axis: str = "data",
+        param_pspecs: Any | None = None,  # pytree of PartitionSpec for TP models
+        buckets: Sequence[int] = (),
+        max_batch: int = 64,
+        dtype: Any = jnp.float32,
+        class_names: Sequence[str] = (),
+        donate: bool = True,
+    ):
+        self.apply_fn = apply_fn
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.dtype = dtype
+        self.class_names = tuple(class_names)
+        self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
+        self._lock = threading.Lock()
+
+        params = jax.tree.map(lambda a: jnp.asarray(a, dtype=self._param_dtype(a)), params)
+        if mesh is not None:
+            pspecs = param_pspecs if param_pspecs is not None else jax.tree.map(
+                lambda _: P(), params
+            )
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
+                pspecs,
+                is_leaf=lambda x: isinstance(x, P) or x is None,
+            )
+            self.params = jax.device_put(params, shardings)
+            self._in_sharding = NamedSharding(mesh, P(data_axis))
+            self._out_sharding = NamedSharding(mesh, P(data_axis))
+            self._jit = jax.jit(
+                apply_fn,
+                in_shardings=(shardings, self._in_sharding),
+                out_shardings=self._out_sharding,
+                donate_argnums=(1,) if donate else (),
+            )
+        else:
+            self.params = jax.device_put(params)
+            self._in_sharding = None
+            self._jit = jax.jit(apply_fn, donate_argnums=(1,) if donate else ())
+
+    def _param_dtype(self, a) -> Any:
+        a = jnp.asarray(a)
+        return self.dtype if jnp.issubdtype(a.dtype, jnp.floating) else a.dtype
+
+    # -------------------------------------------------------------- predict
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Host-in host-out batched predict with bucket padding."""
+        y = self.predict_device(x)
+        return np.asarray(y)
+
+    def predict_device(self, x: np.ndarray) -> jax.Array:
+        """Like predict but leaves the result on device (graph-internal hops
+        between JAX nodes never touch the host)."""
+        x = np.asarray(x, dtype=self.dtype)
+        n = x.shape[0]
+        bucket = bucket_for(n, self.buckets)
+        if bucket is None:
+            # larger than the biggest bucket: split into max-bucket chunks
+            outs = []
+            step = self.buckets[-1]
+            for i in range(0, n, step):
+                outs.append(self.predict_device(x[i : i + step]))
+            return jnp.concatenate(outs, axis=0)
+        padded, valid = pad_batch(x, bucket)
+        if self._in_sharding is not None:
+            padded = jax.device_put(padded, self._in_sharding)
+        y = self._jit(self.params, padded)
+        return y[:valid]
+
+    def warmup(self) -> None:
+        """Compile every bucket ahead of traffic (first XLA compile is tens of
+        seconds on TPU; serving must not pay that on a live request)."""
+        feat_shape = self._example_feature_shape()
+        for b in self.buckets:
+            x = np.zeros((b, *feat_shape), dtype=self.dtype)
+            _ = self.predict(x[:1]) if b == self.buckets[0] else self.predict(x)
+
+    def _example_feature_shape(self) -> tuple[int, ...]:
+        shape = getattr(self, "feature_shape", None)
+        if shape is None:
+            raise ValueError("set runtime.feature_shape before warmup()")
+        return tuple(shape)
+
+
+class JaxModelUnit(Unit):
+    """Graph unit backed by a ModelRuntime (MODEL node, TPU-resident)."""
+
+    def __init__(self, spec: PredictiveUnit, runtime: ModelRuntime):
+        super().__init__(spec)
+        self.runtime = runtime
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        x = np.asarray(msg.array)
+        y = self.runtime.predict_device(x)
+        return msg.with_array(y, self.runtime.class_names or msg.names)
+
+    def as_pure_fn(self):
+        return self.runtime.apply_fn, self.runtime.params

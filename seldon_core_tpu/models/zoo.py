@@ -1,0 +1,209 @@
+"""Model zoo: named model builders -> (apply_fn, params, metadata).
+
+Parity role: the reference's examples/models/* (sklearn_iris, deep_mnist,
+keras_mnist, mean_classifier, ...) are user containers; here the equivalents
+are JAX builders that the JAX_MODEL graph unit loads straight into HBM.
+``model_uri`` schemes understood by unit_from_container:
+    zoo://<name>[?k=v...]   build from this registry (fresh deterministic init)
+    file://<path>           orbax checkpoint dir (params restored to device)
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.graph.spec import ContainerSpec, PredictiveUnit
+from seldon_core_tpu.models.base import JaxModelUnit, ModelRuntime
+
+
+@dataclass
+class ModelSpec:
+    """What a builder returns: everything needed to instantiate a runtime."""
+
+    apply_fn: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    feature_shape: tuple[int, ...]
+    class_names: tuple[str, ...] = ()
+    param_pspecs: Any | None = None  # PartitionSpec pytree for tensor parallelism
+
+
+Builder = Callable[..., ModelSpec]
+_REGISTRY: dict[str, Builder] = {}
+
+
+def register_model(name: str):
+    def deco(fn: Builder) -> Builder:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# ------------------------------------------------------------------ builders
+
+
+def _dense_init(key, n_in: int, n_out: int):
+    wkey, _ = jax.random.split(key)
+    scale = (2.0 / n_in) ** 0.5
+    return {
+        "w": jax.random.normal(wkey, (n_in, n_out), dtype=jnp.float32) * scale,
+        "b": jnp.zeros((n_out,), dtype=jnp.float32),
+    }
+
+
+@register_model("iris_logistic")
+def build_iris_logistic(seed: int = 0, **_) -> ModelSpec:
+    """Logistic head, 4 features -> 3 classes — the sklearn-iris-equivalent
+    (reference examples/models/sklearn_iris/IrisClassifier.py)."""
+    params = _dense_init(jax.random.key(seed), 4, 3)
+
+    def apply(p, x):
+        return jax.nn.softmax(x @ p["w"] + p["b"], axis=-1)
+
+    return ModelSpec(apply, params, (4,), ("setosa", "versicolor", "virginica"))
+
+
+@register_model("iris_mlp")
+def build_iris_mlp(seed: int = 0, hidden: int = 32, **_) -> ModelSpec:
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    params = {"l1": _dense_init(k1, 4, hidden), "l2": _dense_init(k2, hidden, 3)}
+
+    def apply(p, x):
+        h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
+        return jax.nn.softmax(h @ p["l2"]["w"] + p["l2"]["b"], axis=-1)
+
+    return ModelSpec(apply, params, (4,), ("setosa", "versicolor", "virginica"))
+
+
+@register_model("mean_classifier")
+def build_mean_classifier(**_) -> ModelSpec:
+    """Parity with reference examples/models/mean_classifier/MeanClassifier.py:
+    sigmoid of the feature mean -> single score."""
+    params = {}
+
+    def apply(p, x):
+        return jax.nn.sigmoid(jnp.mean(x, axis=-1, keepdims=True))
+
+    return ModelSpec(apply, params, (4,), ("proba",))
+
+
+@register_model("mnist_mlp")
+def build_mnist_mlp(seed: int = 0, hidden: int = 512, **_) -> ModelSpec:
+    """Deep-MNIST-equivalent (reference examples/models/deep_mnist): flat 784
+    input -> 10 softmax. MLP keeps the matmuls MXU-shaped."""
+    keys = jax.random.split(jax.random.key(seed), 3)
+    params = {
+        "l1": _dense_init(keys[0], 784, hidden),
+        "l2": _dense_init(keys[1], hidden, hidden),
+        "l3": _dense_init(keys[2], hidden, 10),
+    }
+
+    def apply(p, x):
+        x = x.reshape((x.shape[0], -1))
+        h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
+        h = jax.nn.relu(h @ p["l2"]["w"] + p["l2"]["b"])
+        return jax.nn.softmax(h @ p["l3"]["w"] + p["l3"]["b"], axis=-1)
+
+    return ModelSpec(apply, params, (784,), tuple(str(i) for i in range(10)))
+
+
+def _register_heavy_models() -> None:
+    """resnet50 / bert_base import lazily — they pull flax."""
+    from seldon_core_tpu.models import resnet as _resnet  # noqa: F401
+    from seldon_core_tpu.models import bert as _bert  # noqa: F401
+
+
+# ------------------------------------------------------------- unit factory
+
+
+def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
+    import jax.numpy as jnp
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[
+        getattr(tpu_cfg, "dtype", "float32")
+    ]
+    rt = ModelRuntime(
+        ms.apply_fn,
+        ms.params,
+        mesh=mesh,
+        param_pspecs=ms.param_pspecs,
+        buckets=tuple(getattr(tpu_cfg, "batch_buckets", ()) or ()),
+        max_batch=getattr(tpu_cfg, "max_batch", 64),
+        dtype=dtype,
+        class_names=ms.class_names,
+        donate=getattr(tpu_cfg, "donate_input", True),
+    )
+    rt.feature_shape = ms.feature_shape
+    return rt
+
+
+def _parse_zoo_uri(uri: str) -> tuple[str, dict]:
+    parsed = urllib.parse.urlparse(uri)
+    name = parsed.netloc or parsed.path.lstrip("/")
+    kwargs: dict[str, Any] = {}
+    for k, v in urllib.parse.parse_qsl(parsed.query):
+        try:
+            kwargs[k] = int(v)
+        except ValueError:
+            try:
+                kwargs[k] = float(v)
+            except ValueError:
+                kwargs[k] = v
+    return name, kwargs
+
+
+def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
+    if uri.startswith("zoo://"):
+        name, kwargs = _parse_zoo_uri(uri)
+        if name in ("resnet50", "bert_base") and name not in _REGISTRY:
+            _register_heavy_models()
+        ms = get_model(name, **kwargs)
+        return _runtime_from_modelspec(ms, tpu_cfg, mesh)
+    if uri.startswith("file://"):
+        from seldon_core_tpu.persistence.checkpoint import restore_model
+
+        ms = restore_model(uri[len("file://") :])
+        return _runtime_from_modelspec(ms, tpu_cfg, mesh)
+    raise ValueError(f"unsupported model_uri '{uri}'")
+
+
+def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
+    """Factory for implementation=JAX_MODEL units: model name/uri comes from a
+    unit parameter ``model_uri`` (or ``model`` shorthand)."""
+    from seldon_core_tpu.graph.spec import parameters_dict
+
+    params = parameters_dict(spec.parameters)
+    uri = params.get("model_uri") or (
+        f"zoo://{params['model']}" if "model" in params else None
+    )
+    if uri is None:
+        container = (context.get("containers") or {}).get(spec.name)
+        uri = getattr(container, "model_uri", "") or None
+    if uri is None:
+        raise ValueError(f"JAX_MODEL unit '{spec.name}' needs a model_uri parameter")
+    runtime = build_runtime_from_uri(uri, context.get("tpu"), context.get("mesh"))
+    return JaxModelUnit(spec, runtime)
+
+
+def unit_from_container(spec: PredictiveUnit, container: ContainerSpec, context: dict):
+    runtime = build_runtime_from_uri(
+        container.model_uri, context.get("tpu"), context.get("mesh")
+    )
+    return JaxModelUnit(spec, runtime)

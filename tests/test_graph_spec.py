@@ -1,0 +1,141 @@
+"""Graph spec / defaulting / validation tests (reference test style:
+cluster-manager SeldonDeploymentDefaultingTest.java + ValidationTest.java,
+driven by JSON fixtures)."""
+
+import pytest
+
+from seldon_core_tpu.graph import (
+    SeldonDeployment,
+    ValidationError,
+    default_deployment,
+    validate_deployment,
+)
+from seldon_core_tpu.graph.spec import (
+    EndpointType,
+    ParameterType,
+    PredictiveUnitMethod,
+    PredictiveUnitType,
+)
+
+SIMPLE_MODEL_CR = {
+    "apiVersion": "machinelearning.seldon.io/v1alpha1",
+    "kind": "SeldonDeployment",
+    "metadata": {"name": "seldon-model"},
+    "spec": {
+        "name": "test-deployment",
+        "oauth_key": "oauth-key",
+        "oauth_secret": "oauth-secret",
+        "predictors": [
+            {
+                "name": "fx-market-predictor",
+                "replicas": 1,
+                "componentSpec": {
+                    "containers": [{"name": "mean-classifier", "image": "seldonio/mock:1.0"}]
+                },
+                "graph": {
+                    "name": "mean-classifier",
+                    "type": "MODEL",
+                    "endpoint": {"type": "REST"},
+                },
+            }
+        ],
+    },
+}
+
+
+def test_parse_reference_style_cr():
+    dep = SeldonDeployment.from_dict(SIMPLE_MODEL_CR)
+    assert dep.spec.name == "test-deployment"
+    assert dep.spec.predictors[0].graph.type == PredictiveUnitType.MODEL
+
+
+def test_defaulting_fills_methods_and_endpoint():
+    dep = SeldonDeployment.from_dict(SIMPLE_MODEL_CR)
+    out = default_deployment(dep, n_devices=8)
+    g = out.spec.predictors[0].graph
+    assert g.methods == [PredictiveUnitMethod.TRANSFORM_INPUT]
+    assert g.endpoint.service_port == 9000  # reference PU base port
+    assert g.endpoint.type == EndpointType.REST
+    assert out.spec.predictors[0].tpu.mesh == {"data": 8}
+    assert out.spec.predictors[0].tpu.batch_buckets[-1] == 64
+    # input not mutated
+    assert dep.spec.predictors[0].graph.endpoint.service_port == 0
+
+
+def test_defaulting_skips_builtin_implementations():
+    cr = {
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {"name": "stub", "implementation": "SIMPLE_MODEL"},
+                }
+            ],
+        }
+    }
+    out = default_deployment(SeldonDeployment.from_dict(cr), n_devices=1)
+    assert out.spec.predictors[0].graph.endpoint is None
+
+
+def test_validation_missing_container():
+    cr = {
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {"name": "p", "graph": {"name": "nosuch", "type": "MODEL"}}
+            ],
+        }
+    }
+    with pytest.raises(ValidationError) as ei:
+        validate_deployment(SeldonDeployment.from_dict(cr))
+    assert "no matching container" in str(ei.value)
+
+
+def test_validation_requires_type_or_methods():
+    cr = {
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {
+                    "name": "p",
+                    "componentSpec": {"containers": [{"name": "m"}]},
+                    "graph": {"name": "m"},
+                }
+            ],
+        }
+    }
+    with pytest.raises(ValidationError) as ei:
+        validate_deployment(SeldonDeployment.from_dict(cr))
+    assert "must have a type" in str(ei.value)
+
+
+def test_validation_oauth_pairing_and_duplicates():
+    cr = {
+        "spec": {
+            "name": "d",
+            "oauth_key": "k",
+            "predictors": [
+                {"name": "p", "graph": {"name": "s", "implementation": "SIMPLE_MODEL"}},
+                {"name": "p", "graph": {"name": "s2", "implementation": "SIMPLE_MODEL"}},
+            ],
+        }
+    }
+    with pytest.raises(ValidationError) as ei:
+        validate_deployment(SeldonDeployment.from_dict(cr))
+    msg = str(ei.value)
+    assert "oauth" in msg and "unique" in msg
+
+
+def test_validation_passes_valid_deployment():
+    dep = default_deployment(SeldonDeployment.from_dict(SIMPLE_MODEL_CR), n_devices=8)
+    validate_deployment(dep)  # no raise
+
+
+def test_typed_parameters():
+    from seldon_core_tpu.graph.spec import Parameter
+
+    assert Parameter(name="a", value="3", type=ParameterType.INT).typed_value() == 3
+    assert Parameter(name="a", value="0.5", type=ParameterType.FLOAT).typed_value() == 0.5
+    assert Parameter(name="a", value="true", type=ParameterType.BOOL).typed_value() is True
+    assert Parameter(name="a", value="x", type=ParameterType.STRING).typed_value() == "x"
