@@ -48,6 +48,12 @@ class _RestSession:
             )
         return cls._session
 
+    @classmethod
+    async def close(cls):
+        if cls._session is not None and not cls._session.closed:
+            await cls._session.close()
+        cls._session = None
+
 
 class RemoteUnit(Unit):
     """Graph unit whose methods execute in an external service."""
@@ -85,23 +91,46 @@ class RemoteUnit(Unit):
             raise APIException(ErrorCode.ENGINE_INVALID_RESPONSE, str(e)) from e
 
     # ----------------------------------------------------------- gRPC path
-    def _grpc_stub(self, stub_cls):
+    def _grpc_service_for(self, method: str) -> str:
+        """Pick the per-unit-type service a reference container actually
+        serves (prediction.proto:84-103): MODEL containers register
+        Model.Predict, routers Router.Route, etc. Our own grpc_server also
+        registers Generic, but reference wrappers do not."""
+        from seldon_core_tpu.graph.spec import PredictiveUnitType
+
+        t = self.spec.type
+        if method == "Predict" or (method == "TransformInput" and t == PredictiveUnitType.MODEL):
+            return "Model"
+        if method in ("Route", "SendFeedback") and t == PredictiveUnitType.ROUTER:
+            return "Router"
+        if method == "TransformInput":
+            return "Transformer"
+        if method == "TransformOutput":
+            return "OutputTransformer"
+        if method == "Aggregate":
+            return "Combiner"
+        return "Generic"
+
+    async def _grpc_call(self, method: str, request_pb) -> SeldonMessage:
         import grpc
+
+        from seldon_core_tpu.proto.services import ServiceStub
+        from seldon_core_tpu.core.codec_proto import message_from_proto
 
         if self._grpc_channel is None:
             target = f"{self.endpoint.service_host}:{self.endpoint.service_port}"
             self._grpc_channel = grpc.aio.insecure_channel(target)
-        return stub_cls(self._grpc_channel)
-
-    async def _grpc_call(self, method: str, request_pb) -> SeldonMessage:
-        from seldon_core_tpu.proto import prediction_pb2_grpc as pb_grpc
-        from seldon_core_tpu.core.codec_proto import message_from_proto
-
-        stub = self._grpc_stub(pb_grpc.GenericStub)
+        service = self._grpc_service_for(method)
+        # reference containers serve package seldon.protos; wire format is
+        # identical, so address them under that package
+        stub = ServiceStub(self._grpc_channel, service, package="seldon.protos")
+        rpc_method = "Predict" if service == "Model" else method
         try:
-            reply = await getattr(stub, method)(request_pb, timeout=GRPC_DEADLINE_S)
+            reply = await getattr(stub, rpc_method)(request_pb, timeout=GRPC_DEADLINE_S)
         except Exception as e:  # noqa: BLE001
-            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, f"gRPC {method}: {e}") from e
+            raise APIException(
+                ErrorCode.ENGINE_MICROSERVICE_ERROR, f"gRPC {service}.{rpc_method}: {e}"
+            ) from e
         return message_from_proto(reply)
 
     def _to_proto(self, msg: SeldonMessage):

@@ -1,0 +1,3 @@
+from seldon_core_tpu.metrics.registry import Metrics, NullMetrics, get_metrics
+
+__all__ = ["Metrics", "NullMetrics", "get_metrics"]

@@ -1,0 +1,140 @@
+"""Prometheus metrics with the reference's exact metric names/tags so its
+Grafana dashboard ports unchanged (SURVEY §5.5, C10/C27):
+
+- seldon_api_ingress_server_requests_duration_seconds — server-side request
+  histogram (reference api-frontend AuthorizedWebMvcTagsProvider)
+- seldon_api_engine_client_requests_duration_seconds — per-unit-call histogram
+  (reference SeldonRestTemplateExchangeTagsProvider.getTags/getModelMetrics)
+- seldon_api_model_feedback / seldon_api_model_feedback_reward counters
+  (reference PredictiveUnitBean.java:239-242)
+- TPU additions: batch-size histogram, queue-wait histogram, compile counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        REGISTRY,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except Exception:  # noqa: BLE001 - prometheus_client optional
+    HAVE_PROMETHEUS = False
+
+_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0
+)
+
+
+class NullMetrics:
+    """No-op recorder (metrics disabled or prometheus_client absent)."""
+
+    def ingress_request(self, deployment: str, method: str, duration_s: float) -> None:
+        pass
+
+    def unit_call(self, deployment: str, predictor: str, unit: str, method: str,
+                  duration_s: float) -> None:
+        pass
+
+    def feedback(self, deployment: str, predictor: str, unit: str, reward: float) -> None:
+        pass
+
+    def batch(self, deployment: str, size: int, queue_wait_s: float) -> None:
+        pass
+
+    def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
+        pass
+
+    def export(self) -> bytes:
+        return b""
+
+
+class Metrics(NullMetrics):
+    def __init__(self, registry=None):
+        if registry is None:
+            registry = CollectorRegistry()
+        self.registry = registry
+        self._ingress = Histogram(
+            "seldon_api_ingress_server_requests_duration_seconds",
+            "External API request latency",
+            ["deployment_name", "method"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._unit = Histogram(
+            "seldon_api_engine_client_requests_duration_seconds",
+            "Graph unit call latency",
+            ["deployment_name", "predictor_name", "model_name", "method"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._feedback = Counter(
+            "seldon_api_model_feedback",
+            "Feedback events per unit",
+            ["deployment_name", "predictor_name", "model_name"],
+            registry=registry,
+        )
+        # Gauge, not Counter: rewards may be negative (bandit penalties) and
+        # prometheus Counters reject negative increments
+        self._feedback_reward = Gauge(
+            "seldon_api_model_feedback_reward",
+            "Accumulated reward per unit",
+            ["deployment_name", "predictor_name", "model_name"],
+            registry=registry,
+        )
+        self._batch_size = Histogram(
+            "seldon_tpu_batch_size",
+            "Micro-batch sizes submitted to the device",
+            ["deployment_name"],
+            registry=registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._queue_wait = Histogram(
+            "seldon_tpu_batch_queue_wait_seconds",
+            "Time requests wait in the micro-batch queue",
+            ["deployment_name"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._compile = Histogram(
+            "seldon_tpu_xla_compile_seconds",
+            "XLA compilation time per batch bucket",
+            ["deployment_name", "bucket"],
+            registry=registry,
+            buckets=(0.1, 0.5, 1, 5, 10, 30, 60, 120),
+        )
+
+    def ingress_request(self, deployment, method, duration_s):
+        self._ingress.labels(deployment, method).observe(duration_s)
+
+    def unit_call(self, deployment, predictor, unit, method, duration_s):
+        self._unit.labels(deployment, predictor, unit, method).observe(duration_s)
+
+    def feedback(self, deployment, predictor, unit, reward):
+        self._feedback.labels(deployment, predictor, unit).inc()
+        self._feedback_reward.labels(deployment, predictor, unit).inc(reward)
+
+    def batch(self, deployment, size, queue_wait_s):
+        self._batch_size.labels(deployment).observe(size)
+        self._queue_wait.labels(deployment).observe(queue_wait_s)
+
+    def compile(self, deployment, bucket, duration_s):
+        self._compile.labels(deployment, str(bucket)).observe(duration_s)
+
+    def export(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def get_metrics(enabled: bool = True) -> NullMetrics:
+    if enabled and HAVE_PROMETHEUS:
+        return Metrics()
+    return NullMetrics()

@@ -1,0 +1,21 @@
+from seldon_core_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_sharding,
+    initialize_distributed,
+    mesh_from_spec,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "data_sharding",
+    "initialize_distributed",
+    "mesh_from_spec",
+    "replicated",
+]

@@ -1,0 +1,94 @@
+"""Device-mesh construction and axis conventions.
+
+This replaces the reference's only scaling mechanism — k8s ``replicas`` of
+whole predictor pods behind a Service (proto/seldon_deployment.proto:48) —
+with SPMD over a ``jax.sharding.Mesh``:
+
+    axis "data"   — batch sharding (the serving workhorse; ICI all-gather
+                    only at the output edge)
+    axis "model"  — tensor parallelism for models too big for one chip's HBM
+    axis "seq"    — sequence/context parallelism (ring attention) for
+                    long-sequence models (ops/ring_attention.py)
+    axis "expert" — expert parallelism (MoE models)
+
+Multi-host: `initialize_distributed()` wires jax.distributed across hosts of
+a slice (ICI within, DCN across slices) — the TPU-native analogue of the
+reference's pod-to-pod RPC mesh (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def mesh_from_spec(axes: Mapping[str, int] | None, devices=None) -> Mesh | None:
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the device
+    count used; a single-device request returns None (no sharding needed —
+    plain jit is faster than a 1-device mesh)."""
+    if not axes:
+        return None
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = [int(s) for s in axes.values()]
+    total = int(np.prod(sizes))
+    if total == 1:
+        return None
+    if total > len(devices):
+        # graceful degradation: shrink the data axis to what exists (serving
+        # must come up on a smaller slice; reference analogue: fewer replicas)
+        axes = dict(axes)
+        shrink = total // len(devices)
+        if DATA_AXIS in axes and axes[DATA_AXIS] % shrink == 0:
+            axes[DATA_AXIS] //= shrink
+            sizes = [int(s) for s in axes.values()]
+            total = int(np.prod(sizes))
+        if total > len(devices):
+            raise ValueError(
+                f"mesh {dict(axes)} needs {total} devices, have {len(devices)}"
+            )
+    mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, tuple(axes.keys()))
+
+
+def data_sharding(mesh: Mesh | None, axis: str = DATA_AXIS) -> NamedSharding | None:
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh | None) -> NamedSharding | None:
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host init (jax.distributed). No-ops on single-host. Args default
+    from the standard env vars so a k8s operator can inject them the same way
+    the reference injects ENGINE_* vars."""
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes
+        if num_processes is not None
+        else int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id=process_id
+        if process_id is not None
+        else int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )

@@ -1,0 +1,85 @@
+"""gRPC service surface, built on grpc generic handlers (no codegen plugin).
+
+Service/method names match the reference contract
+(/root/reference/proto/prediction.proto:76-109): Generic, Model, Router,
+Transformer, OutputTransformer, Combiner, Seldon. Because reference clients
+address methods as /seldon.protos.<Service>/<Method> while our proto package
+is seldon.tpu, servers register BOTH package prefixes — the payload bytes are
+wire-compatible either way (field numbers match).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import grpc
+
+from seldon_core_tpu.proto import prediction_pb2 as pb
+
+PACKAGES = ("seldon.tpu", "seldon.protos")
+
+# service -> {method: (request_cls, response_cls)}
+SERVICES: dict[str, dict[str, tuple]] = {
+    "Generic": {
+        "TransformInput": (pb.SeldonMessage, pb.SeldonMessage),
+        "TransformOutput": (pb.SeldonMessage, pb.SeldonMessage),
+        "Route": (pb.SeldonMessage, pb.SeldonMessage),
+        "Aggregate": (pb.SeldonMessageList, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    "Model": {"Predict": (pb.SeldonMessage, pb.SeldonMessage)},
+    "Router": {
+        "Route": (pb.SeldonMessage, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    "Transformer": {"TransformInput": (pb.SeldonMessage, pb.SeldonMessage)},
+    "OutputTransformer": {"TransformOutput": (pb.SeldonMessage, pb.SeldonMessage)},
+    "Combiner": {"Aggregate": (pb.SeldonMessageList, pb.SeldonMessage)},
+    "Seldon": {
+        "Predict": (pb.SeldonMessage, pb.SeldonMessage),
+        "SendFeedback": (pb.Feedback, pb.SeldonMessage),
+    },
+    # TPU-native addition
+    "Admin": {"ServerInfo": (pb.ServerInfoRequest, pb.ServerInfo)},
+}
+
+
+def generic_handler(
+    service: str, methods: dict[str, Callable], package: str
+) -> grpc.GenericRpcHandler:
+    """Build a GenericRpcHandler for async unary-unary methods."""
+    spec = SERVICES[service]
+    rpc_handlers = {}
+    for name, fn in methods.items():
+        req_cls, resp_cls = spec[name]
+        rpc_handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+    return grpc.method_handlers_generic_handler(f"{package}.{service}", rpc_handlers)
+
+
+def add_service(server: grpc.aio.Server, service: str, methods: dict[str, Callable]) -> None:
+    """Register an implementation under both package prefixes."""
+    for package in PACKAGES:
+        server.add_generic_rpc_handlers((generic_handler(service, methods, package),))
+
+
+class ServiceStub:
+    """Client stub over a channel for one service (sync or aio channel)."""
+
+    def __init__(self, channel, service: str, package: str = "seldon.tpu"):
+        self._methods = {}
+        for name, (req_cls, resp_cls) in SERVICES[service].items():
+            self._methods[name] = channel.unary_unary(
+                f"/{package}.{service}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def __getattr__(self, name: str):
+        try:
+            return self._methods[name]
+        except KeyError as e:
+            raise AttributeError(name) from e

@@ -1,0 +1,5 @@
+from seldon_core_tpu.serving.batcher import MicroBatcher
+from seldon_core_tpu.serving.server import PredictorServer
+from seldon_core_tpu.serving.service import PredictionService
+
+__all__ = ["MicroBatcher", "PredictionService", "PredictorServer"]

@@ -1,0 +1,178 @@
+"""Request micro-batcher: the TPU replacement for per-request model calls.
+
+The reference engine forwards every client request individually to the model
+container (engine/.../InternalPredictionService.java) — fine for CPU Flask,
+fatal for TPU utilisation. Here concurrent requests for the same predictor are
+coalesced along the batch axis: collect until ``max_batch`` rows or a
+``batch_timeout_ms`` deadline, run the graph ONCE on the merged batch, then
+split the output rows back per request.
+
+Semantics notes (SURVEY §7 hard parts — routing under batching):
+- requests are only merged when their non-batch feature shape matches (a
+  shape-keyed pending map), so XLA sees only bucket shapes;
+- a ROUTER decision inside the graph applies per *merged batch*. For A/B-style
+  random routers this preserves the traffic split in expectation; per-request
+  isolation can be forced with ``batch_across_requests=False`` per deployment.
+- per-request meta (puid) is preserved: graph-produced tags/routing are shared
+  by all requests in the batch, puid stays the caller's own.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.metrics import NullMetrics
+
+
+@dataclass
+class _Pending:
+    msg: SeldonMessage
+    rows: int
+    enqueued_at: float
+    future: asyncio.Future
+
+
+ExecuteFn = Callable[[SeldonMessage], Awaitable[SeldonMessage]]
+
+
+class MicroBatcher:
+    """Coalesces SeldonMessages with tensor payloads for one executor."""
+
+    def __init__(
+        self,
+        execute: ExecuteFn,
+        *,
+        max_batch: int = 64,
+        batch_timeout_ms: float = 3.0,
+        queue_timeout_ms: float = 2000.0,
+        metrics: NullMetrics | None = None,
+        deployment_name: str = "",
+    ):
+        self._execute = execute
+        self.max_batch = max_batch
+        self.batch_timeout_s = batch_timeout_ms / 1000.0
+        self.queue_timeout_s = queue_timeout_ms / 1000.0
+        self._pending: dict[tuple, list[_Pending]] = {}
+        self._pending_rows: dict[tuple, int] = {}
+        self._flush_tasks: dict[tuple, asyncio.TimerHandle] = {}
+        self._metrics = metrics or NullMetrics()
+        self._deployment = deployment_name
+        self._closed = False
+        self._inflight: set[asyncio.Task] = set()
+
+    async def submit(self, msg: SeldonMessage) -> SeldonMessage:
+        """Submit one request; resolves with its own (row-sliced) response."""
+        if self._closed:
+            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, "batcher closed")
+        arr = msg.array
+        if arr is None:
+            # non-tensor payloads can't batch — run through directly
+            return await self._execute(msg)
+        arr = np.asarray(arr)
+        if arr.ndim < 2:
+            arr = np.atleast_2d(arr)
+            msg = msg.with_array(arr)
+        rows = int(arr.shape[0])
+        if rows >= self.max_batch:
+            return await self._execute(msg)
+
+        key = (arr.shape[1:], str(arr.dtype))
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        item = _Pending(msg=msg, rows=rows, enqueued_at=time.perf_counter(), future=fut)
+
+        bucket = self._pending.setdefault(key, [])
+        bucket.append(item)
+        self._pending_rows[key] = self._pending_rows.get(key, 0) + rows
+
+        if self._pending_rows[key] >= self.max_batch:
+            self._cancel_timer(key)
+            self._flush(key)
+        elif key not in self._flush_tasks:
+            self._flush_tasks[key] = loop.call_later(
+                self.batch_timeout_s, self._flush, key
+            )
+        try:
+            return await asyncio.wait_for(fut, timeout=self.queue_timeout_s)
+        except asyncio.TimeoutError:
+            raise APIException(ErrorCode.REQUEST_TIMEOUT, "request timed out in batch queue")
+
+    # ------------------------------------------------------------ internals
+    def _cancel_timer(self, key) -> None:
+        t = self._flush_tasks.pop(key, None)
+        if t is not None:
+            t.cancel()
+
+    def _flush(self, key) -> None:
+        self._flush_tasks.pop(key, None)
+        items = self._pending.pop(key, [])
+        self._pending_rows.pop(key, None)
+        if not items:
+            return
+        task = asyncio.ensure_future(self._run_batch(items))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _run_batch(self, items: list[_Pending]) -> None:
+        now = time.perf_counter()
+        total_rows = sum(i.rows for i in items)
+        self._metrics.batch(self._deployment, total_rows, now - items[0].enqueued_at)
+        try:
+            if len(items) == 1:
+                merged_msg = items[0].msg
+            else:
+                merged = np.concatenate([np.asarray(i.msg.array) for i in items], axis=0)
+                # meta: first request's names; tags merged; puids kept per-item
+                merged_msg = items[0].msg.with_array(merged)
+            out = await self._execute(merged_msg)
+            out_arr = out.array
+            if out_arr is None or len(items) == 1:
+                for i in items:
+                    self._resolve(i, out, own_slice=None)
+                return
+            out_np = np.asarray(out_arr)
+            if out_np.shape[0] != total_rows:
+                # graph changed the batch dim (e.g. global aggregate) — can't
+                # split; every caller gets the full result
+                for i in items:
+                    self._resolve(i, out, own_slice=None)
+                return
+            offset = 0
+            for i in items:
+                sl = out_np[offset : offset + i.rows]
+                offset += i.rows
+                self._resolve(i, out, own_slice=sl)
+        except Exception as e:  # noqa: BLE001 - propagate to every waiter
+            for i in items:
+                if not i.future.done():
+                    i.future.set_exception(e)
+
+    def _resolve(self, item: _Pending, out: SeldonMessage, own_slice) -> None:
+        if item.future.done():
+            return
+        resp = out if own_slice is None else out.with_array(own_slice)
+        # restore the caller's own puid (batch-mates share tags/routing)
+        merged_meta = Meta(
+            puid=item.msg.meta.puid,
+            tags=dict(resp.meta.tags),
+            routing=dict(resp.meta.routing),
+            request_path=dict(resp.meta.request_path),
+        )
+        item.future.set_result(resp.with_meta(merged_meta))
+
+    async def close(self) -> None:
+        """Drain: flush queued requests, then await every in-flight batch so
+        no caller is left with an unresolved future at shutdown."""
+        self._closed = True
+        for key in list(self._pending):
+            self._cancel_timer(key)
+            self._flush(key)
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)

@@ -1,0 +1,123 @@
+"""gRPC ingress for a predictor server.
+
+Parity: reference engine SeldonGrpcServer.java (:33-52 port from
+ENGINE_SERVER_GRPC_PORT default 5000) + SeldonService.java:45 (Seldon.Predict
+-> PredictionService). Also exposes the per-unit-type services (Model/Router/
+Transformer/OutputTransformer/Combiner/Generic) against the ROOT unit so this
+process can stand in for a reference model microservice (wrappers/python gRPC
+mode, C18) — that's what makes our server a drop-in node inside someone
+else's reference graph.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from seldon_core_tpu.core.codec_proto import (
+    feedback_from_proto,
+    message_from_proto,
+    message_list_from_proto,
+    message_to_proto,
+)
+from seldon_core_tpu.core.errors import APIException
+from seldon_core_tpu.core.message import SeldonMessage
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.services import add_service
+from seldon_core_tpu.serving.service import PredictionService
+
+
+def _wrap(fn):
+    """Normalise APIException into a failure SeldonMessage proto (reference
+    returns status-bearing messages rather than transport errors)."""
+
+    async def handler(request, context):
+        try:
+            return await fn(request, context)
+        except APIException as e:
+            msg = SeldonMessage.failure(e.error.code, e.error.message, e.info)
+            return message_to_proto(msg)
+
+    return handler
+
+
+async def start_grpc_server(
+    service: PredictionService, host: str = "0.0.0.0", port: int = 5000
+) -> grpc.aio.Server:
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+    )
+
+    @_wrap
+    async def predict(request, context):
+        out = await service.predict(message_from_proto(request))
+        return message_to_proto(out)
+
+    @_wrap
+    async def send_feedback(request, context):
+        out = await service.send_feedback(feedback_from_proto(request))
+        return message_to_proto(out)
+
+    @_wrap
+    async def transform_input(request, context):
+        out = await service.executor.root.unit.transform_input(
+            message_from_proto(request)
+        )
+        return message_to_proto(out)
+
+    @_wrap
+    async def transform_output(request, context):
+        out = await service.executor.root.unit.transform_output(
+            message_from_proto(request)
+        )
+        return message_to_proto(out)
+
+    @_wrap
+    async def route(request, context):
+        branch = await service.executor.root.unit.route(message_from_proto(request))
+        import numpy as np
+
+        return message_to_proto(
+            SeldonMessage.from_array(np.asarray([[branch]], dtype=np.float32))
+        )
+
+    @_wrap
+    async def aggregate(request, context):
+        out = await service.executor.root.unit.aggregate(message_list_from_proto(request))
+        return message_to_proto(out)
+
+    async def server_info(request, context):
+        import jax
+
+        info = pb.ServerInfo(
+            deployment_name=service.deployment_name,
+            predictor_name=service.predictor_name,
+            device_count=len(jax.devices()),
+            platform=jax.devices()[0].platform,
+        )
+        return info
+
+    add_service(server, "Seldon", {"Predict": predict, "SendFeedback": send_feedback})
+    add_service(server, "Model", {"Predict": predict})
+    add_service(server, "Router", {"Route": route, "SendFeedback": send_feedback})
+    add_service(server, "Transformer", {"TransformInput": transform_input})
+    add_service(server, "OutputTransformer", {"TransformOutput": transform_output})
+    add_service(server, "Combiner", {"Aggregate": aggregate})
+    add_service(
+        server,
+        "Generic",
+        {
+            "TransformInput": transform_input,
+            "TransformOutput": transform_output,
+            "Route": route,
+            "Aggregate": aggregate,
+            "SendFeedback": send_feedback,
+        },
+    )
+    add_service(server, "Admin", {"ServerInfo": server_info})
+
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    return server

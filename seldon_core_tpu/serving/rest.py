@@ -1,0 +1,106 @@
+"""REST server (aiohttp) — the engine's external HTTP surface.
+
+Parity: reference engine RestClientController.java:
+- POST /api/v0.1/predictions (:102) — accepts application/json bodies AND the
+  reference's form-encoded ``json=`` style (microservice.py:44-52 wire quirk);
+- POST /api/v0.1/feedback (:140);
+- GET /ready /ping (:62-75), POST|GET /pause /unpause (:87-99) — /pause flips
+  readiness false so an orchestrator drains the pod, matching the preStop
+  ``curl /pause`` hook the reference operator injects;
+- /metrics and /prometheus (reference scrape annotation path) — Prometheus
+  exposition.
+Errors return the reference's status-JSON shape with its numeric codes.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from seldon_core_tpu.core.codec_json import (
+    feedback_from_dict,
+    message_from_dict,
+    message_to_dict,
+)
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.serving.service import PredictionService
+
+
+async def _payload_dict(request: web.Request) -> dict:
+    """JSON body, or form field ``json=`` (reference wire compat)."""
+    ctype = request.content_type or ""
+    if ctype.startswith("application/x-www-form-urlencoded") or ctype.startswith(
+        "multipart/form-data"
+    ):
+        form = await request.post()
+        raw = form.get("json")
+        if raw is None:
+            raise APIException(ErrorCode.ENGINE_INVALID_JSON, "missing 'json' form field")
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
+    try:
+        return await request.json()
+    except Exception as e:  # noqa: BLE001
+        raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
+
+
+def _error_response(exc: APIException) -> web.Response:
+    return web.json_response(exc.to_status_json(), status=exc.error.http_status)
+
+
+def build_app(service: PredictionService, state: dict | None = None, metrics=None) -> web.Application:
+    state = state if state is not None else {"paused": False}
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["state"] = state
+    app["service"] = service
+
+    async def predictions(request: web.Request) -> web.Response:
+        try:
+            msg = message_from_dict(await _payload_dict(request))
+            out = await service.predict(msg)
+            return web.json_response(message_to_dict(out))
+        except APIException as e:
+            return _error_response(e)
+
+    async def feedback(request: web.Request) -> web.Response:
+        try:
+            fb = feedback_from_dict(await _payload_dict(request))
+            out = await service.send_feedback(fb)
+            return web.json_response(message_to_dict(out))
+        except APIException as e:
+            return _error_response(e)
+
+    async def ready(request: web.Request) -> web.Response:
+        if state["paused"] or not service.executor.ready():
+            return web.Response(status=503, text="paused" if state["paused"] else "loading")
+        return web.Response(text="ready")
+
+    async def ping(request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def pause(request: web.Request) -> web.Response:
+        state["paused"] = True
+        return web.Response(text="paused")
+
+    async def unpause(request: web.Request) -> web.Response:
+        state["paused"] = False
+        return web.Response(text="unpaused")
+
+    async def prometheus(request: web.Request) -> web.Response:
+        m = metrics or getattr(service, "metrics", None)
+        body = m.export() if m is not None else b""
+        return web.Response(body=body, content_type="text/plain")
+
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/ping", ping)
+    for method in ("GET", "POST"):
+        app.router.add_route(method, "/pause", pause)
+        app.router.add_route(method, "/unpause", unpause)
+    app.router.add_get("/metrics", prometheus)
+    app.router.add_get("/prometheus", prometheus)
+    return app

@@ -1,0 +1,70 @@
+"""PredictionService: request-level orchestration above the executor.
+
+Parity: reference engine PredictionService.java (:52-57 puid assignment,
+:69-90 predict/feedback entry) — plus the TPU micro-batcher in the path.
+"""
+
+from __future__ import annotations
+
+import time
+
+from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
+from seldon_core_tpu.core.puid import new_puid
+from seldon_core_tpu.engine.executor import GraphExecutor
+from seldon_core_tpu.metrics import NullMetrics
+from seldon_core_tpu.serving.batcher import MicroBatcher
+
+
+class PredictionService:
+    def __init__(
+        self,
+        executor: GraphExecutor,
+        *,
+        deployment_name: str = "",
+        predictor_name: str = "",
+        batcher: MicroBatcher | None = None,
+        metrics: NullMetrics | None = None,
+    ):
+        self.executor = executor
+        self.deployment_name = deployment_name
+        self.predictor_name = predictor_name
+        self.batcher = batcher
+        self.metrics = metrics or NullMetrics()
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        start = time.perf_counter()
+        if not msg.meta.puid:  # assign-if-missing (PredictionService.java:74-78)
+            msg = msg.with_meta(
+                Meta(
+                    puid=new_puid(),
+                    tags=dict(msg.meta.tags),
+                    routing=dict(msg.meta.routing),
+                    request_path=dict(msg.meta.request_path),
+                )
+            )
+        if self.batcher is not None:
+            out = await self.batcher.submit(msg)
+        else:
+            out = await self.executor.execute(msg)
+        # response carries the request puid (reference restores it :76)
+        if out.meta.puid != msg.meta.puid:
+            out = out.with_meta(
+                Meta(
+                    puid=msg.meta.puid,
+                    tags=dict(out.meta.tags),
+                    routing=dict(out.meta.routing),
+                    request_path=dict(out.meta.request_path),
+                )
+            )
+        self.metrics.ingress_request(
+            self.deployment_name, "predict", time.perf_counter() - start
+        )
+        return out
+
+    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        start = time.perf_counter()
+        await self.executor.send_feedback(feedback)
+        self.metrics.ingress_request(
+            self.deployment_name, "feedback", time.perf_counter() - start
+        )
+        return SeldonMessage(meta=Meta(puid=new_puid()))

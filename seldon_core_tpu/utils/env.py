@@ -1,0 +1,71 @@
+"""Env-var config hand-off, reference-compatible.
+
+The reference's load-bearing config mechanism is base64-JSON-in-env
+(SURVEY §5.6): the operator injects ``ENGINE_PREDICTOR`` = b64(json(
+PredictorSpec)) into the engine container (SeldonDeploymentOperatorImpl
+.java:100-103) and the engine decodes it at boot (EnginePredictor.java:56-117).
+Same contract here, same var names.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any
+
+ENGINE_PREDICTOR = "ENGINE_PREDICTOR"
+ENGINE_SELDON_DEPLOYMENT = "ENGINE_SELDON_DEPLOYMENT"
+ENGINE_SERVER_PORT = "ENGINE_SERVER_PORT"  # default 8000 (CustomizationBean.java)
+ENGINE_SERVER_GRPC_PORT = "ENGINE_SERVER_GRPC_PORT"  # default 5000 (SeldonGrpcServer.java:33)
+PREDICTIVE_UNIT_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
+PREDICTIVE_UNIT_ID = "PREDICTIVE_UNIT_ID"
+SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
+
+
+def encode_b64_json(obj: Any) -> str:
+    return base64.b64encode(json.dumps(obj).encode()).decode("ascii")
+
+
+def decode_b64_json(value: str) -> Any:
+    return json.loads(base64.b64decode(value))
+
+
+def predictor_from_env(env: dict | None = None):
+    """Decode a PredictorSpec (or the first predictor of a full deployment)
+    from the environment; returns (predictor_spec, deployment_name) or None.
+    Mirrors EnginePredictor.init precedence: ENGINE_PREDICTOR, then
+    ENGINE_SELDON_DEPLOYMENT, then ./deploymentdef.json, else None (caller
+    falls back to the default SIMPLE_MODEL graph)."""
+    from seldon_core_tpu.graph.spec import PredictorSpec, SeldonDeployment
+
+    env = env if env is not None else dict(os.environ)
+    raw = env.get(ENGINE_PREDICTOR)
+    if raw:
+        return PredictorSpec.model_validate(decode_b64_json(raw)), env.get(
+            SELDON_DEPLOYMENT_ID, ""
+        )
+    raw = env.get(ENGINE_SELDON_DEPLOYMENT)
+    if raw:
+        dep = SeldonDeployment.from_dict(decode_b64_json(raw))
+        if dep.spec.predictors:
+            return dep.spec.predictors[0], dep.spec.name
+    if os.path.exists("deploymentdef.json"):
+        with open("deploymentdef.json") as f:
+            dep = SeldonDeployment.from_dict(json.load(f))
+        if dep.spec.predictors:
+            return dep.spec.predictors[0], dep.spec.name
+    return None
+
+
+def default_predictor():
+    """The reference's fallback graph when no config is present
+    (EnginePredictor.java:131-150): a single SIMPLE_MODEL unit."""
+    from seldon_core_tpu.graph.spec import PredictiveUnit, PredictorSpec
+
+    return PredictorSpec(
+        name="default",
+        graph=PredictiveUnit.model_validate(
+            {"name": "simple-model", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+        ),
+    )

@@ -1,0 +1,132 @@
+"""Proto codec round-trips + gRPC server integration (reference style:
+pb/TestPredictionProto.java + apife FakeEngineServer end-to-end)."""
+
+import asyncio
+
+import numpy as np
+import grpc
+import pytest
+
+from seldon_core_tpu.core.codec_proto import (
+    feedback_from_proto,
+    feedback_to_proto,
+    message_from_proto,
+    message_to_proto,
+)
+from seldon_core_tpu.core.message import DataKind, Feedback, Meta, SeldonMessage
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.services import ServiceStub
+from seldon_core_tpu.serving.grpc_server import start_grpc_server
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.utils.env import default_predictor
+
+
+def test_proto_tensor_roundtrip():
+    msg = SeldonMessage.from_array(
+        np.asarray([[1.5, 2.5], [3.5, 4.5]], np.float32),
+        ("a", "b"),
+        meta=Meta(puid="p", tags={"k": "v", "n": 2.0}, routing={"r": 1}),
+    )
+    back = message_from_proto(message_to_proto(msg))
+    np.testing.assert_allclose(np.asarray(back.array), np.asarray(msg.array))
+    assert back.names == ("a", "b")
+    assert back.meta.puid == "p"
+    assert back.meta.tags == {"k": "v", "n": 2.0}
+    assert back.meta.routing == {"r": 1}
+
+
+def test_proto_ndarray_and_bin_str():
+    msg = SeldonMessage.from_array(
+        np.asarray([[1.0, 2.0]], np.float32), kind=DataKind.NDARRAY
+    )
+    back = message_from_proto(message_to_proto(msg))
+    assert back.data.kind == DataKind.NDARRAY
+    np.testing.assert_allclose(np.asarray(back.array), [[1.0, 2.0]])
+
+    b = message_from_proto(message_to_proto(SeldonMessage(bin_data=b"xyz")))
+    assert b.bin_data == b"xyz"
+    s = message_from_proto(message_to_proto(SeldonMessage(str_data="hi")))
+    assert s.str_data == "hi"
+
+
+def test_proto_feedback_roundtrip():
+    fb = Feedback(
+        request=SeldonMessage.from_array(np.ones((1, 2), np.float32)),
+        response=SeldonMessage(meta=Meta(routing={"ab": 1})),
+        reward=0.5,
+    )
+    back = feedback_from_proto(feedback_to_proto(fb))
+    assert back.reward == 0.5
+    assert back.response.meta.routing == {"ab": 1}
+
+
+def test_proto_wire_compat_with_reference_package_shape():
+    # serialized bytes parse into a message with reference field numbers:
+    # field 2 = meta, field 3 = data etc. Spot-check via raw descriptor.
+    m = pb.SeldonMessage()
+    assert m.DESCRIPTOR.fields_by_name["meta"].number == 2
+    assert m.DESCRIPTOR.fields_by_name["data"].number == 3
+    assert m.DESCRIPTOR.fields_by_name["binData"].number == 4
+    assert pb.DefaultData.DESCRIPTOR.fields_by_name["tensor"].number == 2
+    assert pb.Feedback.DESCRIPTOR.fields_by_name["reward"].number == 3
+
+
+async def _start_server():
+    service = PredictionService(
+        build_executor(default_predictor()), deployment_name="d", predictor_name="p"
+    )
+    server = await start_grpc_server(service, host="127.0.0.1", port=0)
+    # port 0: find actual bound port
+    return server
+
+
+async def test_grpc_predict_and_feedback_end_to_end():
+    service = PredictionService(
+        build_executor(default_predictor()), deployment_name="d", predictor_name="p"
+    )
+    server = grpc_server = await start_grpc_server(service, "127.0.0.1", 50952)
+    try:
+        async with grpc.aio.insecure_channel("127.0.0.1:50952") as ch:
+            stub = ServiceStub(ch, "Seldon")
+            req = message_to_proto(
+                SeldonMessage.from_array(np.ones((2, 4), np.float32))
+            )
+            reply = await stub.Predict(req)
+            out = message_from_proto(reply)
+            np.testing.assert_allclose(
+                np.asarray(out.array), np.repeat([[0.1, 0.9, 0.5]], 2, 0), rtol=1e-6
+            )
+            assert out.meta.puid  # assigned
+
+            fb = pb.Feedback()
+            fb.reward = 1.0
+            ack = await stub.SendFeedback(fb)
+            assert ack.meta.puid
+
+            # reference-package compatibility: same server, seldon.protos prefix
+            legacy = ServiceStub(ch, "Seldon", package="seldon.protos")
+            reply2 = await legacy.Predict(req)
+            assert message_from_proto(reply2).array is not None
+
+            # Model service against root unit
+            model_stub = ServiceStub(ch, "Model")
+            reply3 = await model_stub.Predict(req)
+            assert message_from_proto(reply3).array is not None
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_admin_server_info():
+    service = PredictionService(
+        build_executor(default_predictor()), deployment_name="dep", predictor_name="p"
+    )
+    server = await start_grpc_server(service, "127.0.0.1", 50953)
+    try:
+        async with grpc.aio.insecure_channel("127.0.0.1:50953") as ch:
+            stub = ServiceStub(ch, "Admin")
+            info = await stub.ServerInfo(pb.ServerInfoRequest())
+            assert info.deployment_name == "dep"
+            assert info.device_count == 8  # virtual CPU mesh
+    finally:
+        await server.stop(None)

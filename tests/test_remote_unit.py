@@ -1,0 +1,79 @@
+"""RemoteUnit escape-hatch integration: a graph node served by an external
+process (here: our own server standing in for a reference model container —
+the apife FakeEngineServer pattern)."""
+
+import asyncio
+
+import numpy as np
+
+from seldon_core_tpu.core.message import SeldonMessage
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph import SeldonDeployment
+from seldon_core_tpu.serving.grpc_server import start_grpc_server
+from seldon_core_tpu.serving.rest import build_app
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.utils.env import default_predictor
+
+
+def _graph_with_remote(port: int, etype: str):
+    cr = {
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "remote-model",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": port,
+                            "type": etype,
+                        },
+                    },
+                }
+            ],
+        }
+    }
+    return SeldonDeployment.from_dict(cr).spec.predictors[0]
+
+
+async def test_remote_grpc_model_node():
+    backend = PredictionService(build_executor(default_predictor()))
+    server = await start_grpc_server(backend, "127.0.0.1", 50954)
+    try:
+        ex = build_executor(_graph_with_remote(50954, "GRPC"))
+        out = await ex.execute(SeldonMessage.from_array(np.ones((1, 4), np.float32)))
+        np.testing.assert_allclose(np.asarray(out.array), [[0.1, 0.9, 0.5]], rtol=1e-6)
+    finally:
+        await server.stop(None)
+
+
+async def test_remote_rest_model_node():
+    from aiohttp import web
+
+    # a minimal reference-style model microservice: form-encoded json= in,
+    # prediction JSON out (wrappers/python/model_microservice.py contract)
+    async def predict(request):
+        form = await request.post()
+        assert "json" in form
+        return web.json_response(
+            {"data": {"names": ["c0"], "ndarray": [[0.7]]}, "meta": {"tags": {"served": "rest"}}}
+        )
+
+    app = web.Application()
+    app.router.add_post("/predict", predict)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 50955)
+    await site.start()
+    try:
+        ex = build_executor(_graph_with_remote(50955, "REST"))
+        out = await ex.execute(SeldonMessage.from_array(np.ones((1, 4), np.float32)))
+        np.testing.assert_allclose(np.asarray(out.array), [[0.7]])
+        assert out.meta.tags == {"served": "rest"}
+    finally:
+        from seldon_core_tpu.engine.remote import _RestSession
+
+        await _RestSession.close()
+        await runner.cleanup()

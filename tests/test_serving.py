@@ -1,0 +1,283 @@
+"""Serving-layer tests: micro-batcher semantics + in-process REST API tests
+(reference style: engine api/rest/TestRestClientController.java boots the
+full engine with its default SIMPLE_MODEL graph and posts predictions)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.core import APIException, SeldonMessage
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph import SeldonDeployment
+from seldon_core_tpu.serving.batcher import MicroBatcher
+from seldon_core_tpu.serving.rest import build_app
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.utils.env import default_predictor
+
+
+def _predictor(graph: dict):
+    cr = {"spec": {"name": "d", "predictors": [{"name": "p", "graph": graph}]}}
+    return SeldonDeployment.from_dict(cr).spec.predictors[0]
+
+
+# ------------------------------------------------------------------ batcher
+
+
+async def test_batcher_coalesces_concurrent_requests():
+    calls = []
+
+    async def execute(msg):
+        calls.append(np.asarray(msg.array).shape[0])
+        return msg.with_array(np.asarray(msg.array) * 2)
+
+    b = MicroBatcher(execute, max_batch=64, batch_timeout_ms=20.0)
+    msgs = [SeldonMessage.from_array(np.full((1, 4), i, np.float32)) for i in range(8)]
+    outs = await asyncio.gather(*(b.submit(m) for m in msgs))
+    assert len(calls) == 1 and calls[0] == 8  # one device call for 8 requests
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(out.array), np.full((1, 4), i * 2))
+
+
+async def test_batcher_flushes_at_max_batch_without_waiting():
+    async def execute(msg):
+        return msg
+
+    b = MicroBatcher(execute, max_batch=4, batch_timeout_ms=10_000.0)
+    msgs = [SeldonMessage.from_array(np.ones((1, 2), np.float32)) for _ in range(4)]
+    outs = await asyncio.wait_for(
+        asyncio.gather(*(b.submit(m) for m in msgs)), timeout=2.0
+    )
+    assert len(outs) == 4  # did not wait for the 10s timer
+
+
+async def test_batcher_separates_incompatible_shapes():
+    calls = []
+
+    async def execute(msg):
+        calls.append(np.asarray(msg.array).shape)
+        return msg
+
+    b = MicroBatcher(execute, max_batch=64, batch_timeout_ms=10.0)
+    a = SeldonMessage.from_array(np.ones((1, 4), np.float32))
+    c = SeldonMessage.from_array(np.ones((1, 7), np.float32))
+    await asyncio.gather(b.submit(a), b.submit(c))
+    assert sorted(s[1] for s in calls) == [4, 7]  # two separate device calls
+
+
+async def test_batcher_preserves_per_request_puid():
+    async def execute(msg):
+        return msg.with_array(np.asarray(msg.array))
+
+    b = MicroBatcher(execute, max_batch=8, batch_timeout_ms=10.0)
+    from seldon_core_tpu.core.message import Meta
+
+    m1 = SeldonMessage.from_array(np.ones((1, 2), np.float32), meta=Meta(puid="p1"))
+    m2 = SeldonMessage.from_array(np.ones((1, 2), np.float32), meta=Meta(puid="p2"))
+    o1, o2 = await asyncio.gather(b.submit(m1), b.submit(m2))
+    assert o1.meta.puid == "p1" and o2.meta.puid == "p2"
+
+
+async def test_batcher_propagates_errors_to_all_waiters():
+    async def execute(msg):
+        raise APIException.__new__(APIException) or None
+
+    async def failing(msg):
+        raise RuntimeError("boom")
+
+    b = MicroBatcher(failing, max_batch=8, batch_timeout_ms=5.0)
+    m = SeldonMessage.from_array(np.ones((1, 2), np.float32))
+    with pytest.raises(RuntimeError):
+        await asyncio.gather(b.submit(m), b.submit(m))
+
+
+# ------------------------------------------------------------------ REST API
+
+
+async def _client(service) -> TestClient:
+    app = build_app(service)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def _default_service(batch=False):
+    pred = default_predictor()
+    ex = build_executor(pred)
+    batcher = MicroBatcher(ex.execute, max_batch=16, batch_timeout_ms=2.0) if batch else None
+    return PredictionService(ex, deployment_name="d", predictor_name="p", batcher=batcher)
+
+
+async def test_rest_predictions_default_graph():
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            json={"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["data"]["names"] == ["c0", "c1", "c2"]
+        # response mirrors the request's wire form (ndarray in -> ndarray out)
+        np.testing.assert_allclose(body["data"]["ndarray"], [[0.1, 0.9, 0.5]], rtol=1e-6)
+        assert body["meta"]["puid"]  # puid was assigned
+    finally:
+        await client.close()
+
+
+async def test_rest_form_encoded_compat():
+    # reference wire quirk: form field json= (microservice.py:44-52)
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data={"json": json.dumps({"data": {"ndarray": [[1, 2, 3, 4]]}})},
+        )
+        assert resp.status == 200
+        assert (await resp.json())["data"]["names"] == ["c0", "c1", "c2"]
+    finally:
+        await client.close()
+
+
+async def test_rest_invalid_json_gives_reference_error_shape():
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions", data=b"{bad", headers={"Content-Type": "application/json"}
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["code"] == 101 and body["status"] == "FAILURE"
+    finally:
+        await client.close()
+
+
+async def test_rest_health_and_pause_cycle():
+    client = await _client(_default_service())
+    try:
+        assert (await client.get("/ping")).status == 200
+        assert (await client.get("/ready")).status == 200
+        assert (await client.post("/pause")).status == 200
+        assert (await client.get("/ready")).status == 503
+        assert (await client.post("/unpause")).status == 200
+        assert (await client.get("/ready")).status == 200
+    finally:
+        await client.close()
+
+
+async def test_rest_feedback_roundtrip():
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/feedback",
+            json={
+                "request": {"data": {"ndarray": [[1, 2, 3, 4]]}},
+                "response": {"meta": {"routing": {}}},
+                "reward": 1.0,
+            },
+        )
+        assert resp.status == 200
+    finally:
+        await client.close()
+
+
+async def test_rest_predictions_through_batcher():
+    client = await _client(_default_service(batch=True))
+    try:
+        resps = await asyncio.gather(
+            *(
+                client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}},
+                )
+                for _ in range(8)
+            )
+        )
+        assert all(r.status == 200 for r in resps)
+        puids = {(await r.json())["meta"]["puid"] for r in resps}
+        assert len(puids) == 8  # unique per request even when batched
+    finally:
+        await client.close()
+
+
+async def test_metrics_endpoint_exposes_reference_names():
+    from seldon_core_tpu.metrics import get_metrics
+
+    pred = default_predictor()
+    ex = build_executor(pred)
+    metrics = get_metrics(True)
+    service = PredictionService(ex, deployment_name="d", metrics=metrics)
+    app = build_app(service, metrics=metrics)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        await client.post(
+            "/api/v0.1/predictions", json={"data": {"ndarray": [[1, 2, 3, 4]]}}
+        )
+        body = await (await client.get("/prometheus")).text()
+        assert "seldon_api_ingress_server_requests_duration_seconds" in body
+    finally:
+        await client.close()
+
+
+async def test_batcher_scalar_payload_no_crash():
+    async def execute(msg):
+        return msg
+
+    b = MicroBatcher(execute, max_batch=8, batch_timeout_ms=5.0)
+    from seldon_core_tpu.core.codec_json import message_from_dict
+
+    out = await b.submit(message_from_dict({"data": {"ndarray": 5}}))
+    assert np.asarray(out.array).shape == (1, 1)
+
+
+async def test_batcher_close_drains_inflight():
+    started = asyncio.Event()
+
+    async def slow_execute(msg):
+        started.set()
+        await asyncio.sleep(0.1)
+        return msg
+
+    b = MicroBatcher(slow_execute, max_batch=8, batch_timeout_ms=1.0)
+    m = SeldonMessage.from_array(np.ones((1, 2), np.float32))
+    task = asyncio.ensure_future(b.submit(m))
+    await started.wait()
+    await b.close()  # must wait for the in-flight batch
+    assert task.done() and not task.exception()
+
+
+async def test_negative_reward_feedback_with_metrics():
+    from seldon_core_tpu.metrics import get_metrics
+
+    graph = {
+        "name": "eg",
+        "implementation": "EPSILON_GREEDY",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    metrics = get_metrics(True)
+
+    def hook(unit, reward):
+        metrics.feedback("d", "p", unit, reward)
+
+    ex = build_executor(_predictor(graph), feedback_metrics_hook=hook)
+    service = PredictionService(ex, deployment_name="d", metrics=metrics)
+    client = await _client(service)
+    try:
+        resp = await client.post(
+            "/api/v0.1/feedback",
+            json={
+                "request": {"data": {"ndarray": [[1, 2, 3, 4]]}},
+                "response": {"meta": {"routing": {"eg": 0}}},
+                "reward": -1.0,
+            },
+        )
+        assert resp.status == 200  # negative rewards must not crash metrics
+    finally:
+        await client.close()
