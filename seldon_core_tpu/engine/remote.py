@@ -65,6 +65,7 @@ class RemoteUnit(Unit):
             raise ValueError(f"RemoteUnit '{spec.name}' needs an endpoint")
         self.endpoint = ep
         self._grpc_channel = None  # cached (never per-call)
+        self._stub_cache: dict[str, object] = {}
 
     # ----------------------------------------------------------- REST path
     async def _rest_call(self, path: str, payload: dict) -> SeldonMessage:
@@ -121,9 +122,15 @@ class RemoteUnit(Unit):
             target = f"{self.endpoint.service_host}:{self.endpoint.service_port}"
             self._grpc_channel = grpc.aio.insecure_channel(target)
         service = self._grpc_service_for(method)
+        # stub per service, cached — the reference's perf hazard is a new
+        # ManagedChannel per call (InternalPredictionService.java:211-214);
+        # we reuse both the channel and the per-service stub.
         # reference containers serve package seldon.protos; wire format is
         # identical, so address them under that package
-        stub = ServiceStub(self._grpc_channel, service, package="seldon.protos")
+        stub = self._stub_cache.get(service)
+        if stub is None:
+            stub = ServiceStub(self._grpc_channel, service, package="seldon.protos")
+            self._stub_cache[service] = stub
         rpc_method = "Predict" if service == "Model" else method
         try:
             reply = await getattr(stub, rpc_method)(request_pb, timeout=GRPC_DEADLINE_S)

@@ -1,0 +1,41 @@
+from seldon_core_tpu.gateway.app import (
+    Backend,
+    Gateway,
+    InProcessBackend,
+    RemoteBackend,
+    build_gateway_app,
+)
+from seldon_core_tpu.gateway.audit import (
+    AuditSink,
+    JsonlAuditSink,
+    KafkaAuditSink,
+    MemoryAuditSink,
+    NullAuditSink,
+    make_audit_sink,
+)
+from seldon_core_tpu.gateway.oauth import (
+    FileTokenStore,
+    InMemoryTokenStore,
+    OAuthProvider,
+    make_token_store,
+)
+from seldon_core_tpu.gateway.store import DeploymentStore
+
+__all__ = [
+    "AuditSink",
+    "Backend",
+    "DeploymentStore",
+    "FileTokenStore",
+    "Gateway",
+    "InMemoryTokenStore",
+    "InProcessBackend",
+    "JsonlAuditSink",
+    "KafkaAuditSink",
+    "MemoryAuditSink",
+    "NullAuditSink",
+    "OAuthProvider",
+    "RemoteBackend",
+    "build_gateway_app",
+    "make_audit_sink",
+    "make_token_store",
+]

@@ -1,0 +1,273 @@
+"""Ingress gateway: OAuth2 + external prediction API (reference api-frontend).
+
+Parity (C13): REST POST /api/v0.1/predictions and /api/v0.1/feedback with
+Bearer auth, POST /oauth/token (client_credentials), principal ->
+DeploymentSpec lookup (APIFE_NO_RUNNING_DEPLOYMENT when absent —
+PredictionService.java:42-46), request/response audit after every prediction
+(RestClientController.java:164), ingress metrics (:188-189).
+
+Backends: the reference always crosses the network to the engine Service.
+Here the default is IN-PROCESS — the engine (graph executor + TPU runtimes)
+lives in the same process, so gateway->engine is a function call; the
+RemoteBackend (pooled HTTP, reference timeouts 200/500/2000 ms, retry) covers
+split deployments where the predictor runs on a different TPU host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+from aiohttp import web
+
+from seldon_core_tpu.core.codec_json import (
+    feedback_from_dict,
+    message_from_dict,
+    message_to_dict,
+)
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.gateway.audit import AuditSink, NullAuditSink
+from seldon_core_tpu.gateway.oauth import OAuthProvider
+from seldon_core_tpu.gateway.store import DeploymentStore
+
+
+class Backend:
+    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
+        raise NotImplementedError
+
+    async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
+        raise NotImplementedError
+
+
+class InProcessBackend(Backend):
+    """deployment name -> PredictionService living in this process (the
+    TPU-native collapse of the reference's gateway->engine network hop)."""
+
+    def __init__(self):
+        self.services: dict[str, object] = {}
+
+    def register(self, name: str, service) -> None:
+        self.services[name] = service
+
+    def unregister(self, name: str) -> None:
+        self.services.pop(name, None)
+
+    def _service(self, deployment):
+        svc = self.services.get(deployment.name)
+        if svc is None:
+            raise APIException(ErrorCode.APIFE_NO_RUNNING_DEPLOYMENT, deployment.name)
+        return svc
+
+    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
+        return await self._service(deployment).predict(msg)
+
+    async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
+        return await self._service(deployment).send_feedback(fb)
+
+
+class RemoteBackend(Backend):
+    """Pooled HTTP to a per-deployment engine host. Reference parity:
+    api-frontend InternalPredictionService.java — 150-connection pool
+    (:60-61), timeouts conn 500 ms / total 2000 ms (:52-54), one retry on
+    idempotent failure (HttpRetryHandler.java)."""
+
+    def __init__(self, resolve=None):
+        # resolve(deployment) -> base url; default: k8s-style service DNS
+        self._resolve = resolve or (lambda d: f"http://{d.name}:8000")
+        self._session = None
+
+    async def _get_session(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=150, limit_per_host=150),
+                timeout=aiohttp.ClientTimeout(total=2.0, connect=0.5),
+            )
+        return self._session
+
+    async def _post(self, deployment, path: str, payload: dict) -> dict:
+        session = await self._get_session()
+        url = self._resolve(deployment) + path
+        last_exc: Exception | None = None
+        for _ in range(2):  # original + 1 retry
+            try:
+                async with session.post(url, json=payload) as resp:
+                    body = await resp.text()
+                    if resp.status >= 500:
+                        last_exc = APIException(
+                            ErrorCode.APIFE_MICROSERVICE_ERROR, body[:200]
+                        )
+                        continue
+                    parsed = json.loads(body)
+                    if resp.status >= 400:
+                        # engine status-JSON error body (errors.py shape):
+                        # re-raise with the engine's code, don't parse it as
+                        # a SeldonMessage
+                        if isinstance(parsed, dict) and parsed.get("status") == "FAILURE":
+                            code = parsed.get("code")
+                            err = next(
+                                (e for e in ErrorCode if e.code == code),
+                                ErrorCode.APIFE_MICROSERVICE_ERROR,
+                            )
+                            raise APIException(err, str(parsed.get("info", "")))
+                        raise APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, body[:200])
+                    return parsed
+            except APIException:
+                raise  # engine-reported errors are not retryable
+            except Exception as e:  # noqa: BLE001
+                last_exc = e
+        if isinstance(last_exc, APIException):
+            raise last_exc
+        raise APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(last_exc))
+
+    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
+        out = await self._post(deployment, "/api/v0.1/predictions", message_to_dict(msg))
+        return message_from_dict(out)
+
+    async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
+        from seldon_core_tpu.core.codec_json import feedback_to_dict
+
+        out = await self._post(deployment, "/api/v0.1/feedback", feedback_to_dict(fb))
+        return message_from_dict(out)
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class Gateway:
+    def __init__(
+        self,
+        store: DeploymentStore | None = None,
+        oauth: OAuthProvider | None = None,
+        backend: Backend | None = None,
+        audit: AuditSink | None = None,
+        metrics=None,
+    ):
+        self.oauth = oauth or OAuthProvider()
+        self.store = store or DeploymentStore(oauth=self.oauth)
+        if self.store.oauth is None:
+            self.store.oauth = self.oauth
+        self.backend = backend or InProcessBackend()
+        self.audit = audit or NullAuditSink()
+        self.metrics = metrics
+        # reference backdoor: TEST_CLIENT_KEY env registers a test client
+        # (AuthorizationServerConfiguration.java:78-96)
+        test_key = os.environ.get("TEST_CLIENT_KEY", "")
+        if test_key:
+            self.oauth.add_client(test_key, os.environ.get("TEST_CLIENT_SECRET", "secret"))
+
+    # ----- auth helpers
+    def _principal(self, request: web.Request) -> str:
+        auth = request.headers.get("Authorization", "")
+        if auth.lower().startswith("bearer "):
+            token = auth[7:].strip()
+            principal = self.oauth.principal(token)
+            if principal:
+                return principal
+        raise APIException(ErrorCode.APIFE_GRPC_NO_PRINCIPAL_FOUND, "invalid or missing token")
+
+    def _deployment(self, principal: str):
+        dep = self.store.by_principal(principal)
+        if dep is None:
+            # TEST_CLIENT_KEY principal maps to the sole deployment if any
+            if principal == os.environ.get("TEST_CLIENT_KEY", "") and self.store.names():
+                return self.store.by_name(self.store.names()[0])
+            raise APIException(ErrorCode.APIFE_NO_RUNNING_DEPLOYMENT, principal)
+        return dep
+
+
+from seldon_core_tpu.serving.http_util import error_response as _error_response
+from seldon_core_tpu.serving.http_util import payload_dict
+
+
+async def _payload_dict(request: web.Request) -> dict:
+    return await payload_dict(request, ErrorCode.APIFE_INVALID_JSON)
+
+
+def build_gateway_app(gw: Gateway) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["gateway"] = gw
+
+    async def token(request: web.Request) -> web.Response:
+        # client_credentials via Basic auth header or form fields
+        import base64
+
+        client_id = client_secret = ""
+        auth = request.headers.get("Authorization", "")
+        if auth.lower().startswith("basic "):
+            try:
+                decoded = base64.b64decode(auth[6:]).decode()
+                client_id, _, client_secret = decoded.partition(":")
+            except Exception:  # noqa: BLE001
+                pass
+        if not client_id:
+            form = await request.post()
+            client_id = form.get("client_id", "")
+            client_secret = form.get("client_secret", "")
+        try:
+            return web.json_response(gw.oauth.issue_token(client_id, client_secret))
+        except PermissionError:
+            return web.json_response(
+                {"error": "invalid_client", "error_description": "Bad client credentials"},
+                status=401,
+            )
+
+    async def predictions(request: web.Request) -> web.Response:
+        import time as _time
+
+        start = _time.perf_counter()
+        try:
+            principal = gw._principal(request)
+            dep = gw._deployment(principal)
+            msg = message_from_dict(await _payload_dict(request))
+            out = await gw.backend.predict(dep, msg)
+            gw.audit.send(principal, msg, out)  # RestClientController.java:164
+            if gw.metrics is not None:
+                gw.metrics.ingress_request(
+                    dep.name, "predict", _time.perf_counter() - start
+                )
+            return web.json_response(message_to_dict(out))
+        except APIException as e:
+            return _error_response(e)
+
+    async def feedback(request: web.Request) -> web.Response:
+        import time as _time
+
+        start = _time.perf_counter()
+        try:
+            principal = gw._principal(request)
+            dep = gw._deployment(principal)
+            fb = feedback_from_dict(await _payload_dict(request))
+            out = await gw.backend.feedback(dep, fb)
+            if gw.metrics is not None:
+                gw.metrics.ingress_request(
+                    dep.name, "feedback", _time.perf_counter() - start
+                )
+                gw.metrics.feedback(dep.name, "", "", fb.reward)
+            return web.json_response(message_to_dict(out))
+        except APIException as e:
+            return _error_response(e)
+
+    async def ready(request: web.Request) -> web.Response:
+        return web.Response(text="ready")
+
+    async def ping(request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def prometheus(request: web.Request) -> web.Response:
+        body = gw.metrics.export() if gw.metrics is not None else b""
+        return web.Response(body=body, content_type="text/plain")
+
+    app.router.add_post("/oauth/token", token)
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/ping", ping)
+    app.router.add_get("/metrics", prometheus)
+    app.router.add_get("/prometheus", prometheus)
+    return app

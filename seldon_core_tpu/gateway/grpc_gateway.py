@@ -1,0 +1,69 @@
+"""gRPC ingress gateway with oauth_token metadata auth.
+
+Parity (C16): reference api-frontend SeldonGrpcServer.java +
+HeaderServerInterceptor.java:42-75 — reads metadata key ``oauth_token``,
+validates it against the token store, resolves the principal's deployment,
+and forwards Seldon.Predict / Seldon.SendFeedback. The reference keeps a
+per-deployment ManagedChannel cache (:114-132, 197-203); the in-process
+backend makes that a dict lookup, and the channel-cache behavior survives in
+RemoteBackend's pooled session.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from seldon_core_tpu.core.codec_proto import (
+    feedback_from_proto,
+    message_from_proto,
+    message_to_proto,
+)
+from seldon_core_tpu.core.errors import APIException
+from seldon_core_tpu.core.message import SeldonMessage
+from seldon_core_tpu.proto.services import add_service
+
+OAUTH_METADATA_KEY = "oauth_token"  # HeaderServerInterceptor.java:42-44
+
+
+async def start_gateway_grpc(gw, host: str = "0.0.0.0", port: int = 5000) -> grpc.aio.Server:
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+    )
+
+    def _auth(context) -> tuple[str, object]:
+        meta = dict(context.invocation_metadata() or ())
+        token = meta.get(OAUTH_METADATA_KEY, "")
+        principal = gw.oauth.principal(token) if token else None
+        if not principal:
+            from seldon_core_tpu.core.errors import ErrorCode
+
+            raise APIException(ErrorCode.APIFE_GRPC_NO_PRINCIPAL_FOUND, "oauth_token")
+        return principal, gw._deployment(principal)
+
+    async def predict(request, context):
+        try:
+            principal, dep = _auth(context)
+            msg = message_from_proto(request)
+            out = await gw.backend.predict(dep, msg)
+            gw.audit.send(principal, msg, out)
+            return message_to_proto(out)
+        except APIException as e:
+            msg = SeldonMessage.failure(e.error.code, e.error.message, e.info)
+            return message_to_proto(msg)
+
+    async def send_feedback(request, context):
+        try:
+            principal, dep = _auth(context)
+            out = await gw.backend.feedback(dep, feedback_from_proto(request))
+            return message_to_proto(out)
+        except APIException as e:
+            msg = SeldonMessage.failure(e.error.code, e.error.message, e.info)
+            return message_to_proto(msg)
+
+    add_service(server, "Seldon", {"Predict": predict, "SendFeedback": send_feedback})
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    return server

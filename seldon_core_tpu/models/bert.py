@@ -1,0 +1,198 @@
+"""BERT-base encoder in pure JAX with tensor-parallel PartitionSpecs.
+
+Parity role: BASELINE.json's "Full DAG: input Transformer -> epsilon-greedy
+Router -> BERT-base models -> Combiner" config. The reference would run each
+BERT as its own GPU container; here it is a params pytree whose attention/MLP
+weights carry PartitionSpecs so ModelRuntime can shard them over the mesh
+"model" axis (Megatron-style TP: qkv column-split, output row-split — the
+all-reduce after the row-split matmul is inserted by XLA from the shardings,
+never hand-written).
+
+Serving contract: apply(params, x) where x is int token ids [batch, seq]
+(arriving as the SeldonMessage float tensor; cast inside — TPU serving keeps
+one input dtype at the edge). Output: [batch, num_classes] probabilities.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from seldon_core_tpu.models.zoo import ModelSpec, register_model
+
+
+# Host-side numpy init (see models/resnet.py): one device_put instead of one
+# compiled rng program per tensor — matters on tunneled/remote devices.
+import numpy as np
+
+
+def _dense_init(rng: np.random.Generator, n_in, n_out):
+    scale = (2.0 / (n_in + n_out)) ** 0.5
+    return {
+        "w": (rng.standard_normal((n_in, n_out)) * scale).astype(np.float32),
+        "b": np.zeros((n_out,), np.float32),
+    }
+
+
+def _ln_init(d):
+    return {"scale": np.ones((d,), np.float32), "bias": np.zeros((d,), np.float32)}
+
+
+def _ln(p, x, eps=1e-6):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _layer_init(rng, hidden, ffn):
+    return {
+        "qkv": _dense_init(rng, hidden, 3 * hidden),
+        "attn_out": _dense_init(rng, hidden, hidden),
+        "ln1": _ln_init(hidden),
+        "mlp_in": _dense_init(rng, hidden, ffn),
+        "mlp_out": _dense_init(rng, ffn, hidden),
+        "ln2": _ln_init(hidden),
+    }
+
+
+def _attention(p, x, num_heads):
+    b, s, d = x.shape
+    head = d // num_heads
+    qkv = x @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, head).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.asarray(head**0.5, x.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+
+
+def _layer_apply(p, x, num_heads):
+    x = _ln(p["ln1"], x + _attention(p, x, num_heads))
+    h = jax.nn.gelu(x @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype))
+    h = h @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return _ln(p["ln2"], x + h)
+
+
+def init_bert(
+    seed: int = 0,
+    vocab: int = 30522,
+    hidden: int = 768,
+    layers: int = 12,
+    ffn: int = 3072,
+    max_len: int = 512,
+    num_classes: int = 2,
+) -> dict:
+    """Head count is hidden//64 by convention (head_dim 64, BERT-base
+    geometry) — see _infer_heads; it is derived from the params at apply
+    time, never stored."""
+    rng = np.random.default_rng(seed)
+    params: dict[str, Any] = {
+        "tok_emb": (rng.standard_normal((vocab, hidden)) * 0.02).astype(np.float32),
+        "pos_emb": (rng.standard_normal((max_len, hidden)) * 0.02).astype(np.float32),
+        "ln_emb": _ln_init(hidden),
+        "layers": [_layer_init(rng, hidden, ffn) for _ in range(layers)],
+        "head": _dense_init(rng, hidden, num_classes),
+    }
+    return params
+
+
+def bert_pspecs(params: dict) -> dict:
+    """Megatron-style TP over the mesh 'model' axis:
+    qkv / mlp_in column-parallel, attn_out / mlp_out row-parallel;
+    embeddings + layernorms + head replicated. XLA inserts the row-parallel
+    all-reduce from these shardings."""
+
+    def layer_spec(_):
+        return {
+            "qkv": {"w": P(None, "model"), "b": P("model")},
+            "attn_out": {"w": P("model", None), "b": P()},
+            "ln1": {"scale": P(), "bias": P()},
+            "mlp_in": {"w": P(None, "model"), "b": P("model")},
+            "mlp_out": {"w": P("model", None), "b": P()},
+            "ln2": {"scale": P(), "bias": P()},
+        }
+
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "ln_emb": {"scale": P(), "bias": P()},
+        "layers": [layer_spec(l) for l in params["layers"]],
+        "head": {"w": P(), "b": P()},
+    }
+
+
+def bert_logits(params: dict, x: jax.Array) -> jax.Array:
+    """x: token ids [batch, seq] (any numeric dtype) -> logits [batch, classes]."""
+    ids = x.astype(jnp.int32)
+    num_heads = _infer_heads(params)
+    compute_dtype = params["tok_emb"].dtype
+    h = params["tok_emb"][ids] + params["pos_emb"][: ids.shape[1]][None, :, :]
+    h = _ln(params["ln_emb"], h.astype(compute_dtype))
+    for lp in params["layers"]:
+        h = _layer_apply(lp, h, num_heads)
+    cls = h[:, 0, :]  # [CLS] pooling
+    return cls @ params["head"]["w"].astype(cls.dtype) + params["head"]["b"].astype(
+        cls.dtype
+    )
+
+
+def apply_bert(params: dict, x: jax.Array) -> jax.Array:
+    """Serving entrypoint: softmax probabilities."""
+    return jax.nn.softmax(bert_logits(params, x), axis=-1)
+
+
+def _infer_heads(params: dict) -> int:
+    hidden = params["layers"][0]["qkv"]["w"].shape[0]
+    return max(1, hidden // 64)
+
+
+@register_model("bert_base")
+def build_bert_base(seed: int = 0, num_classes: int = 2, max_len: int = 512, **_) -> ModelSpec:
+    params = init_bert(seed, num_classes=num_classes, max_len=max_len)
+    return ModelSpec(
+        apply_bert,
+        params,
+        (128,),  # default serving seq length; buckets handle the batch axis
+        tuple(f"class_{i}" for i in range(num_classes)),
+        param_pspecs=bert_pspecs(params),
+    )
+
+
+@register_model("bert_tiny")
+def build_bert_tiny(
+    seed: int = 0,
+    vocab: int = 1024,
+    hidden: int = 128,
+    layers: int = 2,
+    ffn: int = 256,
+    max_len: int = 128,
+    num_classes: int = 2,
+    **_,
+) -> ModelSpec:
+    """Shrunk config for tests / virtual-mesh dryruns."""
+    params = init_bert(
+        seed,
+        vocab=vocab,
+        hidden=hidden,
+        layers=layers,
+        ffn=ffn,
+        max_len=max_len,
+        num_classes=num_classes,
+    )
+    return ModelSpec(
+        apply_bert,
+        params,
+        (16,),
+        tuple(f"class_{i}" for i in range(num_classes)),
+        param_pspecs=bert_pspecs(params),
+    )

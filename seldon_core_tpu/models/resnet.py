@@ -1,0 +1,207 @@
+"""ResNet-50 in pure JAX (NHWC) — the flagship image model of the zoo.
+
+Parity role: the reference's benchmark configs call for "Average Combiner
+ensemble: 3x ResNet50 image models" (BASELINE.json) served as CUDA/TF
+containers behind per-request RPC. Here ResNet50 is a params-pytree + pure
+apply function loaded straight into TPU HBM by ModelRuntime.
+
+TPU design notes:
+- NHWC layout with HWIO kernels — the layout XLA's TPU conv emitter expects;
+  channels land on the 128-wide lane dimension of the MXU.
+- BatchNorm is inference-mode (running stats are parameters). The functional
+  training path (batch stats computed in-graph) lives in
+  seldon_core_tpu/training/steps.py so serving apply stays a single pure fn.
+- All FLOPs are convs/matmuls; elementwise (BN, relu, add) fuses into the
+  preceding conv under XLA. bfloat16 params/activations are one dtype flag
+  away (ModelRuntime dtype policy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.zoo import ModelSpec, register_model
+
+# stage depths for the resnet family
+_DEPTHS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3), 101: (3, 4, 23, 3)}
+_BOTTLENECK = {50: True, 101: True, 18: False, 34: False}
+
+
+# Param init is HOST-side numpy on purpose: jax.random on a tunneled/remote
+# device pays one compile + round-trip per tensor (~50 s for all of ResNet50);
+# numpy init + one device_put is ~1 s. Determinism comes from the seeded rng.
+
+
+def _conv_init(rng: np.random.Generator, h, w, c_in, c_out):
+    fan_in = h * w * c_in
+    scale = (2.0 / fan_in) ** 0.5
+    return (rng.standard_normal((h, w, c_in, c_out)) * scale).astype(np.float32)
+
+
+def _bn_init(c):
+    return {
+        "scale": np.ones((c,), np.float32),
+        "bias": np.zeros((c,), np.float32),
+        "mean": np.zeros((c,), np.float32),
+        "var": np.ones((c,), np.float32),
+    }
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p, eps=1e-5):
+    # inference-mode batchnorm; folds to scale*x+shift, fused by XLA
+    inv = jax.lax.rsqrt(p["var"].astype(x.dtype) + jnp.asarray(eps, x.dtype))
+    scale = p["scale"].astype(x.dtype) * inv
+    shift = p["bias"].astype(x.dtype) - p["mean"].astype(x.dtype) * scale
+    return x * scale + shift
+
+
+def _bottleneck_init(rng, c_in, c_mid, stride):
+    c_out = c_mid * 4
+    p = {
+        "conv1": _conv_init(rng, 1, 1, c_in, c_mid),
+        "bn1": _bn_init(c_mid),
+        "conv2": _conv_init(rng, 3, 3, c_mid, c_mid),
+        "bn2": _bn_init(c_mid),
+        "conv3": _conv_init(rng, 1, 1, c_mid, c_out),
+        "bn3": _bn_init(c_out),
+    }
+    if stride != 1 or c_in != c_out:
+        p["proj"] = _conv_init(rng, 1, 1, c_in, c_out)
+        p["bn_proj"] = _bn_init(c_out)
+    return p
+
+
+def _bottleneck_apply(p, x, stride):
+    y = jax.nn.relu(_bn(_conv(x, p["conv1"]), p["bn1"]))
+    y = jax.nn.relu(_bn(_conv(y, p["conv2"], stride), p["bn2"]))
+    y = _bn(_conv(y, p["conv3"]), p["bn3"])
+    if "proj" in p:
+        x = _bn(_conv(x, p["proj"], stride), p["bn_proj"])
+    return jax.nn.relu(x + y)
+
+
+def _basic_init(rng, c_in, c_out, stride):
+    p = {
+        "conv1": _conv_init(rng, 3, 3, c_in, c_out),
+        "bn1": _bn_init(c_out),
+        "conv2": _conv_init(rng, 3, 3, c_out, c_out),
+        "bn2": _bn_init(c_out),
+    }
+    if stride != 1 or c_in != c_out:
+        p["proj"] = _conv_init(rng, 1, 1, c_in, c_out)
+        p["bn_proj"] = _bn_init(c_out)
+    return p
+
+
+def _basic_apply(p, x, stride):
+    y = jax.nn.relu(_bn(_conv(x, p["conv1"], stride), p["bn1"]))
+    y = _bn(_conv(y, p["conv2"]), p["bn2"])
+    if "proj" in p:
+        x = _bn(_conv(x, p["proj"], stride), p["bn_proj"])
+    return jax.nn.relu(x + y)
+
+
+def init_resnet(
+    seed: int = 0,
+    depth: int = 50,
+    num_classes: int = 1000,
+    width: int = 64,
+    image_size: int = 224,
+) -> dict:
+    rng = np.random.default_rng(seed)
+    depths = _DEPTHS[depth]
+    bottleneck = _BOTTLENECK[depth]
+    expansion = 4 if bottleneck else 1
+    block_init = _bottleneck_init if bottleneck else _basic_init
+
+    params: dict[str, Any] = {
+        "stem": {"conv": _conv_init(rng, 7, 7, 3, width), "bn": _bn_init(width)},
+    }
+    c_in = width
+    for stage, n_blocks in enumerate(depths):
+        c_mid = width * (2**stage)
+        stride = 1 if stage == 0 else 2
+        blocks = []
+        for b in range(n_blocks):
+            blocks.append(block_init(rng, c_in, c_mid, stride if b == 0 else 1))
+            c_in = c_mid * expansion
+        params[f"stage{stage}"] = blocks
+    scale = (1.0 / c_in) ** 0.5
+    params["head"] = {
+        "w": (rng.standard_normal((c_in, num_classes)) * scale).astype(np.float32),
+        "b": np.zeros((num_classes,), np.float32),
+    }
+    return params
+
+
+def resnet_logits(params: dict, x: jax.Array) -> jax.Array:
+    """x: [batch, H, W, 3] float -> logits [batch, num_classes]."""
+    # pytree structure (not traced values) decides the block type, so this
+    # branch is resolved at trace time — no dynamic control flow under jit
+    bottleneck = "conv3" in params["stage0"][0]
+    block_apply = _bottleneck_apply if bottleneck else _basic_apply
+
+    h = _conv(x, params["stem"]["conv"], stride=2)
+    h = jax.nn.relu(_bn(h, params["stem"]["bn"]))
+    h = jax.lax.reduce_window(
+        h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    stage = 0
+    while f"stage{stage}" in params:
+        for b, bp in enumerate(params[f"stage{stage}"]):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            h = block_apply(bp, h, stride)
+        stage += 1
+    h = jnp.mean(h, axis=(1, 2))  # global average pool
+    return h @ params["head"]["w"].astype(h.dtype) + params["head"]["b"].astype(h.dtype)
+
+
+def apply_resnet(params: dict, x: jax.Array) -> jax.Array:
+    """Serving entrypoint: softmax probabilities."""
+    return jax.nn.softmax(resnet_logits(params, x), axis=-1)
+
+
+@register_model("resnet50")
+def build_resnet50(
+    seed: int = 0,
+    num_classes: int = 1000,
+    depth: int = 50,
+    width: int = 64,
+    image_size: int = 224,
+    **_,
+) -> ModelSpec:
+    params = init_resnet(seed, depth=depth, num_classes=num_classes, width=width)
+    return ModelSpec(
+        apply_resnet,
+        params,
+        (image_size, image_size, 3),
+        tuple(f"class_{i}" for i in range(num_classes)),
+        param_pspecs=None,  # resnet serves data-parallel; weights replicate
+    )
+
+
+@register_model("resnet_tiny")
+def build_resnet_tiny(seed: int = 0, num_classes: int = 10, **_) -> ModelSpec:
+    """Small resnet (depth-18, width-16, 32x32) for tests and CI."""
+    params = init_resnet(seed, depth=18, num_classes=num_classes, width=16)
+    return ModelSpec(
+        apply_resnet,
+        params,
+        (32, 32, 3),
+        tuple(f"class_{i}" for i in range(num_classes)),
+    )

@@ -48,6 +48,8 @@ def register_model(name: str):
 
 def get_model(name: str, **kwargs) -> ModelSpec:
     if name not in _REGISTRY:
+        _register_heavy_models()
+    if name not in _REGISTRY:
         raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
     return _REGISTRY[name](**kwargs)
 
@@ -172,9 +174,7 @@ def _parse_zoo_uri(uri: str) -> tuple[str, dict]:
 def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
     if uri.startswith("zoo://"):
         name, kwargs = _parse_zoo_uri(uri)
-        if name in ("resnet50", "bert_base") and name not in _REGISTRY:
-            _register_heavy_models()
-        ms = get_model(name, **kwargs)
+        ms = get_model(name, **kwargs)  # lazy-registers heavy models itself
         return _runtime_from_modelspec(ms, tpu_cfg, mesh)
     if uri.startswith("file://"):
         from seldon_core_tpu.persistence.checkpoint import restore_model

@@ -1,0 +1,59 @@
+"""Model checkpoint save/restore (file:// model_uri scheme).
+
+Parity role: the reference bakes model weights into docker images (e.g.
+examples/models/sklearn_iris/IrisClassifier.sav loaded by IrisClassifier.py)
+— "checkpointing" there is docker push. Here weights are first-class: a
+checkpoint directory holds the params pytree plus enough metadata to rebuild
+the apply function and its TP PartitionSpecs from the zoo registry, so
+restore lands the weights straight onto the device mesh.
+
+Format: <dir>/metadata.json {model, kwargs, param_tree} +
+<dir>/params.msgpack (flax.serialization bytes — framework-stable, no pickle).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+import jax
+
+
+def _to_state_dict(params: Any):
+    from flax import serialization
+
+    return serialization.to_bytes(jax.tree.map(np.asarray, params))
+
+
+def save_model(path: str, model: str, params: Any, kwargs: dict | None = None) -> None:
+    """Persist params + the zoo builder identity that owns the apply fn."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump({"model": model, "kwargs": kwargs or {}}, f)
+    with open(os.path.join(path, "params.msgpack"), "wb") as f:
+        f.write(_to_state_dict(params))
+
+
+def restore_model(path: str):
+    """Rebuild the ModelSpec: zoo builder gives apply_fn/pspecs/shapes, the
+    checkpoint bytes replace the fresh-init params."""
+    from flax import serialization
+
+    from seldon_core_tpu.models import zoo
+
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    name, kwargs = meta["model"], meta.get("kwargs", {})
+    ms = zoo.get_model(name, **kwargs)  # lazy-registers heavy models itself
+    with open(os.path.join(path, "params.msgpack"), "rb") as f:
+        restored = serialization.from_bytes(jax.tree.map(np.asarray, ms.params), f.read())
+    return zoo.ModelSpec(
+        ms.apply_fn,
+        restored,
+        ms.feature_shape,
+        ms.class_names,
+        param_pspecs=ms.param_pspecs,
+    )

@@ -1,0 +1,40 @@
+"""Shared HTTP wire helpers for the engine REST server and the gateway.
+
+One implementation of the reference's two wire quirks so engine and gateway
+can't drift apart: (a) form-encoded ``json=`` payloads
+(wrappers/python/microservice.py:44-52), (b) the status-JSON error body shape
+(microservice.py:29-30 / APIException). Callers pass their tier's
+invalid-JSON ErrorCode (ENGINE_* for the engine, APIFE_* for the gateway).
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+
+
+async def payload_dict(request: web.Request, invalid_code: ErrorCode) -> dict:
+    """JSON body, or form field ``json=`` (reference wire compat)."""
+    ctype = request.content_type or ""
+    if ctype.startswith("application/x-www-form-urlencoded") or ctype.startswith(
+        "multipart/form-data"
+    ):
+        form = await request.post()
+        raw = form.get("json")
+        if raw is None:
+            raise APIException(invalid_code, "missing 'json' form field")
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIException(invalid_code, str(e)) from e
+    try:
+        return await request.json()
+    except Exception as e:  # noqa: BLE001
+        raise APIException(invalid_code, str(e)) from e
+
+
+def error_response(exc: APIException) -> web.Response:
+    return web.json_response(exc.to_status_json(), status=exc.error.http_status)

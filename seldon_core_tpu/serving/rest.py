@@ -27,28 +27,12 @@ from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.serving.service import PredictionService
 
 
+from seldon_core_tpu.serving.http_util import error_response as _error_response
+from seldon_core_tpu.serving.http_util import payload_dict
+
+
 async def _payload_dict(request: web.Request) -> dict:
-    """JSON body, or form field ``json=`` (reference wire compat)."""
-    ctype = request.content_type or ""
-    if ctype.startswith("application/x-www-form-urlencoded") or ctype.startswith(
-        "multipart/form-data"
-    ):
-        form = await request.post()
-        raw = form.get("json")
-        if raw is None:
-            raise APIException(ErrorCode.ENGINE_INVALID_JSON, "missing 'json' form field")
-        try:
-            return json.loads(raw)
-        except json.JSONDecodeError as e:
-            raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
-    try:
-        return await request.json()
-    except Exception as e:  # noqa: BLE001
-        raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
-
-
-def _error_response(exc: APIException) -> web.Response:
-    return web.json_response(exc.to_status_json(), status=exc.error.http_status)
+    return await payload_dict(request, ErrorCode.ENGINE_INVALID_JSON)
 
 
 def build_app(service: PredictionService, state: dict | None = None, metrics=None) -> web.Application:

@@ -1,0 +1,3 @@
+from seldon_core_tpu.training.steps import TrainState, make_sharded_train_step, make_train_step
+
+__all__ = ["TrainState", "make_train_step", "make_sharded_train_step"]

@@ -1,0 +1,156 @@
+"""Sharded training/fine-tuning steps over the device mesh.
+
+Parity role: the reference is serving-only — the closest thing it has to
+learning is the bandit Router feedback loop (engine/.../PredictiveUnitBean.java
+sendFeedback + wrappers/python/router_microservice.py send_feedback). This
+module is the TPU-native generalisation: reward/label feedback can fine-tune
+the *model itself* on-device, not just a router's arm statistics.
+
+Design:
+- a train step is a pure function (state, batch) -> (state, metrics), built
+  once and jitted with explicit in/out shardings over a Mesh;
+- parallelism comes entirely from shardings: batch over "data", params over
+  "model" (Megatron TP via the model's param_pspecs), activations' sequence
+  axis over "seq" (GSPMD sequence parallelism — XLA inserts the attention
+  all-gathers), so one step definition serves dp, tp, sp and combinations;
+- optimizer state inherits the param shardings leaf-for-leaf (an Adam moment
+  is sharded exactly like its parameter — same layout the scaling-book
+  recipe prescribes), so optimizer memory also scales with 1/|model axis|.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LogitsFn = Callable[[Any, jax.Array], jax.Array]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def make_train_step(
+    logits_fn: LogitsFn,
+    optimizer: optax.GradientTransformation,
+):
+    """Unsharded (single-device / auto-sharded) train step."""
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        def loss_fn(p):
+            logits = logits_fn(p, batch["x"])
+            loss = cross_entropy(logits, batch["y"])
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == batch["y"]).astype(jnp.float32)
+            )
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    return step
+
+
+def init_state(params: Any, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+def shard_state(
+    state: TrainState, mesh: Mesh, param_pspecs: Any | None
+) -> tuple[TrainState, Any]:
+    """device_put state with param shardings; opt-state leaves inherit the
+    sharding of the parameter they track (matching pytree prefix)."""
+    if param_pspecs is None:
+        param_pspecs = jax.tree.map(lambda _: P(), state.params)
+
+    def to_sharding(spec):
+        return NamedSharding(mesh, spec if isinstance(spec, P) else P())
+
+    param_sh = jax.tree.map(
+        to_sharding, param_pspecs, is_leaf=lambda x: isinstance(x, P) or x is None
+    )
+
+    # broadcast param shardings onto the (possibly nested) optimizer state:
+    # optax states are pytrees whose leaves either mirror params (mu, nu) or
+    # are scalars (count) — match by tree structure, default replicated.
+    params_treedef = jax.tree.structure(state.params)
+
+    def opt_shardings(opt_state):
+        def map_one(node):
+            try:
+                if jax.tree.structure(node) == params_treedef:
+                    return param_sh
+            except Exception:
+                pass
+            return jax.tree.map(lambda _: NamedSharding(mesh, P()), node)
+
+        # optax wraps states in tuples/namedtuples; walk one level
+        if isinstance(opt_state, tuple) and type(opt_state) is not tuple:
+            return type(opt_state)(*(opt_shardings(s) for s in opt_state))
+        if isinstance(opt_state, tuple):
+            return tuple(opt_shardings(s) for s in opt_state)
+        return map_one(opt_state)
+
+    opt_sh = opt_shardings(state.opt_state)
+    state_sh = TrainState(param_sh, opt_sh, NamedSharding(mesh, P()))
+    sharded = jax.device_put(state, state_sh)
+    return sharded, state_sh
+
+
+def make_sharded_train_step(
+    logits_fn: LogitsFn,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    param_pspecs: Any | None,
+    *,
+    batch_pspec: P = P("data"),
+    label_pspec: P = P("data"),
+    init_params: Any = None,
+):
+    """Build (jitted_step, sharded_state, shardings) for a mesh.
+
+    batch_pspec defaults to data-parallel; pass P("data", "seq") to also
+    shard the sequence axis (sequence parallelism) — XLA derives the
+    attention collectives from the sharding annotations.
+    """
+    state = init_state(init_params, optimizer)
+    sharded_state, state_sh = shard_state(state, mesh, param_pspecs)
+    step = make_train_step(logits_fn, optimizer)
+    batch_sh = {
+        "x": NamedSharding(mesh, batch_pspec),
+        "y": NamedSharding(mesh, label_pspec),
+    }
+    metric_sh = {"loss": NamedSharding(mesh, P()), "accuracy": NamedSharding(mesh, P())}
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, metric_sh),
+        donate_argnums=(0,),
+    )
+    return jitted, sharded_state, batch_sh
