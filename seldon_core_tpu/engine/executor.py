@@ -79,6 +79,11 @@ class GraphExecutor:
         self.root = root
         self._feedback_hook = feedback_metrics_hook
 
+    def units(self):
+        """All runtime units in the graph, pre-order (used by persistence,
+        warmup, readiness aggregation)."""
+        return (n.unit for n in self.root.walk())
+
     # ------------------------------------------------------------- predict
     async def execute(self, msg: SeldonMessage) -> SeldonMessage:
         return await self._get_output(self.root, msg)

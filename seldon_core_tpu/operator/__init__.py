@@ -1,0 +1,25 @@
+from seldon_core_tpu.operator.api import add_operator_routes
+from seldon_core_tpu.operator.reconciler import (
+    DeploymentManager,
+    ReconcileResult,
+    RunningDeployment,
+    watch_directory,
+)
+from seldon_core_tpu.operator.resources import (
+    create_resources,
+    deployment_service,
+    engine_container,
+    predictor_deployment,
+)
+
+__all__ = [
+    "DeploymentManager",
+    "ReconcileResult",
+    "RunningDeployment",
+    "add_operator_routes",
+    "create_resources",
+    "deployment_service",
+    "engine_container",
+    "predictor_deployment",
+    "watch_directory",
+]

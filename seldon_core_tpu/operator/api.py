@@ -1,0 +1,74 @@
+"""Control-plane REST API — the kubectl-equivalent surface for local mode.
+
+Parity: the reference's control plane is the k8s API server itself (you
+kubectl-apply a SeldonDeployment CR and the operator watches). Without k8s,
+this API is the apply/delete/list/status surface, with the same resource
+path shape (group machinelearning.seldon.io, version v1alpha1, plural
+seldondeployments) so tooling written against the CRD path maps 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from seldon_core_tpu.operator.reconciler import DeploymentManager
+
+BASE = "/apis/machinelearning.seldon.io/v1alpha1/seldondeployments"
+
+
+def add_operator_routes(app: web.Application, manager: DeploymentManager) -> None:
+    async def apply_dep(request: web.Request) -> web.Response:
+        import asyncio
+
+        try:
+            obj = await request.json()
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"invalid JSON: {e}"}, status=400)
+        # reconcile builds executors (weight load + XLA compile): run in a
+        # thread so in-flight predictions on other deployments don't stall
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, manager.apply, obj
+        )
+        status = 400 if result.action == "failed" else 200
+        return web.json_response(
+            {"name": result.name, "action": result.action, "message": result.message},
+            status=status,
+        )
+
+    async def list_deps(request: web.Request) -> web.Response:
+        items = []
+        for name in manager.names():
+            st = manager.status(name)
+            items.append(
+                {
+                    "name": name,
+                    "status": st.model_dump(mode="json") if st else None,
+                }
+            )
+        return web.json_response({"items": items})
+
+    async def get_dep(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        running = manager.get(name)
+        if running is None:
+            return web.json_response({"error": "not found"}, status=404)
+        st = manager.status(name)
+        body = running.dep.to_dict()
+        if st is not None:
+            body["status"] = st.model_dump(mode="json")
+        return web.json_response(body)
+
+    async def delete_dep(request: web.Request) -> web.Response:
+        result = manager.delete(request.match_info["name"])
+        status = 404 if result.message == "not running" else 200
+        return web.json_response(
+            {"name": result.name, "action": result.action}, status=status
+        )
+
+    app.router.add_post(BASE, apply_dep)
+    app.router.add_put(BASE, apply_dep)
+    app.router.add_get(BASE, list_deps)
+    app.router.add_get(BASE + "/{name}", get_dep)
+    app.router.add_delete(BASE + "/{name}", delete_dep)

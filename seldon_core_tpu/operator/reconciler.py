@@ -1,0 +1,325 @@
+"""Deployment reconciler — the control plane's core loop.
+
+Parity (C12): reference cluster-manager SeldonDeploymentControllerImpl.java —
+createOrReplaceSeldonDeployment (:188-234): FAILED-state latch (:190-194,
+a CR that failed validation is not retried until its spec changes), cache
+diff (:197), defaulting (:201), validate (:202), create resources (:204),
+idempotent create-or-update + orphan removal (:64-137), status writeback
+(DeploymentWatcher.java:45-110 -> SeldonDeploymentStatusUpdateImpl.java:49).
+
+TPU inversion: the reference turns a CR into k8s Deployments running engine
+pods. Here a CR becomes a *RunningDeployment in this process* — executors
+compiled onto the device mesh, registered with the gateway — because one TPU
+host serves many deployments (SURVEY §7 multi-tenancy). The k8s-manifest
+half (for real GKE TPU pools) is the pure builder in operator/resources.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.graph.defaulting import default_deployment
+from seldon_core_tpu.graph.spec import (
+    DeploymentStatus,
+    PredictorStatus,
+    SeldonDeployment,
+)
+from seldon_core_tpu.graph.validation import ValidationError, validate_deployment
+
+log = logging.getLogger(__name__)
+
+
+def _spec_hash(dep: SeldonDeployment) -> str:
+    return hashlib.sha256(
+        json.dumps(dep.spec.model_dump(mode="json"), sort_keys=True).encode()
+    ).hexdigest()
+
+
+class RunningDeployment:
+    """One live deployment: a PredictionService per predictor, traffic split
+    by predictor replica weights (the reference gets the same effect from one
+    k8s Service load-balancing over per-predictor Deployments scaled by
+    ``replicas``)."""
+
+    def __init__(
+        self,
+        dep: SeldonDeployment,
+        services: dict[str, object],
+        seed: int = 1337,
+        persister=None,
+    ):
+        self.dep = dep
+        self.services = services  # predictor name -> PredictionService
+        self.persister = persister
+        weights = [(p.name, max(0, p.replicas)) for p in dep.spec.predictors]
+        if sum(w for _, w in weights) == 0:
+            weights = [(n, 1) for n, _ in weights]
+        total = sum(w for _, w in weights)
+        self._weights = [(n, w / total) for n, w in weights]
+        self._rng = random.Random(seed)
+
+    def _pick(self) -> object:
+        r = self._rng.random()
+        acc = 0.0
+        for name, w in self._weights:
+            acc += w
+            if r <= acc:
+                return self.services[name]
+        return self.services[self._weights[-1][0]]
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._pick().predict(msg)
+
+    async def send_feedback(self, fb: Feedback) -> SeldonMessage:
+        # feedback follows the routing recorded in the response meta, which
+        # is predictor-internal; at this level any predictor that saw the
+        # puid works — the reference just hits the Service. Use the first
+        # predictor unless routing tags say otherwise.
+        return await next(iter(self.services.values())).send_feedback(fb)
+
+    def close(self) -> None:
+        if self.persister is not None:
+            self.persister.stop()  # final state flush (C19 parity)
+
+
+@dataclass
+class ReconcileResult:
+    name: str
+    action: str  # created | updated | unchanged | failed | deleted
+    message: str = ""
+
+
+class DeploymentManager:
+    """Reconciles SeldonDeployment resources into running state.
+
+    Wire-up: pass ``store`` (gateway DeploymentStore) and ``backend``
+    (gateway InProcessBackend) so applied deployments become routable through
+    the gateway, exactly how the reference operator's Deployments become
+    routable once the api-frontend watch sees the CR.
+    """
+
+    def __init__(
+        self,
+        store=None,
+        backend=None,
+        metrics=None,
+        service_factory: Optional[Callable] = None,
+        state_store_url: str = "",
+        state_period_s: float = 60.0,
+    ):
+        self.store = store
+        self.backend = backend
+        self.metrics = metrics
+        self._service_factory = service_factory or self._default_service_factory
+        self.state_store_url = state_store_url
+        self.state_period_s = state_period_s
+        self._cache: dict[str, str] = {}  # name -> spec hash
+        self._failed: dict[str, str] = {}  # FAILED latch: name -> failed spec hash
+        self._running: dict[str, RunningDeployment] = {}
+        self._status: dict[str, DeploymentStatus] = {}
+
+    # ------------------------------------------------------------ factories
+    @staticmethod
+    def _default_service_factory(dep: SeldonDeployment, predictor):
+        from seldon_core_tpu.engine import build_executor
+        from seldon_core_tpu.serving.service import PredictionService
+
+        executor = build_executor(predictor)
+        return PredictionService(
+            executor,
+            deployment_name=dep.spec.name or dep.metadata.name,
+            predictor_name=predictor.name,
+        )
+
+    def _make_persister(self, name: str, services: dict):
+        """Restore-on-boot + periodic snapshot for stateful units (C19)."""
+        if not self.state_store_url:
+            return None
+        from seldon_core_tpu.persistence.state import StatePersister, make_state_store
+
+        store = make_state_store(self.state_store_url)
+        if store is None:
+            return None
+        persister = StatePersister(store, name, period_s=self.state_period_s)
+        for svc in services.values():
+            executor = getattr(svc, "executor", None)
+            if executor is not None:
+                persister.attach(executor.units())
+        try:
+            persister.start()
+        except RuntimeError:
+            pass  # no running event loop (sync context): caller may start later
+        return persister
+
+    # ------------------------------------------------------------ reconcile
+    def apply(self, dep: SeldonDeployment | dict) -> ReconcileResult:
+        if isinstance(dep, dict):
+            name_hint = str(
+                (dep.get("metadata") or {}).get("name")
+                or (dep.get("spec") or {}).get("name")
+                or ""
+            )
+            try:
+                dep = SeldonDeployment.from_dict(dep)
+            except Exception as e:  # noqa: BLE001 - structurally invalid CR
+                log.warning("deployment %s failed schema validation: %s", name_hint, e)
+                if name_hint:
+                    self._status[name_hint] = DeploymentStatus(
+                        state="FAILED", description=str(e)
+                    )
+                return ReconcileResult(name_hint, "failed", str(e))
+        name = dep.metadata.name or dep.spec.name
+        if not name:
+            return ReconcileResult("", "failed", "deployment has no name")
+        h = _spec_hash(dep)
+
+        # FAILED latch (reference :190-194): don't re-reconcile a spec that
+        # already failed; a changed spec clears the latch
+        if self._failed.get(name) == h:
+            return ReconcileResult(name, "failed", "previously failed; spec unchanged")
+        if self._cache.get(name) == h:
+            return ReconcileResult(name, "unchanged")
+
+        try:
+            dep = default_deployment(dep)
+            validate_deployment(dep)
+            services = {
+                p.name: self._service_factory(dep, p) for p in dep.spec.predictors
+            }
+        except Exception as e:  # noqa: BLE001 - ValidationError and any
+            # unit/model build failure latch the deployment FAILED
+            self._failed[name] = h
+            self._status[name] = DeploymentStatus(state="FAILED", description=str(e))
+            log.warning("deployment %s failed reconcile: %s", name, e)
+            return ReconcileResult(name, "failed", str(e))
+
+        existed = name in self._running
+        old = self._running.pop(name, None)
+        if old is not None:
+            # flush the old deployment's learned state BEFORE the new
+            # persister restores from the store, or updates lose everything
+            # since the last periodic snapshot
+            old.close()
+        persister = self._make_persister(name, services)
+        self._running[name] = RunningDeployment(dep, services, persister=persister)
+        self._failed.pop(name, None)
+        self._cache[name] = h
+
+        # register with the gateway (store: oauth_key routing; backend: the
+        # in-process engine)
+        if self.store is not None:
+            spec = dep.spec.model_copy(update={"name": dep.spec.name or name})
+            self.store.deployment_added(spec)
+        if self.backend is not None:
+            self.backend.register(dep.spec.name or name, self._running[name])
+
+        # status writeback (reference DeploymentWatcher -> StatusUpdate)
+        self._status[name] = DeploymentStatus(
+            state="Available",
+            predictorStatus=[
+                PredictorStatus(
+                    name=f"{name}-{p.name}",
+                    replicas=p.replicas,
+                    replicasAvailable=p.replicas,
+                )
+                for p in dep.spec.predictors
+            ],
+        )
+        return ReconcileResult(name, "updated" if existed else "created")
+
+    def delete(self, name: str) -> ReconcileResult:
+        running = self._running.pop(name, None)
+        self._cache.pop(name, None)
+        self._failed.pop(name, None)
+        self._status.pop(name, None)
+        if running is None:
+            return ReconcileResult(name, "unchanged", "not running")
+        if self.backend is not None:
+            self.backend.unregister(running.dep.spec.name or name)
+        if self.store is not None:
+            self.store.deployment_removed(running.dep.spec.name or name)
+        running.close()
+        return ReconcileResult(name, "deleted")
+
+    # ------------------------------------------------------------ queries
+    def status(self, name: str) -> DeploymentStatus | None:
+        return self._status.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._running)
+
+    def get(self, name: str) -> RunningDeployment | None:
+        return self._running.get(name)
+
+
+class DirectoryWatcher:
+    """Local control loop: reconcile from a directory of CR JSON files —
+    drop/update/remove a file == kubectl apply/delete. The 5-second cadence
+    and delete-by-disappearance semantics mirror the reference watch
+    (SeldonDeploymentWatcher.java:151-163). Only deployments this watcher
+    applied are deleted when their file disappears (API-applied deployments
+    are untouched)."""
+
+    def __init__(self, manager: DeploymentManager, directory: str):
+        self.manager = manager
+        self.directory = directory
+        self._seen: dict[str, str] = {}  # file name -> deployment name
+
+    def scan_once(self) -> None:
+        import os
+
+        try:
+            files = {
+                f: os.path.join(self.directory, f)
+                for f in sorted(os.listdir(self.directory))
+                if f.endswith(".json")
+            }
+        except FileNotFoundError:
+            files = {}
+        current: dict[str, str] = {}
+        for fname, path in files.items():
+            try:
+                with open(path) as fh:
+                    obj = json.load(fh)
+                result = self.manager.apply(obj)
+                if result.name:
+                    current[fname] = result.name
+            except (json.JSONDecodeError, OSError) as e:
+                log.warning("skipping %s: %s", path, e)
+                # torn read / mid-write file: keep the previous mapping so a
+                # healthy running deployment isn't deleted on a transient
+                # parse failure — only true disappearance deletes
+                if fname in self._seen:
+                    current[fname] = self._seen[fname]
+        for fname, name in self._seen.items():
+            if fname not in current:
+                self.manager.delete(name)
+        self._seen = current
+
+    async def run(
+        self, interval_s: float = 5.0, stop_event: asyncio.Event | None = None
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            # model build / XLA compile inside apply() must not block the
+            # serving event loop (the platform shares one loop)
+            await loop.run_in_executor(None, self.scan_once)
+            if stop_event is not None and stop_event.is_set():
+                return
+            await asyncio.sleep(interval_s)
+
+
+async def watch_directory(
+    manager: DeploymentManager,
+    directory: str,
+    interval_s: float = 5.0,
+    stop_event: asyncio.Event | None = None,
+) -> None:
+    await DirectoryWatcher(manager, directory).run(interval_s, stop_event)

@@ -1,0 +1,152 @@
+"""Stateful-unit persistence: periodic snapshot + restore-on-boot.
+
+Parity (C19): reference wrappers/python/persistence.py — a thread cPickles
+the live user object to Redis key
+``persistence_{SELDON_DEPLOYMENT_ID}_{PREDICTIVE_UNIT_ID}`` every 60 s
+(:26-48) and restores it at boot (:17-24), keeping learned router/bandit
+state across restarts. Same contract here with pluggable stores (file dir
+for single-host, redis when importable) and the same key naming. The
+persisted payload is the unit's __getstate__ (e.g. EpsilonGreedyRouter's arm
+counts/values — host-side state, never jitted).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import time
+from typing import Any, Iterable
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_S = 60.0  # reference persistence.py default
+
+
+def state_key(deployment_id: str, unit_id: str) -> str:
+    return f"persistence_{deployment_id}_{unit_id}"  # reference key format
+
+
+class FileStateStore:
+    """One pickle file per key under a directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        return os.path.join(self.directory, safe + ".pkl")
+
+    def save(self, key: str, payload: bytes) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self._path(key))
+
+    def load(self, key: str) -> bytes | None:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+class RedisStateStore:
+    def __init__(self, url: str):
+        import redis  # gated: not in the base image
+
+        self._r = redis.Redis.from_url(url)
+
+    def save(self, key: str, payload: bytes) -> None:
+        self._r.set(key, payload)
+
+    def load(self, key: str) -> bytes | None:
+        return self._r.get(key)
+
+
+def make_state_store(url: str):
+    if not url:
+        return None
+    if url.startswith("file://"):
+        return FileStateStore(url[len("file://") :])
+    if url.startswith("redis://"):
+        try:
+            return RedisStateStore(url)
+        except ImportError:
+            log.warning("redis not importable; state persistence disabled")
+            return None
+    raise ValueError(f"unknown state store url: {url}")
+
+
+class StatePersister:
+    """Snapshots stateful units on a period; restores them at attach time.
+
+    A unit is stateful iff it defines __getstate__/__setstate__ (the builtin
+    EpsilonGreedyRouter does; pure units and TPU model runtimes do not —
+    model *weights* are checkpoints, not state, exactly as in the reference
+    where weights live in the image and only learned router state persists).
+    """
+
+    def __init__(self, store, deployment_id: str, period_s: float = DEFAULT_PERIOD_S):
+        self.store = store
+        self.deployment_id = deployment_id
+        self.period_s = period_s
+        self._units: dict[str, Any] = {}
+        self._task: asyncio.Task | None = None
+
+    @staticmethod
+    def is_stateful(unit: Any) -> bool:
+        # object defines a default __getstate__ (3.11+); a unit is stateful
+        # only if its own class hierarchy defines BOTH dunder explicitly
+        mro = [c for c in type(unit).__mro__ if c is not object]
+        return any("__getstate__" in c.__dict__ for c in mro) and any(
+            "__setstate__" in c.__dict__ for c in mro
+        )
+
+    def attach(self, units: Iterable[Any]) -> int:
+        """Register stateful units and restore any saved state. Returns the
+        number restored."""
+        restored = 0
+        for unit in units:
+            if not self.is_stateful(unit):
+                continue
+            name = getattr(unit, "name", None) or type(unit).__name__
+            self._units[name] = unit
+            payload = self.store.load(state_key(self.deployment_id, name))
+            if payload is not None:
+                try:
+                    unit.__setstate__(pickle.loads(payload))
+                    restored += 1
+                except Exception as e:  # noqa: BLE001 - stale/corrupt state
+                    log.warning("could not restore state for %s: %s", name, e)
+        return restored
+
+    def persist_now(self) -> int:
+        saved = 0
+        for name, unit in self._units.items():
+            try:
+                payload = pickle.dumps(unit.__getstate__())
+                self.store.save(state_key(self.deployment_id, name), payload)
+                saved += 1
+            except Exception as e:  # noqa: BLE001
+                log.warning("could not persist state for %s: %s", name, e)
+        return saved
+
+    async def run(self, stop_event: asyncio.Event | None = None) -> None:
+        while True:
+            await asyncio.sleep(self.period_s)
+            self.persist_now()
+            if stop_event is not None and stop_event.is_set():
+                return
+
+    def start(self) -> None:
+        if self._units and self._task is None:
+            self._task = asyncio.get_event_loop().create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.persist_now()  # final flush, like the reference's atexit intent

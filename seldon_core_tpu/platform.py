@@ -1,0 +1,149 @@
+"""All-in-one platform process: control plane + gateway + engines.
+
+The reference splits this across three Java services and k8s (cluster-manager
+operator, api-frontend gateway, one engine pod per predictor). On a TPU host
+the economical shape is ONE process: deployments are applied through the
+control API (or a watched directory of CR files), reconciled into in-process
+executors with weights in HBM, and served through the OAuth2 gateway — no
+per-request network hop anywhere in the graph.
+
+CLI:
+    python -m seldon_core_tpu.platform --port 8080 --grpc-port 5000 \
+        [--watch-dir deployments/] [--apply dep.json ...] \
+        [--audit-sink file://audit/] [--token-store file://tokens.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from aiohttp import web
+
+from seldon_core_tpu.gateway import (
+    DeploymentStore,
+    Gateway,
+    InProcessBackend,
+    OAuthProvider,
+    build_gateway_app,
+    make_audit_sink,
+    make_token_store,
+)
+from seldon_core_tpu.metrics import get_metrics
+from seldon_core_tpu.operator import (
+    DeploymentManager,
+    add_operator_routes,
+    watch_directory,
+)
+
+log = logging.getLogger(__name__)
+
+
+class Platform:
+    def __init__(
+        self,
+        *,
+        token_store_url: str = "",
+        audit_sink_url: str = "",
+        metrics_enabled: bool = True,
+    ):
+        self.metrics = get_metrics(metrics_enabled)
+        self.oauth = OAuthProvider(token_store=make_token_store(token_store_url))
+        self.store = DeploymentStore(oauth=self.oauth)
+        self.backend = InProcessBackend()
+        self.gateway = Gateway(
+            store=self.store,
+            oauth=self.oauth,
+            backend=self.backend,
+            audit=make_audit_sink(audit_sink_url),
+            metrics=self.metrics,
+        )
+        self.manager = DeploymentManager(store=self.store, backend=self.backend)
+
+    def build_app(self) -> web.Application:
+        app = build_gateway_app(self.gateway)
+        add_operator_routes(app, self.manager)
+        return app
+
+    async def serve(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        grpc_port: int | None = 5000,
+        watch_dir: str | None = None,
+        watch_interval_s: float = 5.0,
+    ):
+        runner = web.AppRunner(self.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        log.info("platform REST on %s:%s", host, port)
+
+        grpc_server = None
+        if grpc_port:
+            from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+
+            grpc_server = await start_gateway_grpc(self.gateway, host=host, port=grpc_port)
+            log.info("platform gRPC on %s:%s", host, grpc_port)
+
+        watch_task = None
+        if watch_dir:
+            watch_task = asyncio.create_task(
+                watch_directory(self.manager, watch_dir, watch_interval_s)
+            )
+        return runner, grpc_server, watch_task
+
+
+async def _amain(args) -> None:
+    platform = Platform(
+        token_store_url=args.token_store,
+        audit_sink_url=args.audit_sink,
+    )
+    for path in args.apply or []:
+        import json as _json
+
+        with open(path) as f:
+            result = platform.manager.apply(_json.load(f))
+        log.info("apply %s: %s %s", path, result.action, result.message)
+
+    runner, grpc_server, watch_task = await platform.serve(
+        host=args.host,
+        port=args.port,
+        grpc_port=args.grpc_port,
+        watch_dir=args.watch_dir,
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+    if watch_task is not None:
+        watch_task.cancel()
+    if grpc_server is not None:
+        await grpc_server.stop(5)
+    await runner.cleanup()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--grpc-port", type=int, default=5000)
+    parser.add_argument("--watch-dir", default=None)
+    parser.add_argument("--apply", nargs="*", help="CR JSON files to apply at boot")
+    parser.add_argument("--token-store", default="", help="'' | file://p | redis://h")
+    parser.add_argument("--audit-sink", default="", help="'' | mem:// | file://d | kafka://h")
+    parser.add_argument("--no-grpc", action="store_true")
+    args = parser.parse_args()
+    if args.no_grpc:
+        args.grpc_port = None
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Microservice CLI: serve one duck-typed user model class standalone.
+
+Parity (C18): reference wrappers/python/microservice.py —
+    python microservice.py <UserClass> <REST|GRPC> --service-type MODEL \
+        [--persistence]
+- imports module <UserClass> and instantiates class <UserClass> from it,
+  passing typed constructor args parsed from the PREDICTIVE_UNIT_PARAMETERS
+  env JSON (microservice.py:119-148);
+- serves the unit-type API (MODEL/ROUTER/TRANSFORMER/OUTPUT_TRANSFORMER/
+  COMBINER) over REST on PREDICTIVE_UNIT_SERVICE_PORT (default 5000 like
+  the reference's default) and/or gRPC;
+- --persistence snapshots the live user object periodically and restores it
+  at boot (C19; reference --persistence flag, microservice.py:141,150-152).
+
+This makes the framework a drop-in replacement for a reference model
+container: the engine (ours or the reference's) can call this process over
+REST/gRPC with the same wire format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import json
+import logging
+import os
+import sys
+
+from seldon_core_tpu.graph.spec import (
+    PredictiveUnit,
+    PredictiveUnitType,
+    PredictorSpec,
+)
+
+log = logging.getLogger(__name__)
+
+SERVICE_TYPES = {
+    "MODEL": PredictiveUnitType.MODEL,
+    "ROUTER": PredictiveUnitType.ROUTER,
+    "TRANSFORMER": PredictiveUnitType.TRANSFORMER,
+    "OUTPUT_TRANSFORMER": PredictiveUnitType.OUTPUT_TRANSFORMER,
+    "COMBINER": PredictiveUnitType.COMBINER,
+}
+
+
+def parse_parameters(raw: str | None) -> dict:
+    """PREDICTIVE_UNIT_PARAMETERS: [{"name":..,"value":..,"type":..}] with
+    typed coercion (reference microservice.py:119-133)."""
+    if not raw:
+        return {}
+    out = {}
+    for p in json.loads(raw):
+        value, ptype = p.get("value"), p.get("type", "STRING")
+        if ptype == "INT":
+            value = int(value)
+        elif ptype in ("FLOAT", "DOUBLE"):
+            value = float(value)
+        elif ptype == "BOOL":
+            value = str(value).lower() in ("1", "true", "yes")
+        out[p["name"]] = value
+    return out
+
+
+def load_user_object(name: str, model_dir: str | None = None, parameters: dict | None = None):
+    """Import module ``name``, instantiate class ``name`` with the typed
+    parameters as kwargs — the reference contract (interface_name == module
+    name == class name, microservice.py:136-140)."""
+    if model_dir:
+        sys.path.insert(0, model_dir)
+    module = importlib.import_module(name)
+    cls = getattr(module, name)
+    return cls(**(parameters or {}))
+
+
+def build_single_unit_predictor(name: str, service_type: str) -> PredictorSpec:
+    # children stay empty even for routers/combiners: a standalone
+    # microservice exposes the unit's own API; the graph around it lives in
+    # whichever engine calls this process
+    unit_type = SERVICE_TYPES[service_type]
+    return PredictorSpec(
+        name=name,
+        graph=PredictiveUnit.model_validate(
+            {"name": name, "type": unit_type.value, "children": []}
+        ),
+    )
+
+
+async def serve_microservice(
+    user_object,
+    name: str,
+    service_type: str = "MODEL",
+    *,
+    host: str = "0.0.0.0",
+    http_port: int | None = None,
+    grpc_port: int | None = None,
+    enable_rest: bool = True,
+    persistence_url: str = "",
+    persistence_period_s: float = 60.0,
+):
+    """Boot REST (+ optional gRPC) for one user object. Returns (runner,
+    grpc_server, persister)."""
+    from aiohttp import web
+
+    from seldon_core_tpu.engine import build_executor
+    from seldon_core_tpu.engine.units import PythonClassUnit
+    from seldon_core_tpu.metrics import get_metrics
+    from seldon_core_tpu.serving.rest import build_app
+    from seldon_core_tpu.serving.service import PredictionService
+
+    predictor = build_single_unit_predictor(name, service_type)
+    executor = build_executor(
+        predictor, context={"units": {name: user_object}}
+    )
+    service = PredictionService(executor, deployment_name=name, metrics=get_metrics(True))
+
+    persister = None
+    if persistence_url:
+        from seldon_core_tpu.persistence.state import StatePersister, make_state_store
+
+        store = make_state_store(persistence_url)
+        if store is not None:
+            deployment_id = os.environ.get("SELDON_DEPLOYMENT_ID", name)
+            unit_id = os.environ.get("PREDICTIVE_UNIT_ID", name)
+
+            class _UserStateAdapter:
+                """User objects persist whole (reference pickles the object);
+                adapt to the persister's getstate/setstate contract."""
+
+                def __init__(self):
+                    self.name = unit_id
+
+                def __getstate__(self):
+                    return user_object.__dict__
+
+                def __setstate__(self, state):
+                    user_object.__dict__.update(state)
+
+            persister = StatePersister(store, deployment_id, period_s=persistence_period_s)
+            restored = persister.attach([_UserStateAdapter()])
+            if restored:
+                log.info("restored persisted state for %s", unit_id)
+            persister.start()
+
+    runner = None
+    if enable_rest:
+        runner = web.AppRunner(build_app(service))
+        await runner.setup()
+        port = http_port or int(
+            os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "5000")
+        )
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        log.info("microservice %s (%s) REST on %s:%s", name, service_type, host, port)
+
+    grpc_server = None
+    if grpc_port:
+        from seldon_core_tpu.serving.grpc_server import start_grpc_server
+
+        grpc_server = await start_grpc_server(service, host=host, port=grpc_port)
+        log.info("microservice gRPC on %s:%s", host, grpc_port)
+    return runner, grpc_server, persister
+
+
+async def _amain(args) -> None:
+    import signal
+
+    parameters = parse_parameters(os.environ.get("PREDICTIVE_UNIT_PARAMETERS"))
+    user_object = load_user_object(args.interface_name, args.model_dir, parameters)
+    persistence_url = ""
+    if args.persistence:
+        persistence_url = os.environ.get(
+            "PERSISTENCE_STORE", "file://./.seldon_state"
+        )
+    runner, grpc_server, persister = await serve_microservice(
+        user_object,
+        args.interface_name,
+        args.service_type,
+        http_port=args.port,
+        grpc_port=args.grpc_port if args.api in ("GRPC", "BOTH") else None,
+        enable_rest=args.api in ("REST", "BOTH"),
+        persistence_url=persistence_url,
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    if persister is not None:
+        persister.stop()
+    if grpc_server is not None:
+        await grpc_server.stop(5)
+    if runner is not None:
+        await runner.cleanup()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("interface_name", help="module and class name of the user model")
+    p.add_argument("api", nargs="?", default="REST", choices=["REST", "GRPC", "BOTH"])
+    p.add_argument("--service-type", default="MODEL", choices=sorted(SERVICE_TYPES))
+    p.add_argument("--model-dir", default=".")
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--grpc-port", type=int, default=5001)
+    p.add_argument("--persistence", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,214 @@
+"""Async load tester: throughput + latency percentiles + bandit feedback.
+
+Parity (C24): reference util/loadtester/scripts/predict_rest_locust.py — a
+locust swarm that fetches an OAuth token (:107-121), sends random ndarray
+predictions (:123-139), and closes the bandit loop with reward feedback
+whose probability depends on the taken route (:83-103 — route-dependent
+reward probabilities are how an A/B or epsilon-greedy router is exercised
+under load). This asyncio implementation replaces the locust dependency and
+reports p50/90/95/99 like the reference's Grafana dashboard percentiles.
+
+CLI:
+    python -m seldon_core_tpu.tools.loadtest http://HOST:PORT \
+        [--users 10] [--duration 10] [--features 4] [--batch 1] \
+        [--oauth-key K --oauth-secret S] [--feedback-route-rewards 0.4,0.9] \
+        [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LoadStats:
+    latencies_s: list[float] = field(default_factory=list)
+    errors: int = 0
+    feedback_sent: int = 0
+    started: float = 0.0
+    finished: float = 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self.latencies_s:
+            return 0.0
+        xs = sorted(self.latencies_s)
+        idx = min(len(xs) - 1, int(q / 100.0 * len(xs)))
+        return xs[idx]
+
+    def summary(self) -> dict:
+        n = len(self.latencies_s)
+        wall = max(self.finished - self.started, 1e-9)
+        return {
+            "requests": n,
+            "errors": self.errors,
+            "feedback_sent": self.feedback_sent,
+            "duration_s": round(wall, 3),
+            "requests_per_sec": round(n / wall, 2),
+            "p50_ms": round(self.percentile(50) * 1e3, 2),
+            "p90_ms": round(self.percentile(90) * 1e3, 2),
+            "p95_ms": round(self.percentile(95) * 1e3, 2),
+            "p99_ms": round(self.percentile(99) * 1e3, 2),
+        }
+
+
+async def _fetch_token(session, base: str, key: str, secret: str) -> str:
+    async with session.post(
+        f"{base}/oauth/token",
+        data={"grant_type": "client_credentials", "client_id": key, "client_secret": secret},
+    ) as resp:
+        body = await resp.json()
+        return body["access_token"]
+
+
+async def _user(
+    session,
+    base: str,
+    stats: LoadStats,
+    stop_at: float,
+    *,
+    features: int,
+    batch: int,
+    headers: dict,
+    route_rewards: list[float],
+    rng: random.Random,
+    wait_range: tuple[float, float] | None,
+) -> None:
+    while time.perf_counter() < stop_at:
+        payload = {
+            "data": {
+                "ndarray": [
+                    [rng.random() for _ in range(features)] for _ in range(batch)
+                ]
+            }
+        }
+        t0 = time.perf_counter()
+        try:
+            async with session.post(
+                f"{base}/api/v0.1/predictions", json=payload, headers=headers
+            ) as resp:
+                body = await resp.json()
+                ok = resp.status == 200
+        except Exception:  # noqa: BLE001
+            ok = False
+            body = {}
+        dt = time.perf_counter() - t0
+        if ok:
+            stats.latencies_s.append(dt)
+        else:
+            stats.errors += 1
+
+        # bandit loop: reward probability depends on the route taken
+        # (reference predict_rest_locust.py:83-103)
+        routing = (body.get("meta") or {}).get("routing") or {}
+        if ok and route_rewards and routing:
+            branch = next(iter(routing.values()))
+            p = route_rewards[branch % len(route_rewards)]
+            reward = 1.0 if rng.random() < p else 0.0
+            fb = {"response": {"meta": body.get("meta", {})}, "reward": reward}
+            try:
+                async with session.post(
+                    f"{base}/api/v0.1/feedback", json=fb, headers=headers
+                ) as resp:
+                    if resp.status == 200:
+                        stats.feedback_sent += 1
+            except Exception:  # noqa: BLE001
+                pass
+        if wait_range:
+            await asyncio.sleep(rng.uniform(*wait_range))
+
+
+async def run_load(
+    base: str,
+    *,
+    users: int = 10,
+    duration_s: float = 10.0,
+    features: int = 4,
+    batch: int = 1,
+    oauth_key: str = "",
+    oauth_secret: str = "",
+    route_rewards: list[float] | None = None,
+    locust_pacing: bool = False,
+    seed: int = 0,
+) -> LoadStats:
+    import aiohttp
+
+    stats = LoadStats()
+    # reference locust pacing: min_wait 900 / max_wait 1100 ms (~1 req/s/user);
+    # default here is closed-loop max throughput
+    wait_range = (0.9, 1.1) if locust_pacing else None
+    async with aiohttp.ClientSession(
+        connector=aiohttp.TCPConnector(limit=max(users, 150))
+    ) as session:
+        headers = {}
+        if oauth_key:
+            token = await _fetch_token(session, base, oauth_key, oauth_secret)
+            headers["Authorization"] = f"Bearer {token}"
+        stats.started = time.perf_counter()
+        stop_at = stats.started + duration_s
+        await asyncio.gather(
+            *(
+                _user(
+                    session,
+                    base,
+                    stats,
+                    stop_at,
+                    features=features,
+                    batch=batch,
+                    headers=headers,
+                    route_rewards=route_rewards or [],
+                    rng=random.Random(seed + i),
+                    wait_range=wait_range,
+                )
+                for i in range(users)
+            )
+        )
+        stats.finished = time.perf_counter()
+    return stats
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("base", help="http://HOST:PORT")
+    p.add_argument("--users", type=int, default=10)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--features", type=int, default=4)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--oauth-key", default="")
+    p.add_argument("--oauth-secret", default="")
+    p.add_argument(
+        "--feedback-route-rewards",
+        default="",
+        help="comma list of per-route reward probabilities, e.g. 0.4,0.9",
+    )
+    p.add_argument("--locust-pacing", action="store_true", help="~1 req/s/user")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args()
+    rewards = (
+        [float(x) for x in args.feedback_route_rewards.split(",")]
+        if args.feedback_route_rewards
+        else None
+    )
+    stats = asyncio.run(
+        run_load(
+            args.base.rstrip("/"),
+            users=args.users,
+            duration_s=args.duration,
+            features=args.features,
+            batch=args.batch,
+            oauth_key=args.oauth_key,
+            oauth_secret=args.oauth_secret,
+            route_rewards=rewards,
+            locust_pacing=args.locust_pacing,
+        )
+    )
+    out = stats.summary()
+    print(json.dumps(out) if args.as_json else out)
+
+
+if __name__ == "__main__":
+    main()
