@@ -33,10 +33,19 @@ class DeploymentStore:
             fn(event, spec)
 
     def deployment_added(self, spec: DeploymentSpec) -> None:
+        stale_key = ""
         with self._lock:
+            old = self._by_name.get(spec.name)
+            if old is not None and old.oauth_key and old.oauth_key != spec.oauth_key:
+                # credential rotation: the retired key must stop routing AND
+                # stop minting tokens
+                self._by_key.pop(old.oauth_key, None)
+                stale_key = old.oauth_key
             if spec.oauth_key:
                 self._by_key[spec.oauth_key] = spec
             self._by_name[spec.name] = spec
+        if self.oauth is not None and stale_key:
+            self.oauth.remove_client(stale_key)
         # register the deployment's OAuth client, exactly
         # DeploymentStore.java:63-71
         if self.oauth is not None and spec.oauth_key:

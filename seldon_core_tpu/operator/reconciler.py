@@ -124,6 +124,12 @@ class DeploymentManager:
         self._failed: dict[str, str] = {}  # FAILED latch: name -> failed spec hash
         self._running: dict[str, RunningDeployment] = {}
         self._status: dict[str, DeploymentStatus] = {}
+        # apply/delete run on executor threads (control API + dir watcher);
+        # one lock serializes reconciliation — a concurrent double-apply
+        # would double-compile and leak the losing RunningDeployment
+        import threading
+
+        self._reconcile_lock = threading.RLock()
 
     # ------------------------------------------------------------ factories
     @staticmethod
@@ -148,14 +154,17 @@ class DeploymentManager:
         if store is None:
             return None
         persister = StatePersister(store, name, period_s=self.state_period_s)
-        for svc in services.values():
+        single = len(services) == 1
+        for pred_name, svc in services.items():
             executor = getattr(svc, "executor", None)
             if executor is not None:
-                persister.attach(executor.units())
-        try:
-            persister.start()
-        except RuntimeError:
-            pass  # no running event loop (sync context): caller may start later
+                # namespace by predictor so same-named units in different
+                # predictors (canary/A-B) don't collide on one store key;
+                # the single-predictor key stays reference-shaped
+                persister.attach(
+                    executor.units(), prefix="" if single else pred_name
+                )
+        persister.start()
         return persister
 
     # ------------------------------------------------------------ reconcile
@@ -178,6 +187,10 @@ class DeploymentManager:
         name = dep.metadata.name or dep.spec.name
         if not name:
             return ReconcileResult("", "failed", "deployment has no name")
+        with self._reconcile_lock:
+            return self._apply_locked(dep, name)
+
+    def _apply_locked(self, dep: SeldonDeployment, name: str) -> ReconcileResult:
         h = _spec_hash(dep)
 
         # FAILED latch (reference :190-194): don't re-reconcile a spec that
@@ -185,6 +198,10 @@ class DeploymentManager:
         if self._failed.get(name) == h:
             return ReconcileResult(name, "failed", "previously failed; spec unchanged")
         if self._cache.get(name) == h:
+            # the running version is (still) the desired one; repair status
+            # in case a rejected update wrote a failure description
+            if name in self._running:
+                self._write_available_status(name, self._running[name].dep)
             return ReconcileResult(name, "unchanged")
 
         try:
@@ -196,7 +213,17 @@ class DeploymentManager:
         except Exception as e:  # noqa: BLE001 - ValidationError and any
             # unit/model build failure latch the deployment FAILED
             self._failed[name] = h
-            self._status[name] = DeploymentStatus(state="FAILED", description=str(e))
+            if name in self._running:
+                # the previous version keeps serving: state stays Available,
+                # the rejected update is surfaced in the description
+                st = self._write_available_status(name, self._running[name].dep)
+                self._status[name] = st.model_copy(
+                    update={"description": f"update rejected: {e}"}
+                )
+            else:
+                self._status[name] = DeploymentStatus(
+                    state="FAILED", description=str(e)
+                )
             log.warning("deployment %s failed reconcile: %s", name, e)
             return ReconcileResult(name, "failed", str(e))
 
@@ -221,7 +248,11 @@ class DeploymentManager:
             self.backend.register(dep.spec.name or name, self._running[name])
 
         # status writeback (reference DeploymentWatcher -> StatusUpdate)
-        self._status[name] = DeploymentStatus(
+        self._write_available_status(name, dep)
+        return ReconcileResult(name, "updated" if existed else "created")
+
+    def _write_available_status(self, name: str, dep: SeldonDeployment) -> DeploymentStatus:
+        st = DeploymentStatus(
             state="Available",
             predictorStatus=[
                 PredictorStatus(
@@ -232,9 +263,14 @@ class DeploymentManager:
                 for p in dep.spec.predictors
             ],
         )
-        return ReconcileResult(name, "updated" if existed else "created")
+        self._status[name] = st
+        return st
 
     def delete(self, name: str) -> ReconcileResult:
+        with self._reconcile_lock:
+            return self._delete_locked(name)
+
+    def _delete_locked(self, name: str) -> ReconcileResult:
         running = self._running.pop(name, None)
         self._cache.pop(name, None)
         self._failed.pop(name, None)

@@ -114,9 +114,10 @@ def predictor_deployment(dep: SeldonDeployment, pred: PredictorSpec) -> dict:
     n_devices = _mesh_devices(pred)
     container = engine_container(dep, pred)
     pod_spec: dict = {"containers": [container], "terminationGracePeriodSeconds": 20}
-    if n_devices > 1:
-        # GKE TPU scheduling: node selectors pick the slice shape; the
-        # container requests the chips (rounded up to a schedulable slice)
+    if pred.tpu.mesh:
+        # an explicit mesh — even {"data": 1} — means TPU execution: node
+        # selectors pick the slice shape, the container requests the chips
+        # (rounded up to a schedulable slice)
         chips, topology = _tpu_slice(n_devices)
         pod_spec["nodeSelector"] = {
             "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",

@@ -1,0 +1,10 @@
+from seldon_core_tpu.ops.attention import blockwise_attention, naive_attention
+from seldon_core_tpu.ops.pallas_flash import flash_attention
+from seldon_core_tpu.ops.ring_attention import ring_attention
+
+__all__ = [
+    "blockwise_attention",
+    "flash_attention",
+    "naive_attention",
+    "ring_attention",
+]

@@ -1,0 +1,128 @@
+"""Pallas flash-attention forward kernel for TPU.
+
+The hot op of the BERT/long-context serving path, hand-tiled for the MXU:
+grid over (batch*heads, Q blocks); the kernel streams KV blocks through VMEM
+with a fori_loop carrying online-softmax stats in f32 scratch. On non-TPU
+backends (tests run on the 8-device CPU mesh) the same kernel runs in
+interpreter mode, so numerics are covered everywhere while the compiled path
+exercises Mosaic only on real hardware.
+
+Block sizes respect the f32 (8,128) / bf16 (16,128) tiling minima; head_dim
+is padded to the 128 lane width by the wrapper when needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend only exists on TPU-enabled builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # noqa: BLE001
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int, scale: float):
+    """One (batch*head, q-block) program: stream KV in blocks of block_k."""
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    block_q, d = q.shape
+    n_kv = sk // block_k
+
+    def body(i, carry):
+        m_acc, l_acc, o_acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # MXU
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = alpha * l_acc + jnp.sum(p, axis=-1)
+        o_new = alpha[:, None] * o_acc + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, o_new
+
+    init = (
+        jnp.full((block_q,), NEG_INF, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+        jnp.zeros((block_q, d), jnp.float32),
+    )
+    m, l, o = jax.lax.fori_loop(0, n_kv, body, init)
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """q,k,v: [batch, heads, seq, head_dim] -> same shape. Non-causal (the
+    serving encoder path); causal long-context goes through ring_attention.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu" or not _HAS_PLTPU
+
+    # pad head_dim to the 128 lane width for the compiled path: zero-padded
+    # K dims add 0 to every dot product and padded V dims are sliced off, so
+    # numerics are unchanged (scale uses the original d)
+    orig_d = d
+    if not interpret and d % 128:
+        pad_d = 128 - d % 128
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pad_d)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad_d)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad_d)))
+        d = q.shape[-1]
+
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # padded Q rows are harmless (sliced off after); padded K would need
+    # in-kernel masking, so the KV axis must already be a block multiple —
+    # the serving batcher buckets seq to these sizes anyway
+    pad_q = (-sq) % block_q
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if sk % block_k:
+        raise ValueError(
+            f"kv seq {sk} must be a multiple of block_k {block_k} "
+            "(pad inputs before calling)"
+        )
+
+    qf = q.reshape(b * h, q.shape[2], d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    n_q = qf.shape[1] // block_q
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, sk=sk, scale=1.0 / (orig_d**0.5)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(b, h, -1, d)
+    if pad_q:
+        out = out[:, :, :sq, :]
+    if d != orig_d:
+        out = out[..., :orig_d]
+    return out

@@ -12,7 +12,7 @@ counts/values — host-side state, never jitted).
 
 from __future__ import annotations
 
-import asyncio
+
 import logging
 import os
 import pickle
@@ -94,7 +94,8 @@ class StatePersister:
         self.deployment_id = deployment_id
         self.period_s = period_s
         self._units: dict[str, Any] = {}
-        self._task: asyncio.Task | None = None
+        self._thread: "threading.Thread | None" = None
+        self._stop = None  # threading.Event once started
 
     @staticmethod
     def is_stateful(unit: Any) -> bool:
@@ -105,14 +106,17 @@ class StatePersister:
             "__setstate__" in c.__dict__ for c in mro
         )
 
-    def attach(self, units: Iterable[Any]) -> int:
+    def attach(self, units: Iterable[Any], prefix: str = "") -> int:
         """Register stateful units and restore any saved state. Returns the
-        number restored."""
+        number restored. ``prefix`` namespaces the unit id (predictor name)
+        so same-named units in different predictors don't share a slot."""
         restored = 0
         for unit in units:
             if not self.is_stateful(unit):
                 continue
             name = getattr(unit, "name", None) or type(unit).__name__
+            if prefix:
+                name = f"{prefix}.{name}"
             self._units[name] = unit
             payload = self.store.load(state_key(self.deployment_id, name))
             if payload is not None:
@@ -134,19 +138,28 @@ class StatePersister:
                 log.warning("could not persist state for %s: %s", name, e)
         return saved
 
-    async def run(self, stop_event: asyncio.Event | None = None) -> None:
-        while True:
-            await asyncio.sleep(self.period_s)
-            self.persist_now()
-            if stop_event is not None and stop_event.is_set():
-                return
-
     def start(self) -> None:
-        if self._units and self._task is None:
-            self._task = asyncio.get_event_loop().create_task(self.run())
+        """Begin periodic snapshots on a daemon thread — like the reference's
+        PersistenceThread (persistence.py:43-48); a thread (not an asyncio
+        task) so it works no matter which thread reconciles the deployment."""
+        import threading
+
+        if not self._units or self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.period_s):
+                self.persist_now()
+
+        self._thread = threading.Thread(
+            target=loop, name=f"persist-{self.deployment_id}", daemon=True
+        )
+        self._thread.start()
 
     def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            self._task = None
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=2)
+            self._thread = None
         self.persist_now()  # final flush, like the reference's atexit intent

@@ -65,8 +65,13 @@ def generate_batch(contract: dict, batch_size: int, rng: np.random.Generator):
         names.extend([base] if repeat == 1 else [f"{base}_{i}" for i in range(repeat)])
         cols.append(col)
     if any(c.dtype == object for c in cols):
+        # mixed string/numeric rows: coerce numpy scalars to JSON-safe
+        # Python types (np.float64 is not json-serializable)
+        def py(v):
+            return v.item() if isinstance(v, np.generic) else v
+
         rows = [
-            [c[i, j] for c in cols for j in range(c.shape[1])]
+            [py(c[i, j]) for c in cols for j in range(c.shape[1])]
             for i in range(batch_size)
         ]
         return names, rows

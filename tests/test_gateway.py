@@ -172,3 +172,21 @@ async def test_grpc_gateway_auth_and_predict():
             assert resp.status.code == 0 or not resp.HasField("status") or resp.status.status == 0
     finally:
         await server.stop(None)
+
+
+def test_oauth_key_rotation_revokes_old_key():
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    store.deployment_added(DeploymentSpec(name="d", oauth_key="old", oauth_secret="s"))
+    token = oauth.issue_token("old", "s")["access_token"]
+    assert store.by_principal("old") is not None
+
+    # rotate credentials
+    store.deployment_added(DeploymentSpec(name="d", oauth_key="new", oauth_secret="s2"))
+    assert store.by_principal("old") is None  # retired key no longer routes
+    assert oauth.principal(token) is None  # old tokens revoked
+    with pytest.raises(PermissionError):
+        oauth.issue_token("old", "s")  # old client cannot mint tokens
+    assert store.by_principal("new") is not None

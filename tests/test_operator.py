@@ -257,3 +257,38 @@ def test_tpu_slice_rounds_up_to_valid_topology():
     assert _tpu_slice(100) == (128, "8x16")
     with pytest.raises(ValueError):
         _tpu_slice(500)
+
+
+def test_failed_update_keeps_running_version_available():
+    m = DeploymentManager()
+    m.apply(_cr())
+    assert m.status("mydep").state == "Available"
+
+    bad = _cr()
+    bad["spec"]["predictors"][0]["graph"] = {
+        "name": "r",
+        "type": "ROUTER",
+        "implementation": "RANDOM_ABTEST",
+    }
+    r = m.apply(bad)
+    assert r.action == "failed"
+    st = m.status("mydep")
+    # v1 still serves: state stays Available, rejection surfaced in description
+    assert st.state == "Available"
+    assert "update rejected" in st.description
+    assert m.get("mydep") is not None
+
+    # re-applying the running spec clears the failure description
+    assert m.apply(_cr()).action == "unchanged"
+    assert m.status("mydep").description == ""
+
+
+def test_single_chip_mesh_still_requests_tpu():
+    cr = _cr()
+    cr["spec"]["predictors"][0]["tpu"] = {"mesh": {"data": 1}}
+    dep = SeldonDeployment.from_dict(cr)
+    deploy = create_resources(dep)[0]
+    pod = deploy["spec"]["template"]["spec"]
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "1x1"
+    container = pod["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "1"

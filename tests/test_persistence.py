@@ -168,3 +168,59 @@ async def test_manager_wires_persistence(tmp_path):
         message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}})
     )
     assert out.meta.routing["eg"] == 1  # learned preference survived
+
+
+def test_persister_start_works_from_worker_thread(tmp_path):
+    """The reconciler runs on executor threads (no event loop): start() must
+    still begin periodic snapshots."""
+    import concurrent.futures
+    import time as _time
+
+    from seldon_core_tpu.engine import build_executor
+    from seldon_core_tpu.persistence.state import FileStateStore, StatePersister
+
+    store = FileStateStore(str(tmp_path))
+    ex = build_executor(_bandit_predictor())
+
+    def start_in_thread():
+        p = StatePersister(store, "tdep", period_s=0.05)
+        p.attach(ex.units())
+        p.start()
+        return p
+
+    with concurrent.futures.ThreadPoolExecutor() as pool:
+        persister = pool.submit(start_in_thread).result()
+    try:
+        _time.sleep(0.3)
+        assert store.load("persistence_tdep_eg") is not None  # snapshot ran
+    finally:
+        persister.stop()
+
+
+def test_multi_predictor_units_get_separate_keys(tmp_path):
+    from seldon_core_tpu.operator import DeploymentManager
+
+    graph = {
+        "name": "eg",
+        "type": "ROUTER",
+        "implementation": "EPSILON_GREEDY",
+        "children": [
+            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    cr = {
+        "metadata": {"name": "abdep"},
+        "spec": {
+            "name": "abdep",
+            "predictors": [
+                {"name": "main", "graph": graph},
+                {"name": "canary", "graph": graph},
+            ],
+        },
+    }
+    m = DeploymentManager(state_store_url=f"file://{tmp_path}", state_period_s=999)
+    m.apply(cr)
+    running = m.get("abdep")
+    assert set(running.persister._units) == {"main.eg", "canary.eg"}
+    m.delete("abdep")

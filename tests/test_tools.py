@@ -280,3 +280,17 @@ async def test_microservice_grpc_only_has_no_rest(tmp_path):
             assert reply.data.ndarray.values[0].list_value.values[0].number_value == 3.0
     finally:
         await grpc_server.stop(None)
+
+
+def test_contract_mixed_categorical_and_continuous_is_json_safe():
+    contract = {
+        "features": [
+            {"name": "color", "dtype": "STRING", "ftype": "categorical",
+             "values": ["red", "green"]},
+            {"name": "x", "dtype": "FLOAT", "ftype": "continuous", "range": [0, 1]},
+        ]
+    }
+    rng = np.random.default_rng(0)
+    names, rows = generate_batch(contract, 4, rng)
+    json.dumps({"data": {"names": names, "ndarray": rows}})  # must not raise
+    assert isinstance(rows[0][1], float)
