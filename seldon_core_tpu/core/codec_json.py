@@ -55,8 +55,17 @@ def _decode_default_data(obj: Mapping[str, Any], dtype: Any) -> DefaultData:
     if "ndarray" in obj:
         try:
             array = np.asarray(obj["ndarray"], dtype=dtype)
-        except (ValueError, TypeError) as e:
-            raise APIException(ErrorCode.ENGINE_INVALID_JSON, f"bad ndarray: {e}") from e
+        except (ValueError, TypeError):
+            # non-numeric payloads (e.g. string categoricals) keep numpy's
+            # inferred dtype — the reference microservice does the same
+            # (rest_datadef_to_array: plain np.array); numeric-only models
+            # fail later with a clear shape/dtype error
+            try:
+                array = np.asarray(obj["ndarray"])
+            except (ValueError, TypeError) as e:
+                raise APIException(
+                    ErrorCode.ENGINE_INVALID_JSON, f"bad ndarray: {e}"
+                ) from e
         return DefaultData(names=names, array=array, kind=DataKind.NDARRAY)
     raise APIException(ErrorCode.ENGINE_INVALID_JSON, "data must contain tensor or ndarray")
 
@@ -111,6 +120,47 @@ def message_from_json(text: str | bytes, dtype: Any = DEFAULT_DTYPE) -> SeldonMe
     except json.JSONDecodeError as e:
         raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
     return message_from_dict(obj, dtype)
+
+
+def message_from_json_fast(raw: bytes, dtype: Any = DEFAULT_DTYPE) -> SeldonMessage:
+    """Hot-path decode: the ndarray number matrix (the bulk of the body)
+    parses in C (native/fastcodec) and the small envelope in Python json;
+    any deviation falls back to the pure-Python path, which stays the
+    semantic source of truth."""
+    if dtype is DEFAULT_DTYPE:
+        from seldon_core_tpu import native
+
+        span = native.find_ndarray_span(raw)
+        if span is not None:
+            s, e = span
+            array = native.parse_ndarray(raw[s:e])
+            if array is not None:
+                try:
+                    obj = json.loads(raw[:s] + b"null" + raw[e:])
+                except json.JSONDecodeError as exc:
+                    raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(exc)) from exc
+                data = obj.get("data")
+                # the spliced null must be THIS message's data.ndarray (not a
+                # nested request's), and tensor must not also be present (the
+                # oracle prefers tensor when both exist); otherwise fall back
+                if (
+                    isinstance(data, Mapping)
+                    and data.get("ndarray", "") is None
+                    and "tensor" not in data
+                ):
+                    msg = message_from_dict(
+                        {k: v for k, v in obj.items() if k != "data"}, dtype
+                    )
+                    return SeldonMessage(
+                        data=DefaultData(
+                            names=tuple(data.get("names") or ()),
+                            array=array,
+                            kind=DataKind.NDARRAY,
+                        ),
+                        meta=msg.meta,
+                        status=msg.status,
+                    )
+    return message_from_json(raw, dtype)
 
 
 def feedback_from_dict(obj: Mapping[str, Any], dtype: Any = DEFAULT_DTYPE) -> Feedback:
@@ -181,6 +231,45 @@ def message_to_dict(msg: SeldonMessage) -> dict[str, Any]:
 
 def message_to_json(msg: SeldonMessage) -> str:
     return json.dumps(message_to_dict(msg))
+
+
+def message_to_json_fast(msg: SeldonMessage) -> bytes:
+    """Hot-path encode: the response ndarray serializes in C, the envelope
+    in Python json with a placeholder splice. Falls back to message_to_json
+    for anything but a float 2D ndarray payload."""
+    arr = np.asarray(msg.data.array) if msg.data is not None else None
+    if (
+        arr is not None
+        and msg.data.kind == DataKind.NDARRAY
+        and arr.ndim == 2
+        and arr.dtype == np.float32  # f64 would silently lose precision in C
+    ):
+        from seldon_core_tpu import native
+
+        body = native.encode_ndarray(np.asarray(msg.data.array))
+        if body is not None:
+            # build the envelope WITHOUT ever calling arr.tolist() (that is
+            # the cost this path exists to avoid)
+            obj: dict[str, Any] = {"meta": _encode_meta(msg.meta)}
+            if msg.status is not None:
+                obj["status"] = {
+                    "code": msg.status.code,
+                    "info": msg.status.info,
+                    "reason": msg.status.reason,
+                    "status": msg.status.status.name,
+                }
+            data: dict[str, Any] = {}
+            if msg.data.names:
+                data["names"] = list(msg.data.names)
+            data["ndarray"] = "\x00NDARRAY\x00"
+            obj["data"] = data
+            text = json.dumps(obj).encode()
+            # data is inserted LAST, so its placeholder is the rightmost
+            # occurrence — a client-forged copy of the sentinel in meta tags
+            # or names can never be the one spliced
+            head, sep, tail = text.rpartition(b'"\\u0000NDARRAY\\u0000"')
+            return head + body + tail
+    return message_to_json(msg).encode()
 
 
 def feedback_to_dict(fb: Feedback) -> dict[str, Any]:

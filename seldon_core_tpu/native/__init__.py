@@ -1,0 +1,193 @@
+"""Native wire codec: lazy g++ build + ctypes binding, Python fallback.
+
+The C++ side (fastcodec.cpp) parses/serializes the ndarray number matrix —
+the dominant CPU cost of a REST prediction once the graph runs in-process.
+This module compiles it on first use (cached .so next to the source,
+rebuilt when the .cpp is newer) and exposes:
+
+    find_ndarray_span(raw: bytes) -> (start, end) | None
+    parse_ndarray(raw: bytes) -> np.ndarray (float32, 1D or 2D) | None
+    encode_ndarray(arr) -> bytes | None
+    pad_rows(arr, bucket) -> np.ndarray
+
+Every entry returns None (or falls back to numpy) when the library is
+unavailable or the payload isn't a rectangular numeric array — callers keep
+the pure-Python path as the semantic source of truth.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastcodec.cpp")
+_SO = os.path.join(_HERE, "_fastcodec.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> str | None:
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+        res = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            capture_output=True,
+            timeout=120,
+        )
+        if res.returncode != 0:
+            log.warning("fastcodec build failed: %s", res.stderr.decode()[:500])
+            return None
+        os.replace(_SO + ".tmp", _SO)
+        return _SO
+    except Exception as e:  # noqa: BLE001 - no compiler / RO filesystem
+        log.warning("fastcodec build unavailable: %s", e)
+        return None
+
+
+def get_lib():
+    """The loaded library or None. Thread-safe, builds at most once."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.ndarray_find.restype = ctypes.c_int
+        lib.ndarray_find.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.ndarray_probe.restype = ctypes.c_int
+        lib.ndarray_probe.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ndarray_parse.restype = ctypes.c_int
+        lib.ndarray_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+        ]
+        lib.ndarray_encode.restype = ctypes.c_long
+        lib.ndarray_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_char_p,
+            ctypes.c_long,
+        ]
+        lib.pad_rows_f32.restype = ctypes.c_int
+        lib.pad_rows_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def find_ndarray_span(raw: bytes) -> tuple[int, int] | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    start, end = ctypes.c_long(), ctypes.c_long()
+    rc = lib.ndarray_find(raw, len(raw), ctypes.byref(start), ctypes.byref(end))
+    if rc != 0:
+        return None
+    return start.value, end.value
+
+
+def parse_ndarray(raw: bytes) -> np.ndarray | None:
+    """Parse a JSON 1D/2D numeric array (bytes) to float32. None on any
+    deviation (ragged, strings, nesting >2) — caller falls back to json."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows, cols = ctypes.c_long(), ctypes.c_long()
+    is2d = ctypes.c_int()
+    rc = lib.ndarray_probe(
+        raw, len(raw), ctypes.byref(rows), ctypes.byref(cols), ctypes.byref(is2d)
+    )
+    if rc != 0:
+        return None
+    r, c = rows.value, cols.value
+    out = np.empty(r * c, dtype=np.float32)
+    if r * c:
+        rc = lib.ndarray_parse(
+            raw, len(raw), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), r, c
+        )
+        if rc != 0:
+            return None
+    return out.reshape(r, c) if is2d.value else out.reshape(c)
+
+
+def encode_ndarray(arr: np.ndarray) -> bytes | None:
+    """float32 2D matrix -> JSON bytes ('[[...],[...]]'). None if lib absent
+    or array not 2D float-convertible."""
+    lib = get_lib()
+    if lib is None or arr.ndim != 2:
+        return None
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    cap = a.size * 32 + a.shape[0] * 2 + 16
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.ndarray_encode(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        a.shape[0],
+        a.shape[1],
+        buf,
+        cap,
+    )
+    if n < 0:
+        return None
+    return buf.raw[:n]
+
+
+def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad the batch axis to ``bucket`` (C memcpy when available)."""
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    n, feat = a.shape[0], int(np.prod(a.shape[1:], initial=1))
+    lib = get_lib()
+    if lib is None:
+        out = np.zeros((bucket, *a.shape[1:]), dtype=np.float32)
+        out[:n] = a
+        return out
+    out = np.empty((bucket, *a.shape[1:]), dtype=np.float32)
+    rc = lib.pad_rows_f32(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        feat,
+        bucket,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        raise ValueError(f"pad_rows: batch {n} exceeds bucket {bucket}")
+    return out

@@ -1,0 +1,312 @@
+// fastcodec: native wire-codec hot path for the serving runtime.
+//
+// Role in the framework: the reference's "native tier" is its Java engine —
+// every request body is JSON-parsed and re-serialized on the hot path
+// (engine/.../InternalPredictionService.java form-encoded json= hops). Our
+// engine keeps the graph in-process, so the remaining CPU cost of a REST
+// prediction is exactly (a) parsing the request's number matrix and
+// (b) serializing the response's number matrix. Both are implemented here in
+// C++ and bound via ctypes (native/__init__.py), with a pure-Python fallback
+// when no compiler is available.
+//
+// Contract (all functions return 0 on success, negative error codes below):
+//   ndarray_find   locate the value span of the first "ndarray" key
+//   ndarray_probe  shape-check a rectangular 2D numeric JSON array
+//   ndarray_parse  parse it into a caller-allocated float32 buffer
+//   ndarray_encode serialize a float32 matrix to JSON into a caller buffer
+//   pad_rows_f32   copy rows into a zero-padded bucket buffer
+//
+// Build: g++ -O3 -shared -fPIC (native/__init__.py compiles lazily and
+// caches the .so next to this file).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+enum {
+  OK = 0,
+  ERR_NOT_FOUND = -1,   // no "ndarray" key
+  ERR_SYNTAX = -2,      // malformed JSON in the array span
+  ERR_NOT_RECT = -3,    // ragged rows
+  ERR_NOT_NUMERIC = -4, // strings/objects inside the array
+  ERR_TOO_DEEP = -5,    // not a 1D/2D array
+  ERR_BOUNDS = -6,      // caller buffer too small
+};
+
+static const char *skip_ws(const char *p, const char *end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+    ++p;
+  return p;
+}
+
+// Find the first occurrence of the JSON key "ndarray" (outside of string
+// values we can cheaply ignore: we scan for the quoted key then a colon) and
+// return the [start, end) byte span of its value.
+int ndarray_find(const char *buf, long len, long *start, long *end) {
+  static const char key[] = "\"ndarray\"";
+  const char *bufend = buf + len;
+  const char *p = buf;
+  bool in_str = false;
+  while (p < bufend) {
+    if (in_str) {
+      if (*p == '\\' && p + 1 < bufend)
+        ++p;
+      else if (*p == '"')
+        in_str = false;
+      ++p;
+      continue;
+    }
+    if (*p == '"') {
+      if ((long)(bufend - p) >= (long)sizeof(key) - 1 &&
+          memcmp(p, key, sizeof(key) - 1) == 0) {
+        const char *q = skip_ws(p + sizeof(key) - 1, bufend);
+        if (q < bufend && *q == ':') {
+          q = skip_ws(q + 1, bufend);
+          if (q >= bufend || *q != '[')
+            return ERR_SYNTAX;
+          // scan to the matching bracket (strings inside are rejected later
+          // by probe, but skip them correctly here)
+          long depth = 0;
+          bool s = false;
+          const char *r = q;
+          while (r < bufend) {
+            char c = *r;
+            if (s) {
+              if (c == '\\' && r + 1 < bufend)
+                ++r;
+              else if (c == '"')
+                s = false;
+            } else if (c == '"') {
+              s = true;
+            } else if (c == '[') {
+              ++depth;
+            } else if (c == ']') {
+              if (--depth == 0) {
+                *start = (long)(q - buf);
+                *end = (long)(r - buf) + 1;
+                return OK;
+              }
+            }
+            ++r;
+          }
+          return ERR_SYNTAX;
+        }
+      }
+      in_str = true;
+      ++p;
+      continue;
+    }
+    ++p;
+  }
+  return ERR_NOT_FOUND;
+}
+
+// Parse one number with strtod; returns nullptr on failure.
+static const char *parse_num(const char *p, const char *end, double *out) {
+  char *q;
+  *out = strtod(p, &q);
+  if (q == p || q > end)
+    return nullptr;
+  return q;
+}
+
+// Structural scan of one number: strict JSON number grammar
+// ('-'? digits ('.' digits)? ([eE][+-]? digits)?) so the fast path accepts
+// exactly what the Python oracle accepts — no strtod needed here, the parse
+// pass re-reads the value. Returns nullptr on grammar violation.
+static const char *scan_num(const char *p, const char *end) {
+  const char *q = p;
+  if (q < end && *q == '-')
+    ++q;
+  const char *int_start = q;
+  while (q < end && *q >= '0' && *q <= '9')
+    ++q;
+  if (q == int_start)
+    return nullptr; // no integer part ('.5', '+1', '-' alone all invalid)
+  if (q < end && *q == '.') {
+    ++q;
+    const char *frac_start = q;
+    while (q < end && *q >= '0' && *q <= '9')
+      ++q;
+    if (q == frac_start)
+      return nullptr; // trailing dot ('5.')
+  }
+  if (q < end && (*q == 'e' || *q == 'E')) {
+    ++q;
+    if (q < end && (*q == '+' || *q == '-'))
+      ++q;
+    const char *exp_start = q;
+    while (q < end && *q >= '0' && *q <= '9')
+      ++q;
+    if (q == exp_start)
+      return nullptr;
+  }
+  return q;
+}
+
+// Probe a 1D or 2D numeric array: shape check + syntax check in one pass.
+// 1D arrays report rows=1, cols=n, is2d=0.
+int ndarray_probe(const char *buf, long len, long *rows, long *cols,
+                  int *is2d) {
+  const char *end = buf + len;
+  const char *p = skip_ws(buf, end);
+  if (p >= end || *p != '[')
+    return ERR_SYNTAX;
+  p = skip_ws(p + 1, end);
+  if (p < end && *p == ']') { // empty array
+    *rows = 0;
+    *cols = 0;
+    *is2d = 0;
+    return OK;
+  }
+  if (p < end && *p == '[') {
+    // 2D
+    long r = 0, c_first = -1;
+    while (true) {
+      if (p >= end || *p != '[')
+        return ERR_SYNTAX;
+      p = skip_ws(p + 1, end);
+      long c = 0;
+      if (p < end && *p != ']') {
+        while (true) {
+          const char *q = scan_num(p, end);
+          if (!q)
+            return ERR_NOT_NUMERIC;
+          ++c;
+          p = skip_ws(q, end);
+          if (p < end && *p == ',') {
+            p = skip_ws(p + 1, end);
+            continue;
+          }
+          break;
+        }
+      }
+      if (p >= end || *p != ']')
+        return ERR_SYNTAX;
+      ++r;
+      if (c_first < 0)
+        c_first = c;
+      else if (c != c_first)
+        return ERR_NOT_RECT;
+      p = skip_ws(p + 1, end);
+      if (p < end && *p == ',') {
+        p = skip_ws(p + 1, end);
+        if (p < end && *p == '[')
+          continue;
+        return ERR_TOO_DEEP; // mixed 2D and scalar elements
+      }
+      break;
+    }
+    if (p >= end || *p != ']')
+      return ERR_SYNTAX;
+    *rows = r;
+    *cols = c_first < 0 ? 0 : c_first;
+    *is2d = 1;
+    return OK;
+  }
+  // 1D
+  long c = 0;
+  while (true) {
+    const char *q = scan_num(p, end);
+    if (!q)
+      return ERR_NOT_NUMERIC;
+    ++c;
+    p = skip_ws(q, end);
+    if (p < end && *p == ',') {
+      p = skip_ws(p + 1, end);
+      continue;
+    }
+    break;
+  }
+  if (p >= end || *p != ']')
+    return ERR_SYNTAX;
+  *rows = 1;
+  *cols = c;
+  *is2d = 0;
+  return OK;
+}
+
+// Fill a pre-allocated float32 buffer of rows*cols (caller ran probe).
+int ndarray_parse(const char *buf, long len, float *out, long rows,
+                  long cols) {
+  const char *end = buf + len;
+  const char *p = buf;
+  long need = rows * cols, got = 0;
+  while (p < end && got < need) {
+    char ch = *p;
+    if ((ch >= '0' && ch <= '9') || ch == '-') {
+      // re-validate the token grammar (probe ran scan_num over the same
+      // text, but defense-in-depth keeps the two passes agreeing), then
+      // convert with strtod and require a structural terminator so
+      // '1-2' / '1.2.3' can never silently parse as one number
+      const char *tok_end = scan_num(p, end);
+      if (!tok_end)
+        return ERR_NOT_NUMERIC;
+      double v;
+      const char *q = parse_num(p, end, &v);
+      if (!q || q != tok_end)
+        return ERR_NOT_NUMERIC;
+      if (q < end) {
+        char t = *q;
+        if (!(t == ',' || t == ']' || t == ' ' || t == '\t' || t == '\n' ||
+              t == '\r'))
+          return ERR_NOT_NUMERIC;
+      }
+      out[got++] = (float)v;
+      p = q;
+    } else {
+      ++p;
+    }
+  }
+  return got == need ? OK : ERR_SYNTAX;
+}
+
+// Serialize a float32 matrix as a 2D JSON array into dst (cap bytes incl.
+// NUL). Returns bytes written (excl. NUL) or a negative error.
+long ndarray_encode(const float *src, long rows, long cols, char *dst,
+                    long cap) {
+  long w = 0;
+#define PUT(c)                                                                 \
+  do {                                                                         \
+    if (w + 1 >= cap)                                                          \
+      return ERR_BOUNDS;                                                       \
+    dst[w++] = (c);                                                            \
+  } while (0)
+  PUT('[');
+  for (long r = 0; r < rows; ++r) {
+    if (r)
+      PUT(',');
+    PUT('[');
+    for (long c = 0; c < cols; ++c) {
+      if (c)
+        PUT(',');
+      if (w + 32 >= cap)
+        return ERR_BOUNDS;
+      // %.9g round-trips float32 exactly
+      int n = snprintf(dst + w, (size_t)(cap - w), "%.9g",
+                       (double)src[r * cols + c]);
+      if (n < 0)
+        return ERR_SYNTAX;
+      w += n;
+    }
+    PUT(']');
+  }
+  PUT(']');
+  dst[w] = '\0';
+  return w;
+#undef PUT
+}
+
+// Copy n rows of feat floats into a bucket x feat buffer, zeroing the tail.
+int pad_rows_f32(const float *src, long n, long feat, long bucket,
+                 float *dst) {
+  if (n > bucket)
+    return ERR_BOUNDS;
+  memcpy(dst, src, (size_t)(n * feat) * sizeof(float));
+  memset(dst + n * feat, 0, (size_t)((bucket - n) * feat) * sizeof(float));
+  return OK;
+}
+
+} // extern "C"

@@ -21,7 +21,9 @@ from aiohttp import web
 from seldon_core_tpu.core.codec_json import (
     feedback_from_dict,
     message_from_dict,
+    message_from_json_fast,
     message_to_dict,
+    message_to_json_fast,
 )
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.serving.service import PredictionService
@@ -43,9 +45,17 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
 
     async def predictions(request: web.Request) -> web.Response:
         try:
-            msg = message_from_dict(await _payload_dict(request))
+            ctype = request.content_type or ""
+            if ctype.startswith("application/json"):
+                # hot path: ndarray matrix parses/serializes in C
+                # (native/fastcodec); envelope in Python json
+                msg = message_from_json_fast(await request.read())
+            else:
+                msg = message_from_dict(await _payload_dict(request))
             out = await service.predict(msg)
-            return web.json_response(message_to_dict(out))
+            return web.Response(
+                body=message_to_json_fast(out), content_type="application/json"
+            )
         except APIException as e:
             return _error_response(e)
 

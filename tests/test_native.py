@@ -1,0 +1,191 @@
+"""Native C++ wire codec: build, parse/encode round trips, fallbacks, and
+equivalence with the pure-Python codec (which stays the semantic oracle).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import native
+from seldon_core_tpu.core.codec_json import (
+    message_from_json,
+    message_from_json_fast,
+    message_to_dict,
+    message_to_json_fast,
+)
+from seldon_core_tpu.core.message import DataKind
+
+
+def test_library_builds():
+    # g++ is baked into the image; the codec must compile and load
+    assert native.available()
+
+
+def test_find_span_simple():
+    raw = b'{"data": {"names": ["a"], "ndarray": [[1.0, 2.0]]}}'
+    s, e = native.find_ndarray_span(raw)
+    assert raw[s:e] == b"[[1.0, 2.0]]"
+
+
+def test_find_span_ignores_key_inside_string_value():
+    raw = b'{"note": "the \\"ndarray\\" key", "data": {"ndarray": [[3]]}}'
+    s, e = native.find_ndarray_span(raw)
+    assert raw[s:e] == b"[[3]]"
+
+
+def test_parse_2d():
+    arr = native.parse_ndarray(b"[[1.5, -2e3, 3], [4, 5.25, 6]]")
+    np.testing.assert_array_equal(
+        arr, np.asarray([[1.5, -2000.0, 3.0], [4.0, 5.25, 6.0]], np.float32)
+    )
+
+
+def test_parse_1d():
+    arr = native.parse_ndarray(b"[1, 2, 3.5]")
+    assert arr.shape == (3,)
+    assert arr[2] == 3.5
+
+
+def test_parse_rejects_ragged_and_strings():
+    assert native.parse_ndarray(b"[[1, 2], [3]]") is None
+    assert native.parse_ndarray(b'[["a", "b"]]') is None
+    assert native.parse_ndarray(b"[[[1]]]") is None  # 3D: python path handles
+
+
+def test_encode_roundtrips_float32_exactly():
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((7, 5)).astype(np.float32)
+    body = native.encode_ndarray(arr)
+    back = np.asarray(json.loads(body), np.float32)
+    np.testing.assert_array_equal(back, arr)  # %.9g round-trips f32 exactly
+
+
+def test_pad_rows():
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = native.pad_rows(arr, 4)
+    assert out.shape == (4, 3)
+    np.testing.assert_array_equal(out[:2], arr)
+    assert out[2:].sum() == 0
+    with pytest.raises(ValueError):
+        native.pad_rows(arr, 1)
+
+
+def test_fast_decode_matches_python_decode():
+    raw = json.dumps(
+        {
+            "meta": {"puid": "p1", "tags": {"k": "v"}, "routing": {"r": 1}},
+            "data": {"names": ["x", "y"], "ndarray": [[1.0, 2.0], [3.0, 4.0]]},
+        }
+    ).encode()
+    fast = message_from_json_fast(raw)
+    slow = message_from_json(raw)
+    np.testing.assert_array_equal(fast.array, slow.array)
+    assert fast.names == slow.names
+    assert fast.meta.puid == slow.meta.puid
+    assert fast.meta.routing == slow.meta.routing
+    assert fast.data.kind == DataKind.NDARRAY
+
+
+def test_fast_decode_falls_back_on_nested_request():
+    # feedback-style body where data.ndarray is NOT the first ndarray key
+    raw = json.dumps(
+        {
+            "request": {"data": {"ndarray": [[9.0]]}},
+            "data": {"ndarray": [[1.0]]},
+        }
+    ).encode()
+    msg = message_from_json_fast(raw)
+    # whatever path it took, semantics must match the python codec
+    slow = message_from_json(raw)
+    np.testing.assert_array_equal(msg.array, slow.array)
+
+
+def test_fast_decode_falls_back_on_string_categories():
+    raw = json.dumps({"data": {"ndarray": [["red", 1.0]]}}).encode()
+    msg = message_from_json_fast(raw)
+    assert msg.array.shape == (1, 2)
+
+
+def test_fast_encode_matches_python_encode():
+    from seldon_core_tpu.core.codec_json import message_from_dict
+
+    msg = message_from_dict(
+        {
+            "meta": {"puid": "q"},
+            "data": {"names": ["a"], "ndarray": [[0.5, 1.25], [2.0, 3.0]]},
+        }
+    )
+    fast = json.loads(message_to_json_fast(msg))
+    slow = message_to_dict(msg)
+    assert fast["meta"]["puid"] == slow["meta"]["puid"]
+    assert fast["data"]["names"] == slow["data"]["names"]
+    np.testing.assert_array_equal(
+        np.asarray(fast["data"]["ndarray"], np.float32),
+        np.asarray(slow["data"]["ndarray"], np.float32),
+    )
+
+
+def test_fast_decode_malformed_json_raises_api_exception():
+    from seldon_core_tpu.core.errors import APIException
+
+    with pytest.raises(APIException):
+        message_from_json_fast(b'{"data": {"ndarray": [[1.0]}')
+
+
+def test_parse_rejects_malformed_number_tokens():
+    # each of these diverged from the Python oracle before the grammar fix
+    assert native.parse_ndarray(b"[[.5]]") is None
+    assert native.parse_ndarray(b"[[1-2]]") is None
+    assert native.parse_ndarray(b"[[1.2.3]]") is None
+    assert native.parse_ndarray(b"[[5.]]") is None
+    assert native.parse_ndarray(b"[[+1]]") is None
+    # valid JSON numbers still parse
+    arr = native.parse_ndarray(b"[[-1.5e-3, 0.5, 2E4]]")
+    np.testing.assert_allclose(arr, [[-0.0015, 0.5, 20000.0]], rtol=1e-6)
+
+
+def test_fast_encode_survives_forged_sentinel_in_tags():
+    from seldon_core_tpu.core.codec_json import message_from_dict
+
+    msg = message_from_dict(
+        {
+            "meta": {"puid": "p", "tags": {"t": "\x00NDARRAY\x00"}},
+            "data": {"ndarray": [[1.0, 2.0]]},
+        }
+    )
+    out = json.loads(message_to_json_fast(msg))
+    assert out["meta"]["tags"]["t"] == "\x00NDARRAY\x00"  # tag untouched
+    np.testing.assert_array_equal(
+        np.asarray(out["data"]["ndarray"], np.float32), [[1.0, 2.0]]
+    )
+
+
+def test_fast_encode_leaves_float64_to_python_path():
+    from seldon_core_tpu.core.codec_json import message_to_dict
+    from seldon_core_tpu.core.message import DefaultData, Meta, SeldonMessage
+
+    precise = 123456789.12345679
+    msg = SeldonMessage(
+        data=DefaultData(
+            names=(), array=np.asarray([[precise]], np.float64), kind=DataKind.NDARRAY
+        ),
+        meta=Meta(puid="p"),
+    )
+    out = json.loads(message_to_json_fast(msg))
+    assert out["data"]["ndarray"][0][0] == precise  # no f32 downcast
+
+
+def test_fast_decode_prefers_tensor_like_oracle():
+    raw = json.dumps(
+        {
+            "data": {
+                "tensor": {"shape": [1, 2], "values": [9.0, 9.0]},
+                "ndarray": [[1.0, 2.0]],
+            }
+        }
+    ).encode()
+    fast = message_from_json_fast(raw)
+    slow = message_from_json(raw)
+    np.testing.assert_array_equal(fast.array, slow.array)
+    assert fast.data.kind == slow.data.kind == DataKind.TENSOR
