@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from seldon_core_tpu.parallel.compat import pvary
+
 from seldon_core_tpu.ops.attention import NEG_INF, _block_stats, combine_stats
 
 _shard_map = jax.shard_map  # jax>=0.7 top-level export
@@ -55,9 +57,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, seq_per_dev:
     # constants created inside shard_map are axis-invariant; the carry must
     # be marked varying over the ring axis to match the loop outputs
     init = (
-        lax.pvary(jnp.full((b, h, s), NEG_INF, q.dtype), (axis_name,)),
-        lax.pvary(jnp.zeros((b, h, s), q.dtype), (axis_name,)),
-        lax.pvary(jnp.zeros((b, h, s, d), q.dtype), (axis_name,)),
+        pvary(jnp.full((b, h, s), NEG_INF, q.dtype), (axis_name,)),
+        pvary(jnp.zeros((b, h, s), q.dtype), (axis_name,)),
+        pvary(jnp.zeros((b, h, s, d), q.dtype), (axis_name,)),
         k,
         v,
     )

@@ -1,0 +1,13 @@
+"""jax version compatibility shims for manual-axes (shard_map) code."""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def pvary(x, axes):
+    """Mark x as varying over manual mesh axes. jax >= 0.9 renamed
+    lax.pvary to lax.pcast(..., to='varying')."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism and MoE expert parallelism on the virtual mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.ops.moe import init_moe, moe_ffn, moe_load_balance_loss, moe_pspecs
+from seldon_core_tpu.parallel.pipeline import pipeline_apply
+
+
+def _pipe_mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("pipe",))
+
+
+def _sequential_reference(stage_fn, stage_params, x_micro):
+    """Ground truth: run every microbatch through all stages sequentially."""
+    outs = []
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    for m in range(x_micro.shape[0]):
+        h = x_micro[m]
+        for s in range(n_stages):
+            p = jax.tree.map(lambda a: a[s], stage_params)
+            h = stage_fn(p, h)
+        outs.append(h)
+    return jnp.stack(outs)
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stage_params(stages, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.standard_normal((stages, d, d)) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((stages, d)) * 0.1, jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 3), (4, 4), (8, 2)])
+def test_pipeline_matches_sequential(stages, micro):
+    d = 8
+    params = _stage_params(stages, d)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((micro, 2, d)), jnp.float32)
+    ref = _sequential_reference(_stage_fn, params, x)
+    got = pipeline_apply(_stage_fn, params, x, _pipe_mesh(stages))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match_sequential():
+    """Backward through the pipeline (scan + ppermute transpose) must equal
+    the sequential model's gradients — this is what makes pp training real."""
+    stages, d = 4, 8
+    params = _stage_params(stages, d)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((4, 2, d)), jnp.float32)
+    mesh = _pipe_mesh(stages)
+
+    def loss_pipe(p):
+        return jnp.mean(pipeline_apply(_stage_fn, p, x, mesh) ** 2)
+
+    def loss_seq(p):
+        return jnp.mean(_sequential_reference(_stage_fn, p, x) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g_pipe[k]), np.asarray(g_seq[k]), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_moe_selects_experts_and_is_sharded_consistent():
+    d_model, d_ff, n_experts = 16, 32, 8
+    params = init_moe(0, d_model, d_ff, n_experts)
+    x = jnp.asarray(
+        np.random.default_rng(3).standard_normal((2, 4, d_model)), jnp.float32
+    )
+    ref = moe_ffn(params, x)
+    assert ref.shape == (2, 4, d_model)
+
+    # expert-sharded execution must match unsharded numerics
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(1, 4), ("data", "expert"))
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        moe_pspecs("expert"),
+        is_leaf=lambda v: isinstance(v, P),
+    )
+    sharded_params = jax.device_put(params, shardings)
+    got = jax.jit(moe_ffn)(sharded_params, jax.device_put(x, NamedSharding(mesh, P())))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_load_balance_loss_bounds():
+    params = init_moe(1, 8, 16, 4)
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 8, 8)), jnp.float32)
+    aux = float(moe_load_balance_loss(params, x))
+    # Switch-style aux loss: 1.0 at perfect balance, <= E at total collapse
+    assert 0.9 <= aux <= 4.0
+
+
+def test_graft_dryrun_covers_ep_and_pp():
+    import __graft_entry__ as g
+
+    g._dryrun_expert_parallel(jax.devices()[:8])
+    g._dryrun_pipeline_parallel(jax.devices()[:8])
