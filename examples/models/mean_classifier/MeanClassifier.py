@@ -1,0 +1,17 @@
+"""Example user model (reference parity: examples/models/mean_classifier).
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice MeanClassifier REST \
+        --model-dir examples/models/mean_classifier
+"""
+
+import numpy as np
+
+
+class MeanClassifier:
+    def __init__(self, intValue=0):
+        self.intValue = intValue
+        self.class_names = ["proba"]
+
+    def predict(self, X, feature_names):
+        return 1.0 / (1.0 + np.exp(-np.mean(X, axis=1, keepdims=True)))

@@ -55,6 +55,43 @@ class SimpleRouterUnit(Unit):
         return 0
 
 
+class MeanTransformerUnit(Unit):
+    """Input-centering transformer (reference ships the same as a container:
+    examples/transformers/mean_transformer/MeanTransformer.py subtracts a
+    STORED mean vector). Required parameter ``means`` — comma-separated
+    floats (a single value broadcasts). Deliberately no per-batch fallback:
+    centering a batch of one would zero the request."""
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        raw = str(self.params.get("means", "")).strip()
+        if not raw:
+            raise ValueError(
+                f"MEAN_TRANSFORMER '{spec.name}' requires a 'means' parameter"
+            )
+        try:
+            self.means = np.asarray([float(v) for v in raw.split(",")], np.float32)
+        except ValueError as e:
+            raise ValueError(
+                f"MEAN_TRANSFORMER '{spec.name}' bad 'means' parameter: {e}"
+            ) from e
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if msg.array is None:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_RESPONSE,
+                f"unit '{self.name}' needs tensor data",
+            )
+        x = np.asarray(msg.array, dtype=np.float32)
+        if self.means.size not in (1, x.shape[-1]):
+            raise APIException(
+                ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                f"unit '{self.name}': means has {self.means.size} values "
+                f"but input has {x.shape[-1]} features",
+            )
+        return msg.with_array(x - self.means, msg.names)
+
+
 class RandomABTestUnit(Unit):
     """Seeded A/B split (reference RandomABTestUnit.java:29-53).
 
@@ -187,6 +224,10 @@ def register_builtins(registry: UnitRegistry) -> None:
     )
     registry.register(
         PredictiveUnitImplementation.EPSILON_GREEDY, lambda spec, ctx: EpsilonGreedyRouter(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.MEAN_TRANSFORMER,
+        lambda spec, ctx: MeanTransformerUnit(spec),
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

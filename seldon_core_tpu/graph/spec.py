@@ -39,6 +39,8 @@ class PredictiveUnitImplementation(str, enum.Enum):
     # TPU-native additions beyond the reference's four built-ins:
     EPSILON_GREEDY = "EPSILON_GREEDY"  # bandit router (BASELINE config 5)
     JAX_MODEL = "JAX_MODEL"  # in-process jitted model from the model zoo
+    MEAN_TRANSFORMER = "MEAN_TRANSFORMER"  # centering input transformer
+    # (reference ships this as a container: examples/transformers/mean_transformer)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -222,5 +224,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.AVERAGE_COMBINER,
         PredictiveUnitImplementation.EPSILON_GREEDY,
         PredictiveUnitImplementation.JAX_MODEL,
+        PredictiveUnitImplementation.MEAN_TRANSFORMER,
     }
 )

@@ -90,6 +90,15 @@ def pipeline_apply(
     must map [mb, ...] -> [mb, ...] (uniform stage signature). Returns
     [M, mb, ...] outputs, replicated over the pipe axis.
     """
+    n_stages = mesh.shape[pipe_axis]
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            # a mismatch would silently run only each device's first local
+            # stage slice (tree.map a[0]) and return wrong outputs
+            raise ValueError(
+                f"stage_params stacked axis is {leaf.shape[0]} but mesh "
+                f"'{pipe_axis}' axis has {n_stages} devices — they must match"
+            )
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
     fn = jax.shard_map(
         partial(_pipeline_local, stage_fn=stage_fn, axis_name=pipe_axis),

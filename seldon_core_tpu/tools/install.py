@@ -1,0 +1,277 @@
+"""Install-bundle generator: the helm-chart equivalent (C26).
+
+Parity: reference helm-charts/seldon-core/templates — CRD with openAPIV3
+validation (seldon-deployment-crd.json), RBAC (rbac.yaml), the operator +
+gateway Deployments and the platform Service. Here one CLI renders the whole
+bundle as Kubernetes YAML for GKE with TPU node pools, with the platform
+running as ONE deployment (control plane + gateway + engines in-process,
+see platform.py) instead of the reference's three Java services:
+
+    python -m seldon_core_tpu.tools.install [--namespace seldon] \
+        [--image IMAGE] [--with-redis] [--with-monitoring] [-o DIR]
+
+prints to stdout (kubectl apply -f -) or writes one file per manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+CRD = {
+    "apiVersion": "apiextensions.k8s.io/v1",
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "seldondeployments.machinelearning.seldon.io"},
+    "spec": {
+        "group": "machinelearning.seldon.io",
+        "names": {
+            "kind": "SeldonDeployment",
+            "listKind": "SeldonDeploymentList",
+            "plural": "seldondeployments",
+            "singular": "seldondeployment",
+            "shortNames": ["sdep"],  # reference CRD short name
+        },
+        "scope": "Namespaced",
+        "versions": [
+            {
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "schema": {
+                    "openAPIV3Schema": {
+                        "type": "object",
+                        "properties": {
+                            "spec": {
+                                "type": "object",
+                                # full graph validation happens in the
+                                # operator (graph/validation.py); the CRD
+                                # keeps a permissive schema like the
+                                # reference's expand-validation output
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                            "status": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                        },
+                    }
+                },
+                "subresources": {"status": {}},
+            }
+        ],
+    },
+}
+
+
+def rbac(namespace: str) -> list[dict]:
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": "seldon-core-tpu", "namespace": namespace},
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "seldon-core-tpu"},
+            "rules": [
+                {
+                    "apiGroups": ["machinelearning.seldon.io"],
+                    "resources": ["seldondeployments", "seldondeployments/status"],
+                    "verbs": ["get", "list", "watch", "update", "patch"],
+                },
+                {
+                    "apiGroups": ["apps"],
+                    "resources": ["deployments"],
+                    "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+                {
+                    "apiGroups": [""],
+                    "resources": ["services"],
+                    "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "seldon-core-tpu"},
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "seldon-core-tpu",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "seldon-core-tpu",
+                    "namespace": namespace,
+                }
+            ],
+        },
+    ]
+
+
+def platform_deployment(namespace: str, image: str, tpu_chips: int = 1) -> list[dict]:
+    """The platform pod hosts the engines, so IT is the pod that needs the
+    chips: with tpu_chips > 0 it gets GKE TPU node selectors + a
+    google.com/tpu request (rounded up to a valid v5e slice)."""
+    pod_spec: dict = {"serviceAccountName": "seldon-core-tpu"}
+    resources: dict = {}
+    if tpu_chips > 0:
+        from seldon_core_tpu.operator.resources import _tpu_slice
+
+        chips, topology = _tpu_slice(tpu_chips)
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": topology,
+        }
+        resources = {"limits": {"google.com/tpu": str(chips)}}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "seldon-core-tpu-platform", "namespace": namespace},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "seldon-core-tpu-platform"}},
+                "template": {
+                    "metadata": {
+                        "labels": {"app": "seldon-core-tpu-platform"},
+                        "annotations": {
+                            "prometheus.io/scrape": "true",
+                            "prometheus.io/path": "/prometheus",
+                            "prometheus.io/port": "8080",
+                        },
+                    },
+                    "spec": {
+                        **pod_spec,
+                        "containers": [
+                            {
+                                "name": "platform",
+                                "image": image,
+                                "command": [
+                                    "python",
+                                    "-m",
+                                    "seldon_core_tpu.platform",
+                                    "--port",
+                                    "8080",
+                                    "--grpc-port",
+                                    "5000",
+                                ],
+                                "ports": [
+                                    {"containerPort": 8080, "name": "http"},
+                                    {"containerPort": 5000, "name": "grpc"},
+                                ],
+                                "readinessProbe": {
+                                    "httpGet": {"path": "/ready", "port": "http"},
+                                    "initialDelaySeconds": 15,
+                                },
+                                **({"resources": resources} if resources else {}),
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "seldon-core-tpu", "namespace": namespace},
+            "spec": {
+                "selector": {"app": "seldon-core-tpu-platform"},
+                "ports": [
+                    {"name": "http", "port": 8080, "targetPort": 8080},
+                    {"name": "grpc", "port": 5000, "targetPort": 5000},
+                ],
+            },
+        },
+    ]
+
+
+def redis_manifests(namespace: str) -> list[dict]:
+    """In-memory redis (reference redis-memonly/) for token + state stores."""
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "redis", "namespace": namespace},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "redis"}},
+                "template": {
+                    "metadata": {"labels": {"app": "redis"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "redis",
+                                "image": "redis:7-alpine",
+                                "args": ["--save", "", "--appendonly", "no"],
+                                "ports": [{"containerPort": 6379}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "redis", "namespace": namespace},
+            "spec": {"selector": {"app": "redis"}, "ports": [{"port": 6379}]},
+        },
+    ]
+
+
+def build_bundle(
+    namespace: str = "seldon",
+    image: str = "seldon-core-tpu/platform:latest",
+    with_redis: bool = False,
+    tpu_chips: int = 1,
+) -> list[dict]:
+    bundle: list[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
+        CRD,
+    ]
+    bundle += rbac(namespace)
+    bundle += platform_deployment(namespace, image, tpu_chips=tpu_chips)
+    if with_redis:
+        bundle += redis_manifests(namespace)
+    return bundle
+
+
+def to_yaml(manifests: list[dict]) -> str:
+    import yaml
+
+    return "---\n".join(yaml.safe_dump(m, sort_keys=False) for m in manifests)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--namespace", default="seldon")
+    p.add_argument("--image", default="seldon-core-tpu/platform:latest")
+    p.add_argument("--with-redis", action="store_true")
+    p.add_argument(
+        "--tpu-chips",
+        type=int,
+        default=1,
+        help="TPU chips for the platform pod (0 = CPU-only, for dev clusters)",
+    )
+    p.add_argument("-o", "--out-dir", default=None)
+    args = p.parse_args()
+    bundle = build_bundle(args.namespace, args.image, args.with_redis, args.tpu_chips)
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        for m in bundle:
+            name = f"{m['kind'].lower()}-{m['metadata']['name']}.yaml"
+            with open(os.path.join(args.out_dir, name), "w") as f:
+                f.write(to_yaml([m]))
+        print(args.out_dir)
+    else:
+        sys.stdout.write(to_yaml(bundle))
+
+
+if __name__ == "__main__":
+    main()
