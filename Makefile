@@ -1,0 +1,32 @@
+# Dev loops (reference parity: top-level Makefile + per-service Makefile.ci).
+
+PY ?= python
+TEST_ENV = PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test-fast bench dryrun protos native install-bundle clean
+
+test:  ## full suite on the 8-device virtual CPU mesh
+	$(PY) -m pytest tests/ -q
+
+test-fast:  ## skip the slow model/parallel tests
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_models_heavy.py --ignore=tests/test_parallel.py
+
+bench:  ## one-line JSON benchmark on the attached accelerator
+	$(PY) bench.py
+
+dryrun:  ## compile-check the multichip path on 8 virtual devices
+	$(TEST_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+protos:  ## regenerate pb2 modules (protoc is in the base image)
+	cd seldon_core_tpu/proto && protoc --python_out=. prediction.proto seldon_deployment.proto
+
+native:  ## force-rebuild the C wire codec
+	rm -f seldon_core_tpu/native/_fastcodec.so
+	$(PY) -c "from seldon_core_tpu import native; assert native.available(); print('fastcodec ok')"
+
+install-bundle:  ## render k8s manifests to deploy/rendered/
+	$(PY) -m seldon_core_tpu.tools.install --with-redis -o deploy/rendered
+
+clean:
+	rm -rf .pytest_cache deploy/rendered seldon_core_tpu/native/_fastcodec.so*
+	find . -name __pycache__ -type d -exec rm -rf {} +

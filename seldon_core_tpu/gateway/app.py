@@ -233,6 +233,8 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 )
             return web.json_response(message_to_dict(out))
         except APIException as e:
+            if gw.metrics is not None:
+                gw.metrics.ingress_error("", "predict", e.error.code)
             return _error_response(e)
 
     async def feedback(request: web.Request) -> web.Response:
@@ -251,6 +253,8 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 gw.metrics.feedback(dep.name, "", "", fb.reward)
             return web.json_response(message_to_dict(out))
         except APIException as e:
+            if gw.metrics is not None:
+                gw.metrics.ingress_error("", "feedback", e.error.code)
             return _error_response(e)
 
     async def ready(request: web.Request) -> web.Response:

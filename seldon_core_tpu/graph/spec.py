@@ -123,6 +123,9 @@ class TpuSpec(_Spec):
     batch_buckets: list[int] = Field(default_factory=list)  # [] -> derived from max_batch
     max_batch: int = 64
     batch_timeout_ms: float = 3.0
+    # False -> per-request isolation: a ROUTER decides per request exactly
+    # like the reference engine, at the cost of per-request graph calls
+    batch_across_requests: bool = True
     dtype: str = "float32"  # computation dtype: float32 | bfloat16
     # donation only pays when output aliases input shape (e.g. transformers);
     # classifier heads change shape, so default off

@@ -41,6 +41,9 @@ class NullMetrics:
     def ingress_request(self, deployment: str, method: str, duration_s: float) -> None:
         pass
 
+    def ingress_error(self, deployment: str, method: str, code: int) -> None:
+        pass
+
     def unit_call(self, deployment: str, predictor: str, unit: str, method: str,
                   duration_s: float) -> None:
         pass
@@ -91,6 +94,12 @@ class Metrics(NullMetrics):
             ["deployment_name", "predictor_name", "model_name"],
             registry=registry,
         )
+        self._ingress_errors = Counter(
+            "seldon_api_ingress_server_errors",
+            "Failed external API requests by error code",
+            ["deployment_name", "method", "code"],
+            registry=registry,
+        )
         self._batch_size = Histogram(
             "seldon_tpu_batch_size",
             "Micro-batch sizes submitted to the device",
@@ -115,6 +124,9 @@ class Metrics(NullMetrics):
 
     def ingress_request(self, deployment, method, duration_s):
         self._ingress.labels(deployment, method).observe(duration_s)
+
+    def ingress_error(self, deployment, method, code):
+        self._ingress_errors.labels(deployment, method, str(code)).inc()
 
     def unit_call(self, deployment, predictor, unit, method, duration_s):
         self._unit.labels(deployment, predictor, unit, method).observe(duration_s)

@@ -1,0 +1,74 @@
+"""Framework adapters: serve non-JAX models as graph nodes.
+
+Parity: the reference wraps sklearn/TF/Keras/H2O models by putting them in a
+container behind the duck-typed predict contract (wrappers/python). Here the
+same duck-typed contract exists in-process (engine/units.py PythonClassUnit),
+and these adapters produce such objects from common frameworks:
+
+- TorchModelAdapter: torch.nn.Module -> predict() on host CPU (torch-cpu
+  tier; the model joins the graph next to TPU-resident JAX nodes);
+- FunctionModelAdapter: any f(np.ndarray) -> np.ndarray;
+- SklearnModelAdapter: estimator with predict_proba/predict.
+
+For TPU-resident serving of foreign weights, convert the weights into a zoo
+ModelSpec (pure JAX apply + params pytree) and load via JAX_MODEL — the
+adapters here are the compatibility tier, not the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class FunctionModelAdapter:
+    """Wrap a plain function as a duck-typed model."""
+
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray], class_names: Sequence[str] = ()):
+        self._fn = fn
+        if class_names:
+            self.class_names = list(class_names)
+
+    def predict(self, X: np.ndarray, feature_names) -> np.ndarray:
+        return np.asarray(self._fn(np.asarray(X)))
+
+
+class TorchModelAdapter:
+    """Wrap a torch.nn.Module (eval mode, CPU) as a duck-typed model."""
+
+    def __init__(self, module: Any, class_names: Sequence[str] = (), softmax: bool = False):
+        import torch
+
+        self._torch = torch
+        self._module = module.eval()
+        self._softmax = softmax
+        if class_names:
+            self.class_names = list(class_names)
+
+    def predict(self, X: np.ndarray, feature_names) -> np.ndarray:
+        torch = self._torch
+        with torch.no_grad():
+            t = torch.as_tensor(np.asarray(X, dtype=np.float32))
+            out = self._module(t)
+            if self._softmax:
+                out = torch.softmax(out, dim=-1)
+        return out.cpu().numpy()
+
+
+class SklearnModelAdapter:
+    """Wrap an sklearn-style estimator (predict_proba preferred, reference
+    IrisClassifier.py pattern)."""
+
+    def __init__(self, estimator: Any, class_names: Sequence[str] = ()):
+        self._est = estimator
+        if class_names:
+            self.class_names = list(class_names)
+        elif hasattr(estimator, "classes_"):
+            self.class_names = [str(c) for c in estimator.classes_]
+
+    def predict(self, X: np.ndarray, feature_names) -> np.ndarray:
+        if hasattr(self._est, "predict_proba"):
+            return np.asarray(self._est.predict_proba(np.asarray(X)))
+        out = np.asarray(self._est.predict(np.asarray(X)))
+        return out if out.ndim == 2 else out[:, None]

@@ -84,9 +84,20 @@ class RunningDeployment:
         # predictor unless routing tags say otherwise.
         return await next(iter(self.services.values())).send_feedback(fb)
 
-    def close(self) -> None:
+    def flush_state(self) -> None:
+        """Final snapshot of stateful units (C19 parity)."""
         if self.persister is not None:
-            self.persister.stop()  # final state flush (C19 parity)
+            self.persister.stop()
+
+    def close_batchers(self) -> None:
+        for svc in self.services.values():
+            batcher = getattr(svc, "batcher", None)
+            if batcher is not None:
+                batcher.close_nowait()
+
+    def close(self) -> None:
+        self.close_batchers()
+        self.flush_state()
 
 
 @dataclass
@@ -132,16 +143,24 @@ class DeploymentManager:
         self._reconcile_lock = threading.RLock()
 
     # ------------------------------------------------------------ factories
-    @staticmethod
-    def _default_service_factory(dep: SeldonDeployment, predictor):
+    def _default_service_factory(self, dep: SeldonDeployment, predictor):
         from seldon_core_tpu.engine import build_executor
+        from seldon_core_tpu.serving.batcher import make_batcher
         from seldon_core_tpu.serving.service import PredictionService
 
         executor = build_executor(predictor)
+        batcher = make_batcher(
+            predictor.tpu,
+            executor.execute,
+            metrics=self.metrics,
+            deployment_name=dep.spec.name or dep.metadata.name,
+        )
         return PredictionService(
             executor,
             deployment_name=dep.spec.name or dep.metadata.name,
             predictor_name=predictor.name,
+            batcher=batcher,
+            metrics=self.metrics,
         )
 
     def _make_persister(self, name: str, services: dict):
@@ -230,10 +249,11 @@ class DeploymentManager:
         existed = name in self._running
         old = self._running.pop(name, None)
         if old is not None:
-            # flush the old deployment's learned state BEFORE the new
-            # persister restores from the store, or updates lose everything
-            # since the last periodic snapshot
-            old.close()
+            # flush the old version's learned state BEFORE the new persister
+            # restores from the store (or the update loses everything since
+            # the last periodic snapshot) — but keep its batchers SERVING
+            # until the new version is registered, so the swap drops nothing
+            old.flush_state()
         persister = self._make_persister(name, services)
         self._running[name] = RunningDeployment(dep, services, persister=persister)
         self._failed.pop(name, None)
@@ -246,6 +266,8 @@ class DeploymentManager:
             self.store.deployment_added(spec)
         if self.backend is not None:
             self.backend.register(dep.spec.name or name, self._running[name])
+        if old is not None:
+            old.close_batchers()  # new version is routable; drain the old
 
         # status writeback (reference DeploymentWatcher -> StatusUpdate)
         self._write_available_status(name, dep)

@@ -31,6 +31,31 @@ from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.metrics import NullMetrics
 
 
+def make_batcher(
+    tpu_spec,
+    execute: "ExecuteFn",
+    *,
+    metrics=None,
+    deployment_name: str = "",
+) -> "MicroBatcher | None":
+    """The one place batching policy is decided from a predictor's TpuSpec:
+    None when batching is disabled (batch_across_requests false — a ROUTER
+    then decides per request like the reference engine) or pointless
+    (max_batch <= 1). Used by both the engine server and the reconciler so
+    their gating can't drift."""
+    if not getattr(tpu_spec, "batch_across_requests", True):
+        return None
+    if getattr(tpu_spec, "max_batch", 1) <= 1:
+        return None
+    return MicroBatcher(
+        execute,
+        max_batch=tpu_spec.max_batch,
+        batch_timeout_ms=tpu_spec.batch_timeout_ms,
+        metrics=metrics,
+        deployment_name=deployment_name,
+    )
+
+
 @dataclass
 class _Pending:
     msg: SeldonMessage
@@ -66,11 +91,14 @@ class MicroBatcher:
         self._deployment = deployment_name
         self._closed = False
         self._inflight: set[asyncio.Task] = set()
+        self._loop: asyncio.AbstractEventLoop | None = None
 
     async def submit(self, msg: SeldonMessage) -> SeldonMessage:
         """Submit one request; resolves with its own (row-sliced) response."""
         if self._closed:
             raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, "batcher closed")
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
         arr = msg.array
         if arr is None:
             # non-tensor payloads can't batch — run through directly
@@ -176,3 +204,14 @@ class MicroBatcher:
             self._flush(key)
         while self._inflight:
             await asyncio.gather(*list(self._inflight), return_exceptions=True)
+
+    def close_nowait(self) -> None:
+        """Thread-safe shutdown for callers outside the serving loop (the
+        reconciler closes deployments from a worker thread): stop accepting
+        and schedule the drain on the loop the batcher runs in."""
+        self._closed = True
+        if self._loop is not None and not self._loop.is_closed():
+            def _drain() -> None:
+                asyncio.ensure_future(self.close())
+
+            self._loop.call_soon_threadsafe(_drain)

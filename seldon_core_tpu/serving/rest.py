@@ -57,6 +57,9 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
                 body=message_to_json_fast(out), content_type="application/json"
             )
         except APIException as e:
+            service.metrics.ingress_error(
+                service.deployment_name, "predict", e.error.code
+            )
             return _error_response(e)
 
     async def feedback(request: web.Request) -> web.Response:
@@ -65,6 +68,9 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
             out = await service.send_feedback(fb)
             return web.json_response(message_to_dict(out))
         except APIException as e:
+            service.metrics.ingress_error(
+                service.deployment_name, "feedback", e.error.code
+            )
             return _error_response(e)
 
     async def ready(request: web.Request) -> web.Response:

@@ -27,7 +27,7 @@ from seldon_core_tpu.graph.defaulting import default_deployment
 from seldon_core_tpu.graph.spec import PredictorSpec, SeldonDeployment
 from seldon_core_tpu.graph.validation import validate_deployment
 from seldon_core_tpu.metrics import get_metrics
-from seldon_core_tpu.serving.batcher import MicroBatcher
+from seldon_core_tpu.serving.batcher import MicroBatcher, make_batcher
 from seldon_core_tpu.serving.rest import build_app
 from seldon_core_tpu.serving.service import PredictionService
 from seldon_core_tpu.utils import env as envmod
@@ -63,10 +63,9 @@ class PredictorServer:
             predictor, context=context, feedback_metrics_hook=feedback_hook
         )
         self.batcher = (
-            MicroBatcher(
+            make_batcher(
+                predictor.tpu,
                 self.executor.execute,
-                max_batch=predictor.tpu.max_batch,
-                batch_timeout_ms=predictor.tpu.batch_timeout_ms,
                 metrics=self.metrics,
                 deployment_name=deployment_name,
             )

@@ -1,0 +1,106 @@
+"""Framework adapters inside graphs: torch/sklearn/function models serve as
+nodes next to JAX ones (the reference's any-framework container capability,
+in-process)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.core.codec_json import message_from_dict
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph.spec import PredictorSpec, PredictiveUnit
+from seldon_core_tpu.models.adapters import (
+    FunctionModelAdapter,
+    SklearnModelAdapter,
+    TorchModelAdapter,
+)
+
+
+def _single_model_predictor(name="m"):
+    return PredictorSpec(
+        name="p",
+        graph=PredictiveUnit.model_validate({"name": name, "type": "MODEL"}),
+    )
+
+
+async def _run(unit_obj, x):
+    ex = build_executor(_single_model_predictor(), context={"units": {"m": unit_obj}})
+    out = await ex.execute(message_from_dict({"data": {"ndarray": x}}))
+    return np.asarray(out.array), out
+
+
+async def test_function_adapter():
+    model = FunctionModelAdapter(lambda X: X * 3.0, class_names=["a", "b"])
+    y, out = await _run(model, [[1.0, 2.0]])
+    np.testing.assert_allclose(y, [[3.0, 6.0]])
+    assert out.names == ("a", "b")
+
+
+async def test_torch_adapter_in_graph():
+    torch = pytest.importorskip("torch")
+
+    lin = torch.nn.Linear(4, 3)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+        lin.bias.copy_(torch.tensor([0.1, 0.2, 0.7]))
+    model = TorchModelAdapter(lin, class_names=["x", "y", "z"], softmax=True)
+    y, out = await _run(model, [[1.0, 2.0, 3.0, 4.0]])
+    assert y.shape == (1, 3)
+    np.testing.assert_allclose(y.sum(axis=-1), 1.0, rtol=1e-5)
+    assert out.names == ("x", "y", "z")
+
+
+async def test_sklearn_style_adapter():
+    class FakeEstimator:
+        classes_ = [0, 1]
+
+        def predict_proba(self, X):
+            p = 1.0 / (1.0 + np.exp(-X.sum(axis=1)))
+            return np.stack([1 - p, p], axis=1)
+
+    model = SklearnModelAdapter(FakeEstimator())
+    y, out = await _run(model, [[0.5, 0.5]])
+    assert y.shape == (1, 2)
+    assert out.names == ("0", "1")
+
+
+async def test_torch_and_jax_nodes_in_one_graph():
+    """The capability the reference needs containers for: a combiner over a
+    torch model and a JAX model, one process, no RPC."""
+    torch = pytest.importorskip("torch")
+
+    lin = torch.nn.Linear(4, 3)
+    with torch.no_grad():
+        lin.weight.fill_(0.0)
+        lin.bias.copy_(torch.tensor([1.0, 1.0, 1.0]))
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "avg",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {"name": "torch-node", "type": "MODEL"},
+                    {
+                        "name": "jax-node",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_logistic", "type": "STRING"}
+                        ],
+                    },
+                ],
+            },
+        }
+    )
+    ex = build_executor(
+        pred,
+        context={"units": {"torch-node": TorchModelAdapter(lin, softmax=True)}},
+    )
+    out = await ex.execute(
+        message_from_dict({"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}})
+    )
+    y = np.asarray(out.array)
+    assert y.shape == (1, 3)
+    np.testing.assert_allclose(y.sum(axis=-1), 1.0, rtol=1e-4)

@@ -281,3 +281,77 @@ async def test_negative_reward_feedback_with_metrics():
         assert resp.status == 200  # negative rewards must not crash metrics
     finally:
         await client.close()
+
+
+async def test_batch_across_requests_false_bypasses_batcher():
+    """Per-request routing isolation: with batch_across_requests false the
+    server builds no batcher, so a RANDOM_ABTEST decides per request exactly
+    like the reference engine."""
+    from seldon_core_tpu.graph.spec import PredictorSpec, PredictiveUnit
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "ab",
+                "type": "ROUTER",
+                "implementation": "RANDOM_ABTEST",
+                "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+            "tpu": {"batch_across_requests": False},
+        }
+    )
+    server = PredictorServer(pred, deployment_name="d")
+    assert server.batcher is None
+
+    pred_batched = pred.model_copy(
+        update={"tpu": pred.tpu.model_copy(update={"batch_across_requests": True})}
+    )
+    server2 = PredictorServer(pred_batched, deployment_name="d")
+    assert server2.batcher is not None
+
+
+async def test_manager_deployments_get_batcher():
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.operator import DeploymentManager
+
+    cr = {
+        "metadata": {"name": "bdep2"},
+        "spec": {
+            "name": "bdep2",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "clf",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_logistic", "type": "STRING"}
+                        ],
+                    },
+                    "tpu": {"max_batch": 8, "batch_timeout_ms": 1.0},
+                }
+            ],
+        },
+    }
+    m = DeploymentManager()
+    m.apply(cr)
+    running = m.get("bdep2")
+    svc = next(iter(running.services.values()))
+    assert svc.batcher is not None
+    # concurrent submits coalesce through the batcher and still demux
+    import asyncio
+
+    msgs = [
+        message_from_dict({"data": {"ndarray": [[float(i), 2.0, 3.0, 4.0]]}})
+        for i in range(4)
+    ]
+    outs = await asyncio.gather(*(svc.predict(msg) for msg in msgs))
+    assert all(o.array.shape == (1, 3) for o in outs)
+    m.delete("bdep2")
