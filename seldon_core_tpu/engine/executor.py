@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import time
 from typing import Any, Callable, Sequence
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
@@ -75,9 +76,15 @@ class GraphExecutor:
         self,
         root: Node,
         feedback_metrics_hook: Callable[[str, float], None] | None = None,
+        unit_call_hook: Callable[[str, str, float], None] | None = None,
     ):
         self.root = root
         self._feedback_hook = feedback_metrics_hook
+        # (unit_name, method, duration_s) per unit invocation — C10 parity:
+        # the reference timers every engine->microservice call
+        # (SeldonRestTemplateExchangeTagsProvider); here calls are in-process
+        # but the observability contract survives
+        self._unit_hook = unit_call_hook
 
     def units(self):
         """All runtime units in the graph, pre-order (used by persistence,
@@ -86,13 +93,43 @@ class GraphExecutor:
 
     # ------------------------------------------------------------- predict
     async def execute(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._get_output(self.root, msg)
+        # opt-in request tracing: a request tagged {"trace": ...} gets per-
+        # unit span timings back in tags["trace"], keyed by the puid trace id
+        spans: list[dict] | None = [] if "trace" in msg.meta.tags else None
+        out = await self._get_output(self.root, msg, spans)
+        if spans is not None:
+            out = out.with_meta(
+                out.meta.merged_with(Meta(tags={"trace": spans}))
+            )
+        return out
 
-    async def _get_output(self, node: Node, msg: SeldonMessage) -> SeldonMessage:
+    async def _timed(self, node: Node, method: str, coro, spans):
+        t0 = time.perf_counter()
+        try:
+            return await coro
+        finally:
+            dt = time.perf_counter() - t0
+            if self._unit_hook is not None:
+                self._unit_hook(node.name, method, dt)
+            if spans is not None:
+                spans.append(
+                    {"unit": node.name, "method": method, "ms": round(dt * 1e3, 3)}
+                )
+
+    async def _get_output(
+        self, node: Node, msg: SeldonMessage, spans: list | None = None
+    ) -> SeldonMessage:
         unit = node.unit
+        # requestPath (reference Meta.requestPath: every node the request
+        # visited, mapped to its serving image/implementation)
+        msg = msg.with_meta(
+            msg.meta.merged_with(Meta(request_path={node.name: unit.image}))
+        )
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_INPUT):
-            out = await unit.transform_input(msg)
+            out = await self._timed(
+                node, "transform_input", unit.transform_input(msg), spans
+            )
             msg = out.with_meta(msg.meta.merged_with(out.meta))
 
         if not node.children:
@@ -100,7 +137,7 @@ class GraphExecutor:
 
         branch = ROUTE_ALL
         if _has_method(node, PredictiveUnitMethod.ROUTE):
-            branch = await unit.route(msg)
+            branch = await self._timed(node, "route", unit.route(msg), spans)
             # sanityCheckRouting (reference :244-250)
             if branch != ROUTE_ALL and not (0 <= branch < len(node.children)):
                 raise APIException(
@@ -117,10 +154,12 @@ class GraphExecutor:
             targets = [node.children[branch]]
 
         if len(targets) == 1:
-            child_outputs = [await self._get_output(targets[0], msg)]
+            child_outputs = [await self._get_output(targets[0], msg, spans)]
         else:
             child_outputs = list(
-                await asyncio.gather(*(self._get_output(c, msg) for c in targets))
+                await asyncio.gather(
+                    *(self._get_output(c, msg, spans) for c in targets)
+                )
             )
 
         merged_meta = msg.meta
@@ -128,7 +167,9 @@ class GraphExecutor:
             merged_meta = merged_meta.merged_with(co.meta)
 
         if _has_method(node, PredictiveUnitMethod.AGGREGATE):
-            out = await unit.aggregate(child_outputs)
+            out = await self._timed(
+                node, "aggregate", unit.aggregate(child_outputs), spans
+            )
         elif len(child_outputs) == 1:
             out = child_outputs[0]
         else:
@@ -139,7 +180,9 @@ class GraphExecutor:
         msg = out.with_meta(merged_meta.merged_with(out.meta))
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
-            out = await unit.transform_output(msg)
+            out = await self._timed(
+                node, "transform_output", unit.transform_output(msg), spans
+            )
             msg = out.with_meta(msg.meta.merged_with(out.meta))
         return msg
 
@@ -221,6 +264,10 @@ def build_node(
     if unit is None:
         unit = Unit(spec)
 
+    container = (context.get("containers") or {}).get(spec.name)
+    if container is not None and getattr(container, "image", ""):
+        unit.image = container.image
+
     children = [build_node(c, registry, context) for c in spec.children]
     return Node(spec=spec, unit=unit, children=children)
 
@@ -230,10 +277,15 @@ def build_executor(
     registry: UnitRegistry | None = None,
     context: dict[str, Any] | None = None,
     feedback_metrics_hook: Callable[[str, float], None] | None = None,
+    unit_call_hook: Callable[[str, str, float], None] | None = None,
 ) -> GraphExecutor:
     registry = registry or default_registry()
     context = dict(context or {})
     context.setdefault("containers", {c.name: c for c in predictor.componentSpec.containers})
     context.setdefault("tpu", predictor.tpu)
     root = build_node(predictor.graph, registry, context)
-    return GraphExecutor(root, feedback_metrics_hook=feedback_metrics_hook)
+    return GraphExecutor(
+        root,
+        feedback_metrics_hook=feedback_metrics_hook,
+        unit_call_hook=unit_call_hook,
+    )

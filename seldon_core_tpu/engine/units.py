@@ -48,6 +48,10 @@ class Unit:
         self.spec = spec
         self.name = spec.name
         self.params: dict[str, Any] = parameters_dict(spec.parameters)
+        # what serves this unit — container image when one exists, else the
+        # implementation name; reported in meta.requestPath (reference
+        # PredictiveUnitState image tracking)
+        self.image: str = spec.implementation.value if spec.implementation else ""
 
     # readiness — aggregated into the server /ready (reference engine boots
     # models at container start; our models may load weights lazily)

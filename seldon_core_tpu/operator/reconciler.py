@@ -148,16 +148,23 @@ class DeploymentManager:
         from seldon_core_tpu.serving.batcher import make_batcher
         from seldon_core_tpu.serving.service import PredictionService
 
-        executor = build_executor(predictor)
+        dep_name = dep.spec.name or dep.metadata.name
+        metrics = self.metrics
+        unit_call_hook = None
+        if metrics is not None:
+            def unit_call_hook(unit_name, method, duration_s):  # noqa: E306
+                metrics.unit_call(dep_name, predictor.name, unit_name, method, duration_s)
+
+        executor = build_executor(predictor, unit_call_hook=unit_call_hook)
         batcher = make_batcher(
             predictor.tpu,
             executor.execute,
             metrics=self.metrics,
-            deployment_name=dep.spec.name or dep.metadata.name,
+            deployment_name=dep_name,
         )
         return PredictionService(
             executor,
-            deployment_name=dep.spec.name or dep.metadata.name,
+            deployment_name=dep_name,
             predictor_name=predictor.name,
             batcher=batcher,
             metrics=self.metrics,

@@ -103,6 +103,10 @@ class MicroBatcher:
         if arr is None:
             # non-tensor payloads can't batch — run through directly
             return await self._execute(msg)
+        if "trace" in msg.meta.tags:
+            # traced requests bypass coalescing: spans must describe THIS
+            # request, and batch-mates must not inherit its trace tags
+            return await self._execute(msg)
         arr = np.asarray(arr)
         if arr.ndim < 2:
             arr = np.atleast_2d(arr)

@@ -59,8 +59,16 @@ class PredictorServer:
         def feedback_hook(unit_name: str, reward: float) -> None:
             self.metrics.feedback(self.deployment_name, predictor.name, unit_name, reward)
 
+        def unit_call_hook(unit_name: str, method: str, duration_s: float) -> None:
+            self.metrics.unit_call(
+                self.deployment_name, predictor.name, unit_name, method, duration_s
+            )
+
         self.executor: GraphExecutor = build_executor(
-            predictor, context=context, feedback_metrics_hook=feedback_hook
+            predictor,
+            context=context,
+            feedback_metrics_hook=feedback_hook,
+            unit_call_hook=unit_call_hook,
         )
         self.batcher = (
             make_batcher(

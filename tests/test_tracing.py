@@ -1,0 +1,112 @@
+"""Tracing/observability: requestPath population, per-unit call timers,
+opt-in request trace spans (SURVEY §5.1 — the reference only had routing/tags
+as a poor-man's trace; puid is the trace id)."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.core.codec_json import message_from_dict, message_to_dict
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph.spec import PredictorSpec, PredictiveUnit
+
+
+def _ab_predictor():
+    return PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "ab",
+                "type": "ROUTER",
+                "implementation": "RANDOM_ABTEST",
+                "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+
+
+async def test_request_path_records_visited_units():
+    ex = build_executor(_ab_predictor())
+    out = await ex.execute(message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    path = out.meta.request_path
+    assert "ab" in path and path["ab"] == "RANDOM_ABTEST"
+    # exactly one of the two children was visited (the routed branch)
+    visited_children = {"a", "b"} & set(path)
+    assert len(visited_children) == 1
+    branch = out.meta.routing["ab"]
+    assert ("a" if branch == 0 else "b") in path
+
+
+async def test_request_path_uses_container_image_when_present():
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "componentSpec": {
+                "containers": [
+                    {"name": "m", "image": "myrepo/clf:1.2", "model_uri": "zoo://iris_logistic"}
+                ]
+            },
+            "graph": {"name": "m", "type": "MODEL"},
+        }
+    )
+    ex = build_executor(pred)
+    out = await ex.execute(message_from_dict({"data": {"ndarray": [[1, 2, 3, 4]]}}))
+    assert out.meta.request_path["m"] == "myrepo/clf:1.2"
+
+
+async def test_unit_call_hook_times_every_method():
+    calls = []
+    ex = build_executor(
+        _ab_predictor(), unit_call_hook=lambda u, m, d: calls.append((u, m, d))
+    )
+    await ex.execute(message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    methods = {(u, m) for u, m, _ in calls}
+    assert ("ab", "route") in methods
+    assert any(m == "transform_input" for _, m, _ in calls)
+    assert all(d >= 0 for _, _, d in calls)
+
+
+async def test_trace_tag_returns_spans():
+    ex = build_executor(_ab_predictor())
+    out = await ex.execute(
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1.0, 2.0]]}}
+        )
+    )
+    spans = out.meta.tags["trace"]
+    assert isinstance(spans, list) and spans
+    assert {"unit", "method", "ms"} <= set(spans[0])
+    assert any(s["method"] == "route" for s in spans)
+    # trace must survive the JSON codec (client-visible)
+    encoded = message_to_dict(out)
+    assert encoded["meta"]["tags"]["trace"]
+
+
+async def test_untraced_request_has_no_span_overhead():
+    ex = build_executor(_ab_predictor())
+    out = await ex.execute(message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}}))
+    assert "trace" not in out.meta.tags
+
+
+async def test_traced_request_bypasses_batcher():
+    """A traced request must not coalesce: its spans describe itself only,
+    and batch-mates never inherit its trace tags."""
+    import asyncio
+
+    from seldon_core_tpu.serving.batcher import MicroBatcher
+
+    ex = build_executor(_ab_predictor())
+    batcher = MicroBatcher(ex.execute, max_batch=8, batch_timeout_ms=20.0)
+
+    plain = message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}})
+    traced = message_from_dict(
+        {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[3.0, 4.0]]}}
+    )
+    out_plain, out_traced = await asyncio.gather(
+        batcher.submit(plain), batcher.submit(traced)
+    )
+    assert "trace" not in out_plain.meta.tags
+    assert out_traced.meta.tags["trace"]
