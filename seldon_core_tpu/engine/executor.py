@@ -284,6 +284,11 @@ def build_executor(
     context.setdefault("containers", {c.name: c for c in predictor.componentSpec.containers})
     context.setdefault("tpu", predictor.tpu)
     root = build_node(predictor.graph, registry, context)
+    tpu_cfg = context.get("tpu")
+    if tpu_cfg is not None and getattr(tpu_cfg, "fuse_graph", True):
+        from seldon_core_tpu.engine.fused import fuse_graph
+
+        root = fuse_graph(root, tpu_cfg, context.get("mesh"))
     return GraphExecutor(
         root,
         feedback_metrics_hook=feedback_metrics_hook,

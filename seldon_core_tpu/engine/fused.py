@@ -1,0 +1,154 @@
+"""Graph fusion: compile a pure all-JAX subtree into ONE XLA program.
+
+This is the TPU-native payoff the whole architecture exists for (SURVEY §7
+step 3): where the reference executes a COMBINER by fanning per-request RPCs
+to N model containers and averaging in Java, a pure subtree here becomes a
+single jitted function — N model applies + the combine trace into one XLA
+program, so XLA fuses/overlaps them and the host pays one dispatch instead
+of N.
+
+Two execution strategies, picked automatically:
+- vmapped ensemble: when every child shares the same apply function and
+  param structure (e.g. 3x resnet50 with different seeds), params stack on a
+  leading ensemble axis and one vmap(apply) computes all members — the
+  matmuls batch onto the MXU together;
+- traced ensemble: heterogeneous children trace sequentially into the same
+  program (still one dispatch, XLA schedules them).
+
+Fusable units are those exposing ``as_pure_fn()`` (engine/units.py hook):
+JaxModelUnit leaves and AverageCombinerUnit interior nodes today. Routers
+and stateful/host units never fuse — the executor remains the correct
+fallback around the fused islands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.engine.executor import Node
+from seldon_core_tpu.engine.units import Unit
+from seldon_core_tpu.graph.spec import PredictiveUnit, PredictiveUnitType
+from seldon_core_tpu.models.base import JaxModelUnit, ModelRuntime
+
+
+@dataclass
+class _PureSubtree:
+    apply_fn: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    class_names: tuple[str, ...]
+    feature_shape: tuple[int, ...] | None
+    n_models: int
+
+
+def _collect(node: Node) -> _PureSubtree | None:
+    """Bottom-up: a JaxModelUnit leaf or a pure combiner over pure children."""
+    unit = node.unit
+    if not node.children:
+        if isinstance(unit, JaxModelUnit):
+            rt = unit.runtime
+            return _PureSubtree(
+                apply_fn=rt.apply_fn,
+                params=rt.params,
+                class_names=rt.class_names,
+                feature_shape=getattr(rt, "feature_shape", None),
+                n_models=1,
+            )
+        return None
+
+    # only genuine COMBINER nodes fuse as interior nodes: a MODEL unit also
+    # exposes as_pure_fn, but its fn applies to the INPUT, not to a list of
+    # child outputs — treating it as a combiner would invert the graph
+    if node.spec.type != PredictiveUnitType.COMBINER:
+        return None
+    pure = unit.as_pure_fn()
+    if pure is None:
+        return None
+    combine_fn, combine_params = pure
+
+    children = [_collect(c) for c in node.children]
+    if any(c is None for c in children):
+        return None
+
+    same_fn = all(c.apply_fn is children[0].apply_fn for c in children)
+    same_tree = all(
+        jax.tree.structure(c.params) == jax.tree.structure(children[0].params)
+        for c in children
+    )
+    if same_fn and same_tree and len(children) > 1:
+        # homogeneous ensemble: stack params, one vmapped apply
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *(c.params for c in children))
+        child_fn = children[0].apply_fn
+
+        def fused(params, x, _combine=combine_fn, _cp=combine_params):
+            ys = jax.vmap(child_fn, in_axes=(0, None))(params["members"], x)
+            return _combine(_cp, [ys[i] for i in range(ys.shape[0])])
+
+        params = {"members": stacked}
+    else:
+        child_fns = [c.apply_fn for c in children]
+
+        def fused(params, x, _fns=tuple(child_fns), _combine=combine_fn, _cp=combine_params):
+            ys = [f(p, x) for f, p in zip(_fns, params["members"])]
+            return _combine(_cp, ys)
+
+        params = {"members": [c.params for c in children]}
+
+    names = next((c.class_names for c in children if c.class_names), ())
+    shape = next((c.feature_shape for c in children if c.feature_shape), None)
+    return _PureSubtree(
+        apply_fn=fused,
+        params=params,
+        class_names=names,
+        feature_shape=shape,
+        n_models=sum(c.n_models for c in children),
+    )
+
+
+class FusedUnit(JaxModelUnit):
+    """A whole pure subtree collapsed into one ModelRuntime."""
+
+
+def fuse_graph(root: Node, tpu_cfg=None, mesh=None) -> Node:
+    """Replace fusable subtrees with single FusedUnit leaves. Applied
+    top-down: the largest pure island wins. No-op when nothing fuses."""
+
+    sub = _collect(root)
+    if sub is not None and sub.n_models > 1:
+        dtype = jnp.float32
+        if tpu_cfg is not None:
+            dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}.get(
+                getattr(tpu_cfg, "dtype", "float32"), jnp.float32
+            )
+        runtime = ModelRuntime(
+            sub.apply_fn,
+            sub.params,
+            mesh=mesh,
+            buckets=tuple(getattr(tpu_cfg, "batch_buckets", ()) or ())
+            if tpu_cfg is not None
+            else (),
+            max_batch=getattr(tpu_cfg, "max_batch", 64) if tpu_cfg is not None else 64,
+            dtype=dtype,
+            class_names=sub.class_names,
+            donate=False,
+        )
+        if sub.feature_shape is not None:
+            runtime.feature_shape = sub.feature_shape
+        spec = PredictiveUnit.model_validate(
+            {"name": root.name, "type": PredictiveUnitType.MODEL.value}
+        )
+        unit = FusedUnit(spec, runtime)
+        # requestPath observability: member names survive in the image
+        # (per-member trace spans / unit timers do NOT exist for a fused
+        # island — that is the documented trade-off of fuse_graph=true)
+        members = ",".join(n.name for n in root.walk() if n is not root)
+        unit.image = f"fused[{members}]" if len(members) <= 120 else f"fused:{sub.n_models}-models"
+        return Node(spec=spec, unit=unit, children=[])
+
+    new_children = [fuse_graph(c, tpu_cfg, mesh) for c in root.children]
+    if new_children != root.children:
+        return Node(spec=root.spec, unit=root.unit, children=new_children)
+    return root

@@ -126,6 +126,12 @@ class TpuSpec(_Spec):
     # False -> per-request isolation: a ROUTER decides per request exactly
     # like the reference engine, at the cost of per-request graph calls
     batch_across_requests: bool = True
+    # compile pure all-JAX subtrees (e.g. combiner ensembles) into one XLA
+    # program (engine/fused.py). Trade-off: a fused island reports ONE
+    # requestPath entry / trace span / unit timer (named fused[members])
+    # instead of per-member entries — set False to keep per-node execution
+    # and per-member observability
+    fuse_graph: bool = True
     dtype: str = "float32"  # computation dtype: float32 | bfloat16
     # donation only pays when output aliases input shape (e.g. transformers);
     # classifier heads change shape, so default off

@@ -70,40 +70,51 @@ def _dense_init(key, n_in: int, n_out: int):
     }
 
 
+# apply fns are MODULE-LEVEL (not per-build closures) so two builds of the
+# same architecture share function identity — that is what lets the fused
+# ensemble compiler (engine/fused.py) stack their params and vmap once.
+
+
+def _apply_logistic(p, x):
+    return jax.nn.softmax(x @ p["w"] + p["b"], axis=-1)
+
+
+def _apply_mlp2(p, x):
+    h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
+    return jax.nn.softmax(h @ p["l2"]["w"] + p["l2"]["b"], axis=-1)
+
+
+def _apply_mean_sigmoid(p, x):
+    return jax.nn.sigmoid(jnp.mean(x, axis=-1, keepdims=True))
+
+
+def _apply_mlp3_flat(p, x):
+    x = x.reshape((x.shape[0], -1))
+    h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
+    h = jax.nn.relu(h @ p["l2"]["w"] + p["l2"]["b"])
+    return jax.nn.softmax(h @ p["l3"]["w"] + p["l3"]["b"], axis=-1)
+
+
 @register_model("iris_logistic")
 def build_iris_logistic(seed: int = 0, **_) -> ModelSpec:
     """Logistic head, 4 features -> 3 classes — the sklearn-iris-equivalent
     (reference examples/models/sklearn_iris/IrisClassifier.py)."""
     params = _dense_init(jax.random.key(seed), 4, 3)
-
-    def apply(p, x):
-        return jax.nn.softmax(x @ p["w"] + p["b"], axis=-1)
-
-    return ModelSpec(apply, params, (4,), ("setosa", "versicolor", "virginica"))
+    return ModelSpec(_apply_logistic, params, (4,), ("setosa", "versicolor", "virginica"))
 
 
 @register_model("iris_mlp")
 def build_iris_mlp(seed: int = 0, hidden: int = 32, **_) -> ModelSpec:
     k1, k2 = jax.random.split(jax.random.key(seed))
     params = {"l1": _dense_init(k1, 4, hidden), "l2": _dense_init(k2, hidden, 3)}
-
-    def apply(p, x):
-        h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
-        return jax.nn.softmax(h @ p["l2"]["w"] + p["l2"]["b"], axis=-1)
-
-    return ModelSpec(apply, params, (4,), ("setosa", "versicolor", "virginica"))
+    return ModelSpec(_apply_mlp2, params, (4,), ("setosa", "versicolor", "virginica"))
 
 
 @register_model("mean_classifier")
 def build_mean_classifier(**_) -> ModelSpec:
     """Parity with reference examples/models/mean_classifier/MeanClassifier.py:
     sigmoid of the feature mean -> single score."""
-    params = {}
-
-    def apply(p, x):
-        return jax.nn.sigmoid(jnp.mean(x, axis=-1, keepdims=True))
-
-    return ModelSpec(apply, params, (4,), ("proba",))
+    return ModelSpec(_apply_mean_sigmoid, {}, (4,), ("proba",))
 
 
 @register_model("mnist_mlp")
@@ -116,14 +127,7 @@ def build_mnist_mlp(seed: int = 0, hidden: int = 512, **_) -> ModelSpec:
         "l2": _dense_init(keys[1], hidden, hidden),
         "l3": _dense_init(keys[2], hidden, 10),
     }
-
-    def apply(p, x):
-        x = x.reshape((x.shape[0], -1))
-        h = jax.nn.relu(x @ p["l1"]["w"] + p["l1"]["b"])
-        h = jax.nn.relu(h @ p["l2"]["w"] + p["l2"]["b"])
-        return jax.nn.softmax(h @ p["l3"]["w"] + p["l3"]["b"], axis=-1)
-
-    return ModelSpec(apply, params, (784,), tuple(str(i) for i in range(10)))
+    return ModelSpec(_apply_mlp3_flat, params, (784,), tuple(str(i) for i in range(10)))
 
 
 def _register_heavy_models() -> None:

@@ -1,0 +1,228 @@
+"""Graph fusion: pure combiner subtrees compile to one XLA program and
+match the unfused executor numerically."""
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.core.codec_json import message_from_dict
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.engine.fused import FusedUnit
+from seldon_core_tpu.graph.spec import PredictorSpec
+
+
+def _ensemble_predictor(models, fuse=True, extra_tpu=None):
+    tpu = {"fuse_graph": fuse, "max_batch": 8}
+    tpu.update(extra_tpu or {})
+    return PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "avg",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {
+                        "name": f"m{i}",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model_uri", "value": uri, "type": "STRING"}
+                        ],
+                    }
+                    for i, uri in enumerate(models)
+                ],
+            },
+            "tpu": tpu,
+        }
+    )
+
+
+MSG = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2], [4.9, 3.0, 1.4, 0.2]]}}
+
+
+async def test_homogeneous_ensemble_fuses_and_matches():
+    models = [f"zoo://iris_mlp?seed={i}" for i in range(3)]
+    fused_ex = build_executor(_ensemble_predictor(models, fuse=True))
+    plain_ex = build_executor(_ensemble_predictor(models, fuse=False))
+
+    # the whole subtree collapsed into one leaf
+    assert isinstance(fused_ex.root.unit, FusedUnit)
+    assert not fused_ex.root.children
+    assert fused_ex.root.unit.image == "fused[m0,m1,m2]"
+    assert not isinstance(plain_ex.root.unit, FusedUnit)
+
+    out_f = await fused_ex.execute(message_from_dict(MSG))
+    out_p = await plain_ex.execute(message_from_dict(MSG))
+    np.testing.assert_allclose(
+        np.asarray(out_f.array), np.asarray(out_p.array), rtol=1e-5, atol=1e-6
+    )
+    assert out_f.names == out_p.names
+
+
+async def test_heterogeneous_ensemble_fuses_and_matches():
+    models = ["zoo://iris_mlp?seed=0", "zoo://iris_logistic?seed=1"]
+    fused_ex = build_executor(_ensemble_predictor(models, fuse=True))
+    plain_ex = build_executor(_ensemble_predictor(models, fuse=False))
+    assert isinstance(fused_ex.root.unit, FusedUnit)
+    out_f = await fused_ex.execute(message_from_dict(MSG))
+    out_p = await plain_ex.execute(message_from_dict(MSG))
+    np.testing.assert_allclose(
+        np.asarray(out_f.array), np.asarray(out_p.array), rtol=1e-5, atol=1e-6
+    )
+
+
+async def test_router_subtree_never_fuses():
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "ab",
+                "type": "ROUTER",
+                "implementation": "RANDOM_ABTEST",
+                "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+                "children": [
+                    {
+                        "name": "m0",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_mlp", "type": "STRING"}
+                        ],
+                    },
+                    {
+                        "name": "m1",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_logistic", "type": "STRING"}
+                        ],
+                    },
+                ],
+            },
+        }
+    )
+    ex = build_executor(pred)
+    assert not isinstance(ex.root.unit, FusedUnit)
+    assert len(ex.root.children) == 2
+    out = await ex.execute(message_from_dict(MSG))
+    assert "ab" in out.meta.routing  # per-request routing preserved
+
+
+async def test_nested_combiner_under_router_fuses_island():
+    """The fused island sits below the router: router stays host-side, each
+    branch's ensemble becomes one program."""
+    ensemble = {
+        "name": "avg0",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {
+                "name": "n0",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model_uri", "value": "zoo://iris_mlp?seed=0", "type": "STRING"}
+                ],
+            },
+            {
+                "name": "n1",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model_uri", "value": "zoo://iris_mlp?seed=1", "type": "STRING"}
+                ],
+            },
+        ],
+    }
+    single = {
+        "name": "solo",
+        "type": "MODEL",
+        "implementation": "JAX_MODEL",
+        "parameters": [{"name": "model", "value": "iris_logistic", "type": "STRING"}],
+    }
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "ab",
+                "type": "ROUTER",
+                "implementation": "RANDOM_ABTEST",
+                "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+                "children": [ensemble, single],
+            },
+        }
+    )
+    ex = build_executor(pred)
+    assert isinstance(ex.root.children[0].unit, FusedUnit)  # ensemble fused
+    assert not isinstance(ex.root.children[1].unit, FusedUnit)  # leaf stays
+    out = await ex.execute(message_from_dict(MSG))
+    assert np.asarray(out.array).shape == (2, 3)
+
+
+async def test_homogeneous_ensemble_takes_vmap_path():
+    """Same-architecture members must share apply-fn identity (module-level
+    zoo fns), so fusion stacks params on an ensemble axis."""
+    import jax
+
+    models = [f"zoo://iris_mlp?seed={i}" for i in range(3)]
+    ex = build_executor(_ensemble_predictor(models, fuse=True))
+    params = ex.root.unit.runtime.params
+    members = params["members"]
+    # stacked pytree (dict with leading ensemble axis), not a list of trees
+    assert isinstance(members, dict)
+    leaves = jax.tree.leaves(members)
+    assert all(l.shape[0] == 3 for l in leaves)
+
+
+async def test_model_with_children_does_not_fuse():
+    """A MODEL unit with children is a chain, not a combiner — fusing it
+    would apply the parent to a list of child outputs (inverted graph)."""
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "avg",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {
+                        "name": "chain-head",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_mlp", "type": "STRING"}
+                        ],
+                        "children": [
+                            {
+                                "name": "inner",
+                                "type": "MODEL",
+                                "implementation": "JAX_MODEL",
+                                "parameters": [
+                                    {"name": "model", "value": "mean_classifier", "type": "STRING"}
+                                ],
+                            }
+                        ],
+                    },
+                    {
+                        "name": "leaf",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "mean_classifier", "type": "STRING"}
+                        ],
+                    },
+                ],
+            },
+        }
+    )
+    fused_ex = build_executor(pred)  # fuse_graph default True
+    assert not isinstance(fused_ex.root.unit, FusedUnit)  # chain blocks fusion
+    plain_ex = build_executor(
+        pred.model_copy(update={"tpu": pred.tpu.model_copy(update={"fuse_graph": False})})
+    )
+    msg = {"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}}
+    out_f = await fused_ex.execute(message_from_dict(msg))
+    out_p = await plain_ex.execute(message_from_dict(msg))
+    np.testing.assert_allclose(
+        np.asarray(out_f.array), np.asarray(out_p.array), rtol=1e-6
+    )
