@@ -107,6 +107,85 @@ class ReconcileResult:
     message: str = ""
 
 
+def deployment_param_bytes(services: dict) -> int:
+    """HBM actually held by a deployment's model parameters (multi-tenancy
+    accounting — SURVEY §7: many deployments share one slice's HBM, a
+    problem the reference's pod-per-deployment design never had)."""
+    import jax
+
+    total = 0
+    for svc in services.values():
+        executor = getattr(svc, "executor", None)
+        if executor is None:
+            continue
+        for unit in executor.units():
+            runtime = getattr(unit, "runtime", None)
+            if runtime is not None:
+                total += sum(
+                    leaf.nbytes
+                    for leaf in jax.tree.leaves(runtime.params)
+                    if hasattr(leaf, "nbytes")
+                )
+    return total
+
+
+def estimate_deployment_bytes(dep: SeldonDeployment) -> int:
+    """Pre-build HBM estimate: construct each JAX_MODEL's params HOST-side
+    (zoo builders init in numpy — nothing touches the device) and sum bytes
+    at the predictor's serving dtype. Used for admission control BEFORE the
+    real build device_puts anything, so an over-budget model can never OOM
+    the tenants already serving."""
+    from seldon_core_tpu.graph.spec import (
+        PredictiveUnitImplementation,
+        parameters_dict,
+    )
+    from seldon_core_tpu.models import zoo
+
+    total = 0
+    for pred in dep.spec.predictors:
+        dtype_factor = 0.5 if pred.tpu.dtype == "bfloat16" else 1.0
+        containers = {c.name: c for c in pred.componentSpec.containers}
+        for unit in pred.graph.walk():
+            uri = None
+            if unit.implementation == PredictiveUnitImplementation.JAX_MODEL:
+                params = parameters_dict(unit.parameters)
+                uri = params.get("model_uri") or (
+                    f"zoo://{params['model']}" if "model" in params else None
+                )
+            if uri is None:
+                c = containers.get(unit.name)
+                uri = getattr(c, "model_uri", "") or None
+            if not uri:
+                continue
+            try:
+                if uri.startswith("zoo://"):
+                    name, kwargs = zoo._parse_zoo_uri(uri)
+                    ms = zoo.get_model(name, **kwargs)
+                elif uri.startswith("file://"):
+                    from seldon_core_tpu.persistence.checkpoint import restore_model
+
+                    ms = restore_model(uri[len("file://") :])
+                else:
+                    continue
+            except Exception:  # noqa: BLE001 - let the real build surface it
+                continue
+            import numpy as np
+
+            total += int(
+                sum(
+                    np.asarray(leaf).nbytes * dtype_factor
+                    for leaf in _tree_leaves(ms.params)
+                )
+            )
+    return total
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
 class DeploymentManager:
     """Reconciles SeldonDeployment resources into running state.
 
@@ -124,6 +203,7 @@ class DeploymentManager:
         service_factory: Optional[Callable] = None,
         state_store_url: str = "",
         state_period_s: float = 60.0,
+        hbm_budget_bytes: int | None = None,
     ):
         self.store = store
         self.backend = backend
@@ -131,6 +211,11 @@ class DeploymentManager:
         self._service_factory = service_factory or self._default_service_factory
         self.state_store_url = state_store_url
         self.state_period_s = state_period_s
+        # None -> unlimited; set to (a fraction of) the slice's HBM so a new
+        # deployment that would not fit is rejected instead of OOM-killing
+        # every deployment already serving
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self._hbm_bytes: dict[str, int] = {}
         self._cache: dict[str, str] = {}  # name -> spec hash
         self._failed: dict[str, str] = {}  # FAILED latch: name -> failed spec hash
         self._running: dict[str, RunningDeployment] = {}
@@ -233,23 +318,41 @@ class DeploymentManager:
         try:
             dep = default_deployment(dep)
             validate_deployment(dep)
+        except Exception as e:  # noqa: BLE001 - invalid spec latches FAILED
+            self._failed[name] = h
+            self._write_rejected_status(name, str(e))
+            log.warning("deployment %s failed reconcile: %s", name, e)
+            return ReconcileResult(name, "failed", str(e))
+
+        # HBM admission control runs BEFORE the build: the estimate is host-
+        # side numpy only, so an over-budget model never touches the device
+        # (building first would OOM the tenants already serving). During an
+        # update both versions are briefly resident, so the deployment's own
+        # bytes are NOT excluded — the swap itself needs the headroom.
+        if self.hbm_budget_bytes is not None:
+            incoming = estimate_deployment_bytes(dep)
+            resident = sum(self._hbm_bytes.values())
+            if resident + incoming > self.hbm_budget_bytes:
+                # no FAILED latch: this is a resource condition, not a spec
+                # defect — once another tenant is deleted the same spec must
+                # reconcile successfully (k8s Pending-pod semantics)
+                msg = (
+                    f"insufficient HBM: deployment needs {incoming} B "
+                    f"(swap headroom included), "
+                    f"{self.hbm_budget_bytes - resident} B free of "
+                    f"{self.hbm_budget_bytes} B budget"
+                )
+                self._write_rejected_status(name, msg)
+                log.warning("deployment %s rejected: %s", name, msg)
+                return ReconcileResult(name, "failed", msg)
+
+        try:
             services = {
                 p.name: self._service_factory(dep, p) for p in dep.spec.predictors
             }
-        except Exception as e:  # noqa: BLE001 - ValidationError and any
-            # unit/model build failure latch the deployment FAILED
+        except Exception as e:  # noqa: BLE001 - unit/model build failure
             self._failed[name] = h
-            if name in self._running:
-                # the previous version keeps serving: state stays Available,
-                # the rejected update is surfaced in the description
-                st = self._write_available_status(name, self._running[name].dep)
-                self._status[name] = st.model_copy(
-                    update={"description": f"update rejected: {e}"}
-                )
-            else:
-                self._status[name] = DeploymentStatus(
-                    state="FAILED", description=str(e)
-                )
+            self._write_rejected_status(name, str(e))
             log.warning("deployment %s failed reconcile: %s", name, e)
             return ReconcileResult(name, "failed", str(e))
 
@@ -263,6 +366,7 @@ class DeploymentManager:
             old.flush_state()
         persister = self._make_persister(name, services)
         self._running[name] = RunningDeployment(dep, services, persister=persister)
+        self._hbm_bytes[name] = deployment_param_bytes(services)
         self._failed.pop(name, None)
         self._cache[name] = h
 
@@ -279,6 +383,18 @@ class DeploymentManager:
         # status writeback (reference DeploymentWatcher -> StatusUpdate)
         self._write_available_status(name, dep)
         return ReconcileResult(name, "updated" if existed else "created")
+
+    def _write_rejected_status(self, name: str, reason: str) -> None:
+        """A failed reconcile: when a previous version is running it keeps
+        serving (state Available, rejection surfaced in the description);
+        otherwise the deployment is FAILED."""
+        if name in self._running:
+            st = self._write_available_status(name, self._running[name].dep)
+            self._status[name] = st.model_copy(
+                update={"description": f"update rejected: {reason}"}
+            )
+        else:
+            self._status[name] = DeploymentStatus(state="FAILED", description=reason)
 
     def _write_available_status(self, name: str, dep: SeldonDeployment) -> DeploymentStatus:
         st = DeploymentStatus(
@@ -304,6 +420,7 @@ class DeploymentManager:
         self._cache.pop(name, None)
         self._failed.pop(name, None)
         self._status.pop(name, None)
+        self._hbm_bytes.pop(name, None)
         if running is None:
             return ReconcileResult(name, "unchanged", "not running")
         if self.backend is not None:
@@ -316,6 +433,15 @@ class DeploymentManager:
     # ------------------------------------------------------------ queries
     def status(self, name: str) -> DeploymentStatus | None:
         return self._status.get(name)
+
+    def hbm_usage(self) -> dict:
+        """Resident parameter bytes: {"deployments": {name: bytes},
+        "total": int, "budget": int | None}."""
+        return {
+            "deployments": dict(self._hbm_bytes),
+            "total": sum(self._hbm_bytes.values()),
+            "budget": self.hbm_budget_bytes,
+        }
 
     def names(self) -> list[str]:
         return sorted(self._running)

@@ -48,6 +48,8 @@ class Platform:
         token_store_url: str = "",
         audit_sink_url: str = "",
         metrics_enabled: bool = True,
+        state_store_url: str = "",
+        hbm_budget_bytes: int | None = None,
     ):
         self.metrics = get_metrics(metrics_enabled)
         self.oauth = OAuthProvider(token_store=make_token_store(token_store_url))
@@ -60,7 +62,13 @@ class Platform:
             audit=make_audit_sink(audit_sink_url),
             metrics=self.metrics,
         )
-        self.manager = DeploymentManager(store=self.store, backend=self.backend)
+        self.manager = DeploymentManager(
+            store=self.store,
+            backend=self.backend,
+            metrics=self.metrics,
+            state_store_url=state_store_url,
+            hbm_budget_bytes=hbm_budget_bytes,
+        )
 
     def build_app(self) -> web.Application:
         app = build_gateway_app(self.gateway)
@@ -100,6 +108,10 @@ async def _amain(args) -> None:
     platform = Platform(
         token_store_url=args.token_store,
         audit_sink_url=args.audit_sink,
+        state_store_url=args.state_store,
+        hbm_budget_bytes=int(args.hbm_budget_gb * (1 << 30))
+        if args.hbm_budget_gb
+        else None,
     )
     for path in args.apply or []:
         import json as _json
@@ -137,6 +149,13 @@ def main() -> None:
     parser.add_argument("--apply", nargs="*", help="CR JSON files to apply at boot")
     parser.add_argument("--token-store", default="", help="'' | file://p | redis://h")
     parser.add_argument("--audit-sink", default="", help="'' | mem:// | file://d | kafka://h")
+    parser.add_argument("--state-store", default="", help="'' | file://d | redis://h (router state)")
+    parser.add_argument(
+        "--hbm-budget-gb",
+        type=float,
+        default=0.0,
+        help="reject deployments whose params would exceed this HBM budget (0 = unlimited)",
+    )
     parser.add_argument("--no-grpc", action="store_true")
     args = parser.parse_args()
     if args.no_grpc:

@@ -292,3 +292,69 @@ def test_single_chip_mesh_still_requests_tpu():
     assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "1x1"
     container = pod["containers"][0]
     assert container["resources"]["limits"]["google.com/tpu"] == "1"
+
+
+def test_hbm_budget_admission_control():
+    from seldon_core_tpu.operator.reconciler import deployment_param_bytes
+
+    # measure one iris deployment, then set a budget that fits exactly one
+    probe = DeploymentManager()
+    probe.apply(_cr("probe"))
+    one = probe.hbm_usage()["deployments"]["probe"]
+    assert one > 0
+
+    m = DeploymentManager(hbm_budget_bytes=int(one * 1.5))
+    assert m.apply(_cr("first", oauth_key="kA")).action == "created"
+    r = m.apply(_cr("second", oauth_key="kB"))
+    assert r.action == "failed"
+    assert "insufficient HBM" in r.message
+    assert m.status("second").state == "FAILED"
+    assert m.names() == ["first"]  # first tenant untouched
+
+    # deleting frees budget; the second deployment then fits
+    m.delete("first")
+    assert m.apply(_cr("second", oauth_key="kB")).action == "created"
+    usage = m.hbm_usage()
+    assert usage["total"] == usage["deployments"]["second"]
+    assert usage["budget"] == int(one * 1.5)
+
+
+def test_hbm_rejected_update_keeps_serving():
+    probe = DeploymentManager()
+    probe.apply(_cr("p0"))
+    one = probe.hbm_usage()["deployments"]["p0"]
+
+    m = DeploymentManager(hbm_budget_bytes=int(one * 1.5))
+    m.apply(_cr("dep"))
+    # an update to a bigger model that exceeds the budget is rejected...
+    r = m.apply(_cr("dep", model="mnist_mlp"))
+    assert r.action == "failed" and "insufficient HBM" in r.message
+    # ...but the running version stays Available and keeps serving
+    st = m.status("dep")
+    assert st.state == "Available"
+    assert "update rejected" in st.description
+    assert m.get("dep") is not None
+
+
+def test_concurrent_apply_delete_stress():
+    """apply/delete from many threads must stay consistent (the reconcile
+    lock) — the multi-writer shape of control API + dir watcher."""
+    import concurrent.futures
+
+    m = DeploymentManager()
+
+    def worker(i):
+        name = f"dep{i % 4}"
+        r = m.apply(_cr(name, oauth_key=f"k{i % 4}"))
+        assert r.action in ("created", "updated", "unchanged")
+        if i % 3 == 0:
+            m.delete(name)
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(worker, range(32)))
+    assert all(results)
+    # invariant: every running deployment has status + hbm accounting
+    for name in m.names():
+        assert m.status(name) is not None
+        assert name in m.hbm_usage()["deployments"]
