@@ -59,6 +59,11 @@ def _layer_init(rng, hidden, ffn):
     }
 
 
+# long sequences switch to the blockwise (flash-style) kernel: O(block)
+# memory instead of the O(s^2) score matrix; exactness is unchanged
+_FLASH_MIN_SEQ = 1024
+
+
 def _attention(p, x, num_heads):
     b, s, d = x.shape
     head = d // num_heads
@@ -69,9 +74,14 @@ def _attention(p, x, num_heads):
         return t.reshape(b, s, num_heads, head).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.asarray(head**0.5, x.dtype)
-    probs = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if s >= _FLASH_MIN_SEQ:
+        from seldon_core_tpu.ops.attention import blockwise_attention
+
+        ctx = blockwise_attention(q, k, v, block_size=512)
+    else:
+        from seldon_core_tpu.ops.attention import naive_attention
+
+        ctx = naive_attention(q, k, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
     return ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
 

@@ -157,3 +157,23 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_bert_long_sequence_uses_blockwise_and_matches():
+    """Sequences >= the flash threshold switch to blockwise attention; the
+    numerics must match the dense einsum path."""
+    from seldon_core_tpu.models import bert as bert_mod
+
+    ms = get_model("bert_tiny", max_len=1152, vocab=128)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (1, 1088)), jnp.int32)
+    long_out = np.asarray(ms.apply_fn(ms.params, ids))  # blockwise path
+
+    # force the dense path by raising the threshold
+    orig = bert_mod._FLASH_MIN_SEQ
+    bert_mod._FLASH_MIN_SEQ = 10**9
+    try:
+        dense_out = np.asarray(ms.apply_fn(ms.params, ids))
+    finally:
+        bert_mod._FLASH_MIN_SEQ = orig
+    np.testing.assert_allclose(long_out, dense_out, rtol=2e-4, atol=2e-5)
