@@ -27,6 +27,11 @@ from seldon_core_tpu.engine.units import ROUTE_ALL, Unit, UnitRegistry
 from seldon_core_tpu.graph.spec import PredictiveUnit, PredictiveUnitImplementation
 
 
+def _seeded_rng(seed) -> random.Random:
+    """seed=None -> OS entropy; any explicit seed (including 0) is honored."""
+    return random.Random(int(seed)) if seed is not None else random.Random()
+
+
 class SimpleModelUnit(Unit):
     """Constant-output test model (reference SimpleModelUnit.java:24-53:
     values [[0.1, 0.9, 0.5]], classNames c0,c1,c2; its 20 ms sleep is exposed
@@ -136,7 +141,7 @@ class EpsilonGreedyRouter(Unit):
     def __init__(self, spec: PredictiveUnit):
         super().__init__(spec)
         self.epsilon = float(self.params.get("epsilon", 0.1))
-        self._rng = random.Random(int(self.params.get("seed", 0)) or None)
+        self._rng = _seeded_rng(self.params.get("seed"))
         n = max(len(spec.children), 1)
         self.counts = [0] * n
         self.rewards = [0.0] * n
@@ -171,6 +176,37 @@ class EpsilonGreedyRouter(Unit):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+
+
+class FaultInjectorUnit(Unit):
+    """Chaos-testing transformer (no reference analogue — SURVEY §5.3 notes
+    'Fault injection: none'). Fails a configurable fraction of requests or
+    injects latency, so retry paths, alerts, and SLO dashboards can be
+    exercised without breaking a real model.
+
+    Parameters: ``fail_rate`` (0..1, default 0), ``delay_ms`` (fixed added
+    latency, default 0), ``seed``."""
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        self.fail_rate = float(self.params.get("fail_rate", 0.0))
+        self.delay_ms = float(self.params.get("delay_ms", 0.0))
+        self._rng = _seeded_rng(self.params.get("seed"))
+        self._lock = threading.Lock()
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if self.delay_ms > 0:
+            import asyncio
+
+            await asyncio.sleep(self.delay_ms / 1000.0)
+        with self._lock:
+            fail = self._rng.random() < self.fail_rate
+        if fail:
+            raise APIException(
+                ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                f"fault injected by unit '{self.name}'",
+            )
+        return msg
 
 
 class AverageCombinerUnit(Unit):
@@ -228,6 +264,10 @@ def register_builtins(registry: UnitRegistry) -> None:
     registry.register(
         PredictiveUnitImplementation.MEAN_TRANSFORMER,
         lambda spec, ctx: MeanTransformerUnit(spec),
+    )
+    registry.register(
+        PredictiveUnitImplementation.FAULT_INJECTOR,
+        lambda spec, ctx: FaultInjectorUnit(spec),
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

@@ -41,6 +41,7 @@ class PredictiveUnitImplementation(str, enum.Enum):
     JAX_MODEL = "JAX_MODEL"  # in-process jitted model from the model zoo
     MEAN_TRANSFORMER = "MEAN_TRANSFORMER"  # centering input transformer
     # (reference ships this as a container: examples/transformers/mean_transformer)
+    FAULT_INJECTOR = "FAULT_INJECTOR"  # chaos testing (reference has none)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -234,5 +235,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.EPSILON_GREEDY,
         PredictiveUnitImplementation.JAX_MODEL,
         PredictiveUnitImplementation.MEAN_TRANSFORMER,
+        PredictiveUnitImplementation.FAULT_INJECTOR,
     }
 )

@@ -234,3 +234,72 @@ async def test_jax_model_unit_from_zoo():
     assert arr.shape == (5, 3)
     np.testing.assert_allclose(arr.sum(axis=1), np.ones(5), rtol=1e-5)
     assert out.names == ("setosa", "versicolor", "virginica")
+
+
+async def test_fault_injector_unit():
+    """Chaos transformer: deterministic seeded failures with the reference
+    error envelope; rate 0 and 1 behave exactly."""
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    def pred(rate):
+        return PredictorSpec.model_validate(
+            {
+                "name": "p",
+                "graph": {
+                    "name": "chaos",
+                    "type": "TRANSFORMER",
+                    "implementation": "FAULT_INJECTOR",
+                    "parameters": [
+                        {"name": "fail_rate", "value": str(rate), "type": "FLOAT"}
+                    ],
+                    "children": [
+                        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+                    ],
+                },
+            }
+        )
+
+    ok = await build_executor(pred(0.0)).execute(
+        message_from_dict({"data": {"ndarray": [[1.0]]}})
+    )
+    assert ok.array is not None
+
+    with pytest.raises(APIException) as e:
+        await build_executor(pred(1.0)).execute(
+            message_from_dict({"data": {"ndarray": [[1.0]]}})
+        )
+    assert "fault injected" in str(e.value)
+
+
+async def test_fault_injector_seed_zero_is_deterministic():
+    from seldon_core_tpu.engine.builtin import FaultInjectorUnit
+    from seldon_core_tpu.graph.spec import PredictiveUnit
+
+    def make():
+        return FaultInjectorUnit(
+            PredictiveUnit.model_validate(
+                {
+                    "name": "c",
+                    "type": "TRANSFORMER",
+                    "implementation": "FAULT_INJECTOR",
+                    "parameters": [
+                        {"name": "fail_rate", "value": "0.5", "type": "FLOAT"},
+                        {"name": "seed", "value": "0", "type": "INT"},
+                    ],
+                }
+            )
+        )
+
+    async def sequence(unit, n=16):
+        out = []
+        msg = SeldonMessage.from_array(np.asarray([[1.0]]))
+        for _ in range(n):
+            try:
+                await unit.transform_input(msg)
+                out.append(0)
+            except APIException:
+                out.append(1)
+        return out
+
+    assert await sequence(make()) == await sequence(make())  # seed 0 honored
