@@ -69,14 +69,37 @@ class ModelRuntime:
             pspecs = param_pspecs if param_pspecs is not None else jax.tree.map(
                 lambda _: P(), params
             )
+
+            def to_mesh_spec(s) -> P:
+                # a model's PartitionSpecs may name axes this mesh doesn't
+                # have (TP specs on a data/seq-only mesh): those dimensions
+                # degrade to replicated instead of erroring
+                if not isinstance(s, P):
+                    return P()
+                axes = set(mesh.axis_names)
+
+                def keep(entry):
+                    if entry is None:
+                        return None
+                    if isinstance(entry, (tuple, list)):
+                        kept = tuple(a for a in entry if a in axes)
+                        return kept if kept else None
+                    return entry if entry in axes else None
+
+                return P(*(keep(e) for e in s))
+
             shardings = jax.tree.map(
-                lambda s: NamedSharding(mesh, s if isinstance(s, P) else P()),
+                lambda s: NamedSharding(mesh, to_mesh_spec(s)),
                 pspecs,
                 is_leaf=lambda x: isinstance(x, P) or x is None,
             )
             self.params = jax.device_put(params, shardings)
-            self._in_sharding = NamedSharding(mesh, P(data_axis))
-            self._out_sharding = NamedSharding(mesh, P(data_axis))
+            # batch axis shards over "data" when the mesh has it; a mesh
+            # without it (e.g. pure seq-parallel serving) replicates the
+            # batch and lets the apply's own collectives do the work
+            batch_spec = P(data_axis) if data_axis in mesh.axis_names else P()
+            self._in_sharding = NamedSharding(mesh, batch_spec)
+            self._out_sharding = NamedSharding(mesh, batch_spec)
             self._jit = jax.jit(
                 apply_fn,
                 in_shardings=(shardings, self._in_sharding),

@@ -64,7 +64,39 @@ def _layer_init(rng, hidden, ffn):
 _FLASH_MIN_SEQ = 1024
 
 
-def _attention(p, x, num_heads):
+def _default_attention(q, k, v):
+    """seq-length-adaptive: dense einsum below _FLASH_MIN_SEQ, blockwise
+    (flash-style, O(block) memory) above."""
+    if q.shape[2] >= _FLASH_MIN_SEQ:
+        from seldon_core_tpu.ops.attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, block_size=512)
+    from seldon_core_tpu.ops.attention import naive_attention
+
+    return naive_attention(q, k, v)
+
+
+def make_ring_attention(mesh, seq_axis: str = "seq"):
+    """Sequence-parallel attention impl for serving long contexts over a
+    mesh: K/V shards rotate over ICI (ops/ring_attention.py) so each device
+    holds O(seq/ring) of the sequence. Plug into build_bert_* via
+    attn_impl."""
+
+    def impl(q, k, v):
+        ring = mesh.shape[seq_axis]
+        if q.shape[2] % ring != 0:
+            # shapes are static at trace time: lengths the ring can't split
+            # evenly fall back to the length-adaptive single-device path
+            # instead of erroring the request
+            return _default_attention(q, k, v)
+        from seldon_core_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh, seq_axis=seq_axis)
+
+    return impl
+
+
+def _attention(p, x, num_heads, attn_impl=None):
     b, s, d = x.shape
     head = d // num_heads
     qkv = x @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
@@ -74,20 +106,13 @@ def _attention(p, x, num_heads):
         return t.reshape(b, s, num_heads, head).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    if s >= _FLASH_MIN_SEQ:
-        from seldon_core_tpu.ops.attention import blockwise_attention
-
-        ctx = blockwise_attention(q, k, v, block_size=512)
-    else:
-        from seldon_core_tpu.ops.attention import naive_attention
-
-        ctx = naive_attention(q, k, v)
+    ctx = (attn_impl or _default_attention)(q, k, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
     return ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
 
 
-def _layer_apply(p, x, num_heads):
-    x = _ln(p["ln1"], x + _attention(p, x, num_heads))
+def _layer_apply(p, x, num_heads, attn_impl=None):
+    x = _ln(p["ln1"], x + _attention(p, x, num_heads, attn_impl))
     h = jax.nn.gelu(x @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype))
     h = h @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
     return _ln(p["ln2"], x + h)
@@ -141,7 +166,7 @@ def bert_pspecs(params: dict) -> dict:
     }
 
 
-def bert_logits(params: dict, x: jax.Array) -> jax.Array:
+def bert_logits(params: dict, x: jax.Array, attn_impl=None) -> jax.Array:
     """x: token ids [batch, seq] (any numeric dtype) -> logits [batch, classes]."""
     ids = x.astype(jnp.int32)
     num_heads = _infer_heads(params)
@@ -149,7 +174,7 @@ def bert_logits(params: dict, x: jax.Array) -> jax.Array:
     h = params["tok_emb"][ids] + params["pos_emb"][: ids.shape[1]][None, :, :]
     h = _ln(params["ln_emb"], h.astype(compute_dtype))
     for lp in params["layers"]:
-        h = _layer_apply(lp, h, num_heads)
+        h = _layer_apply(lp, h, num_heads, attn_impl)
     cls = h[:, 0, :]  # [CLS] pooling
     return cls @ params["head"]["w"].astype(cls.dtype) + params["head"]["b"].astype(
         cls.dtype
@@ -161,9 +186,28 @@ def apply_bert(params: dict, x: jax.Array) -> jax.Array:
     return jax.nn.softmax(bert_logits(params, x), axis=-1)
 
 
+def make_apply_bert(attn_impl):
+    """apply_bert with a custom attention impl (e.g. make_ring_attention)."""
+
+    def apply(params, x):
+        return jax.nn.softmax(bert_logits(params, x, attn_impl), axis=-1)
+
+    return apply
+
+
 def _infer_heads(params: dict) -> int:
     hidden = params["layers"][0]["qkv"]["w"].shape[0]
     return max(1, hidden // 64)
+
+
+def _bert_apply_factory(mesh):
+    """Mesh-aware serving apply: a mesh with a "seq" axis turns on ring
+    attention (sequence parallelism) automatically; otherwise the default
+    length-adaptive attention runs under whatever data/TP sharding the mesh
+    provides."""
+    if mesh is not None and "seq" in getattr(mesh, "shape", {}):
+        return make_apply_bert(make_ring_attention(mesh))
+    return apply_bert
 
 
 @register_model("bert_base")
@@ -175,6 +219,7 @@ def build_bert_base(seed: int = 0, num_classes: int = 2, max_len: int = 512, **_
         (128,),  # default serving seq length; buckets handle the batch axis
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
+        apply_factory=_bert_apply_factory,
     )
 
 
@@ -205,4 +250,5 @@ def build_bert_tiny(
         (16,),
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
+        apply_factory=_bert_apply_factory,
     )

@@ -32,6 +32,10 @@ class ModelSpec:
     feature_shape: tuple[int, ...]
     class_names: tuple[str, ...] = ()
     param_pspecs: Any | None = None  # PartitionSpec pytree for tensor parallelism
+    # optional mesh-aware apply: called with the predictor's Mesh to build a
+    # sharded apply (e.g. ring attention over the "seq" axis); apply_fn
+    # remains the single-device/no-mesh path
+    apply_factory: Callable[[Any], Callable] | None = None
 
 
 Builder = Callable[..., ModelSpec]
@@ -145,8 +149,11 @@ def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[
         getattr(tpu_cfg, "dtype", "float32")
     ]
+    apply_fn = ms.apply_fn
+    if mesh is not None and ms.apply_factory is not None:
+        apply_fn = ms.apply_factory(mesh)
     rt = ModelRuntime(
-        ms.apply_fn,
+        apply_fn,
         ms.params,
         mesh=mesh,
         param_pspecs=ms.param_pspecs,

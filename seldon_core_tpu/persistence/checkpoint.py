@@ -56,4 +56,5 @@ def restore_model(path: str):
         ms.feature_shape,
         ms.class_names,
         param_pspecs=ms.param_pspecs,
+        apply_factory=ms.apply_factory,  # mesh-aware serving survives restore
     )
