@@ -18,6 +18,7 @@ XLA notes:
 
 from __future__ import annotations
 
+import logging
 import threading
 from functools import partial
 from typing import Any, Callable, Mapping, Sequence
@@ -32,6 +33,8 @@ from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.core.tensor import bucket_for, default_buckets, pad_batch
 from seldon_core_tpu.engine.units import Unit
 from seldon_core_tpu.graph.spec import PredictiveUnit
+
+log = logging.getLogger(__name__)
 
 ApplyFn = Callable[[Any, jax.Array], jax.Array]
 
@@ -70,6 +73,8 @@ class ModelRuntime:
                 lambda _: P(), params
             )
 
+            dropped_axes: set[str] = set()
+
             def to_mesh_spec(s) -> P:
                 # a model's PartitionSpecs may name axes this mesh doesn't
                 # have (TP specs on a data/seq-only mesh): those dimensions
@@ -83,8 +88,12 @@ class ModelRuntime:
                         return None
                     if isinstance(entry, (tuple, list)):
                         kept = tuple(a for a in entry if a in axes)
+                        dropped_axes.update(a for a in entry if a not in axes)
                         return kept if kept else None
-                    return entry if entry in axes else None
+                    if entry in axes:
+                        return entry
+                    dropped_axes.add(entry)
+                    return None
 
                 return P(*(keep(e) for e in s))
 
@@ -93,6 +102,16 @@ class ModelRuntime:
                 pspecs,
                 is_leaf=lambda x: isinstance(x, P) or x is None,
             )
+            if dropped_axes:
+                # a misspelled TP axis silently replicating every weight is
+                # an HBM multiplier the operator should know about
+                log.warning(
+                    "param shardings name axes %s missing from mesh %s — "
+                    "those dimensions are now REPLICATED (full param copy "
+                    "per device along the missing axis)",
+                    sorted(dropped_axes),
+                    dict(mesh.shape),
+                )
             self.params = jax.device_put(params, shardings)
             # batch axis shards over "data" when the mesh has it; a mesh
             # without it (e.g. pure seq-parallel serving) replicates the

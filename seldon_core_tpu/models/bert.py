@@ -200,13 +200,22 @@ def _infer_heads(params: dict) -> int:
     return max(1, hidden // 64)
 
 
+# memoized per mesh: fused.py detects homogeneous ensembles by apply-fn
+# IDENTITY, so two builds on the same mesh must get the same function object
+_RING_APPLY_CACHE: dict = {}
+
+
 def _bert_apply_factory(mesh):
     """Mesh-aware serving apply: a mesh with a "seq" axis turns on ring
     attention (sequence parallelism) automatically; otherwise the default
     length-adaptive attention runs under whatever data/TP sharding the mesh
     provides."""
     if mesh is not None and "seq" in getattr(mesh, "shape", {}):
-        return make_apply_bert(make_ring_attention(mesh))
+        fn = _RING_APPLY_CACHE.get(mesh)
+        if fn is None:
+            fn = make_apply_bert(make_ring_attention(mesh))
+            _RING_APPLY_CACHE[mesh] = fn
+        return fn
     return apply_bert
 
 

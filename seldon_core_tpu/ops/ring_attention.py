@@ -29,7 +29,7 @@ from seldon_core_tpu.ops.attention import NEG_INF, _block_stats, combine_stats
 _shard_map = jax.shard_map  # jax>=0.7 top-level export
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, seq_per_dev: int):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, seq_per_dev: int, vary_axes: tuple):
     """Per-device body (runs under shard_map). q,k,v: local shards
     [b, h, s_local, d]."""
     b, h, s, d = q.shape
@@ -55,11 +55,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, seq_per_dev:
         return (m_acc, l_acc, o_acc, k_nxt, v_nxt), None
 
     # constants created inside shard_map are axis-invariant; the carry must
-    # be marked varying over the ring axis to match the loop outputs
+    # be marked varying over EVERY manual axis the inputs vary over (on a
+    # mixed data+seq mesh that includes the batch axis) to match the loop
+    # outputs
     init = (
-        pvary(jnp.full((b, h, s), NEG_INF, q.dtype), (axis_name,)),
-        pvary(jnp.zeros((b, h, s), q.dtype), (axis_name,)),
-        pvary(jnp.zeros((b, h, s, d), q.dtype), (axis_name,)),
+        pvary(jnp.full((b, h, s), NEG_INF, q.dtype), vary_axes),
+        pvary(jnp.zeros((b, h, s), q.dtype), vary_axes),
+        pvary(jnp.zeros((b, h, s, d), q.dtype), vary_axes),
         k,
         v,
     )
@@ -74,17 +76,21 @@ def ring_attention(
     mesh: Mesh,
     *,
     seq_axis: str = "seq",
+    data_axis: str = "data",
     causal: bool = False,
 ) -> jax.Array:
     """q,k,v: [batch, heads, seq, head_dim] GLOBAL arrays (or already
     sharded); returns attention output sharded the same way. seq must divide
-    evenly by the mesh's seq-axis size."""
+    evenly by the mesh's seq-axis size. On a mixed data+seq mesh the batch
+    dim shards over ``data_axis`` too — otherwise every device in the data
+    group would recompute attention for the full batch."""
     seq = q.shape[2]
     ring = mesh.shape[seq_axis]
     if seq % ring != 0:
         raise ValueError(f"seq {seq} not divisible by ring size {ring}")
     seq_per_dev = seq // ring
-    spec = P(None, None, seq_axis, None)
+    batch_entry = data_axis if data_axis in mesh.shape else None
+    spec = P(batch_entry, None, seq_axis, None)
 
     fn = _shard_map(
         partial(
@@ -92,6 +98,7 @@ def ring_attention(
             axis_name=seq_axis,
             causal=causal,
             seq_per_dev=seq_per_dev,
+            vary_axes=tuple(mesh.axis_names),
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
