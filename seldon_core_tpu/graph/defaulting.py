@@ -22,6 +22,8 @@ import dataclasses
 
 from seldon_core_tpu.core.tensor import default_buckets
 from seldon_core_tpu.graph.spec import (
+    PredictiveUnitMethod,
+    bool_param,
     BUILTIN_IMPLEMENTATIONS,
     TYPE_METHODS,
     Endpoint,
@@ -47,8 +49,20 @@ def _default_unit(
     unit: PredictiveUnit, container_names: set[str], port_alloc: dict[str, int]
 ) -> PredictiveUnit:
     update: dict = {}
+    wants_finetune = any(
+        p.name == "finetune" and bool_param(p.typed_value()) for p in unit.parameters
+    )
     if unit.type is not None and not unit.methods:
-        update["methods"] = list(TYPE_METHODS.get(unit.type, ()))
+        methods = list(TYPE_METHODS.get(unit.type, ()))
+        # a fine-tuning model consumes labeled feedback: inject the method so
+        # the executor's feedback walk reaches it (routers get it from
+        # TYPE_METHODS already)
+        if wants_finetune and PredictiveUnitMethod.SEND_FEEDBACK not in methods:
+            methods.append(PredictiveUnitMethod.SEND_FEEDBACK)
+        update["methods"] = methods
+    elif wants_finetune and PredictiveUnitMethod.SEND_FEEDBACK not in unit.methods:
+        # explicit methods list: still reconcile, or the model never learns
+        update["methods"] = list(unit.methods) + [PredictiveUnitMethod.SEND_FEEDBACK]
     needs_endpoint = (
         not _has_builtin_impl(unit)
         and unit.name in container_names

@@ -97,6 +97,14 @@ def parameters_dict(params: list["Parameter"]) -> dict[str, Any]:
     return {p.name: p.typed_value() for p in params}
 
 
+def bool_param(value: Any) -> bool:
+    """Strict boolean coercion for parameters regardless of declared type:
+    the STRING value "false" must not count as enabled."""
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() in ("true", "1", "yes")
+
+
 class PredictiveUnit(_Spec):
     name: str
     children: list["PredictiveUnit"] = Field(default_factory=list)

@@ -210,6 +210,23 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
     if uri is None:
         raise ValueError(f"JAX_MODEL unit '{spec.name}' needs a model_uri parameter")
     runtime = build_runtime_from_uri(uri, context.get("tpu"), context.get("mesh"))
+    from seldon_core_tpu.graph.spec import bool_param
+
+    if bool_param(params.get("finetune", False)):
+        from seldon_core_tpu.graph.spec import TYPE_METHODS, PredictiveUnitMethod
+        from seldon_core_tpu.models.online import OnlineFinetuneModelUnit
+
+        effective = tuple(spec.methods) or TYPE_METHODS.get(spec.type, ())
+        if PredictiveUnitMethod.SEND_FEEDBACK not in effective:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "finetune=true on unit '%s' but SEND_FEEDBACK is not in its "
+                "methods — feedback will never reach it (run the spec "
+                "through defaulting, or add the method explicitly)",
+                spec.name,
+            )
+        return OnlineFinetuneModelUnit(spec, runtime)
     return JaxModelUnit(spec, runtime)
 
 

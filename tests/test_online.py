@@ -1,0 +1,240 @@
+"""Online fine-tuning from labeled serving feedback: the model itself learns
+(beyond the reference's bandit-arm statistics)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.core.codec_json import feedback_from_dict, message_from_dict
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.models.online import OnlineFinetuneModelUnit
+
+
+def _finetune_predictor(batch=8, lr=0.5):
+    return PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "clf",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "methods": ["TRANSFORM_INPUT", "SEND_FEEDBACK"],
+                "parameters": [
+                    {"name": "model", "value": "iris_logistic", "type": "STRING"},
+                    {"name": "finetune", "value": "true", "type": "BOOL"},
+                    {"name": "finetune_batch", "value": str(batch), "type": "INT"},
+                    {"name": "finetune_lr", "value": str(lr), "type": "FLOAT"},
+                    {"name": "finetune_optimizer", "value": "sgd", "type": "STRING"},
+                ],
+            },
+        }
+    )
+
+
+def _units(ex):
+    return {u.name: u for u in ex.units()}
+
+
+async def test_finetune_unit_wired_and_learns():
+    ex = build_executor(_finetune_predictor(batch=8, lr=0.5))
+    unit = _units(ex)["clf"]
+    assert isinstance(unit, OnlineFinetuneModelUnit)
+
+    # a fixed input the fresh model is unsure about; teach it class 2
+    x = [[1.0, 0.5, -0.5, 2.0]]
+    before = np.asarray(
+        (await ex.execute(message_from_dict({"data": {"ndarray": x}}))).array
+    )
+
+    for _ in range(4):  # 4 * 2 examples = 1 update at batch 8
+        fb = feedback_from_dict(
+            {
+                "request": {"data": {"ndarray": x * 2}},
+                "response": {},
+                "reward": 1.0,
+                "truth": {"data": {"ndarray": [[2], [2]]}},
+            }
+        )
+        await ex.send_feedback(fb)
+    assert unit._steps_taken >= 1
+
+    after = np.asarray(
+        (await ex.execute(message_from_dict({"data": {"ndarray": x}}))).array
+    )
+    assert after[0, 2] > before[0, 2]  # probability of the taught class rose
+
+
+async def test_finetune_accepts_onehot_truth():
+    ex = build_executor(_finetune_predictor(batch=2, lr=0.5))
+    unit = _units(ex)["clf"]
+    fb = feedback_from_dict(
+        {
+            "request": {"data": {"ndarray": [[1, 2, 3, 4], [4, 3, 2, 1]]}},
+            "response": {},
+            "reward": 1.0,
+            "truth": {"data": {"ndarray": [[0, 1, 0], [1, 0, 0]]}},
+        }
+    )
+    await ex.send_feedback(fb)
+    assert unit._steps_taken == 1
+
+
+async def test_finetune_ignores_malformed_feedback():
+    ex = build_executor(_finetune_predictor(batch=2))
+    unit = _units(ex)["clf"]
+    # no truth -> ignored; mismatched rows -> ignored
+    await ex.send_feedback(
+        feedback_from_dict(
+            {"request": {"data": {"ndarray": [[1, 2, 3, 4]]}}, "response": {}, "reward": 1.0}
+        )
+    )
+    await ex.send_feedback(
+        feedback_from_dict(
+            {
+                "request": {"data": {"ndarray": [[1, 2, 3, 4]]}},
+                "response": {},
+                "reward": 1.0,
+                "truth": {"data": {"ndarray": [[1], [2]]}},
+            }
+        )
+    )
+    assert unit._steps_taken == 0
+    assert len(unit._buffer_y) == 0
+
+
+async def test_finetune_state_persists(tmp_path):
+    """Learned weights + buffer survive a restart via the state persister."""
+    from seldon_core_tpu.persistence.state import FileStateStore, StatePersister
+
+    store = FileStateStore(str(tmp_path))
+    ex1 = build_executor(_finetune_predictor(batch=2, lr=0.5))
+    p1 = StatePersister(store, "dep", period_s=999)
+    p1.attach(ex1.units())
+    fb = feedback_from_dict(
+        {
+            "request": {"data": {"ndarray": [[1, 2, 3, 4], [1, 2, 3, 4]]}},
+            "response": {},
+            "reward": 1.0,
+            "truth": {"data": {"ndarray": [[2], [2]]}},
+        }
+    )
+    await ex1.send_feedback(fb)
+    unit1 = _units(ex1)["clf"]
+    assert unit1._steps_taken == 1
+    trained = np.asarray(
+        (await ex1.execute(message_from_dict({"data": {"ndarray": [[1, 2, 3, 4]]}}))).array
+    )
+    p1.persist_now()
+
+    ex2 = build_executor(_finetune_predictor(batch=2, lr=0.5))
+    p2 = StatePersister(store, "dep", period_s=999)
+    assert p2.attach(ex2.units()) == 1
+    restored = np.asarray(
+        (await ex2.execute(message_from_dict({"data": {"ndarray": [[1, 2, 3, 4]]}}))).array
+    )
+    np.testing.assert_allclose(restored, trained, rtol=1e-5)
+
+
+def test_defaulting_injects_send_feedback_for_finetune():
+    from seldon_core_tpu.graph.defaulting import default_deployment
+    from seldon_core_tpu.graph.spec import PredictiveUnitMethod, SeldonDeployment
+
+    dep = SeldonDeployment.from_dict(
+        {
+            "metadata": {"name": "d"},
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "clf",
+                            "type": "MODEL",
+                            "implementation": "JAX_MODEL",
+                            "parameters": [
+                                {"name": "model", "value": "iris_logistic", "type": "STRING"},
+                                {"name": "finetune", "value": "true", "type": "BOOL"},
+                            ],
+                        },
+                    }
+                ],
+            },
+        }
+    )
+    out = default_deployment(dep)
+    methods = out.spec.predictors[0].graph.methods
+    assert PredictiveUnitMethod.SEND_FEEDBACK in methods
+    assert PredictiveUnitMethod.TRANSFORM_INPUT in methods
+
+
+async def test_large_feedback_payload_drains_fully():
+    """Payloads bigger than finetune_batch must not grow the buffer without
+    bound: every full batch trains."""
+    ex = build_executor(_finetune_predictor(batch=4, lr=0.1))
+    unit = _units(ex)["clf"]
+    rows = [[1.0, 2.0, 3.0, 4.0]] * 10
+    fb = feedback_from_dict(
+        {
+            "request": {"data": {"ndarray": rows}},
+            "response": {},
+            "reward": 1.0,
+            "truth": {"data": {"ndarray": [[1]] * 10}},
+        }
+    )
+    await ex.send_feedback(fb)
+    assert unit._steps_taken == 2  # 10 rows / batch 4 -> 2 steps
+    assert len(unit._buffer_y) == 2  # remainder only
+
+
+def test_string_false_does_not_enable_finetune():
+    from seldon_core_tpu.models.online import OnlineFinetuneModelUnit
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "clf",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "iris_logistic", "type": "STRING"},
+                    {"name": "finetune", "value": "false", "type": "STRING"},
+                ],
+            },
+        }
+    )
+    ex = build_executor(pred)
+    assert not isinstance(_units(ex)["clf"], OnlineFinetuneModelUnit)
+
+
+def test_defaulting_reconciles_explicit_methods():
+    from seldon_core_tpu.graph.defaulting import default_deployment
+    from seldon_core_tpu.graph.spec import PredictiveUnitMethod, SeldonDeployment
+
+    dep = SeldonDeployment.from_dict(
+        {
+            "metadata": {"name": "d"},
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "clf",
+                            "type": "MODEL",
+                            "implementation": "JAX_MODEL",
+                            "methods": ["TRANSFORM_INPUT"],  # explicit, missing feedback
+                            "parameters": [
+                                {"name": "model", "value": "iris_logistic", "type": "STRING"},
+                                {"name": "finetune", "value": "true", "type": "BOOL"},
+                            ],
+                        },
+                    }
+                ],
+            },
+        }
+    )
+    out = default_deployment(dep)
+    assert PredictiveUnitMethod.SEND_FEEDBACK in out.spec.predictors[0].graph.methods
