@@ -1,0 +1,34 @@
+"""Example outlier-scoring user class (reference parity:
+examples/models/paysim_fraud_detector + the OUTLIER_DETECTOR wrapper tier,
+wrappers/python/outlier_detector_microservice.py:15-17).
+
+The reference fraud detector loads a fitted sklearn pipeline from disk and
+scores PaySim transactions. This example scores transactions against stored
+per-feature statistics (amount, oldBalance, newBalance) — a Mahalanobis-style
+max z-score, the same scoring shape the builtin OUTLIER_DETECTOR unit uses —
+so it runs with no model artifact.
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice FraudDetector REST \
+        --service-type OUTLIER_DETECTOR \
+        --model-dir examples/models/fraud_detector
+
+Every response carries meta.tags.outlierScore; the graph in
+examples/deployments/fraud_outlier.json runs the builtin equivalent ahead
+of a MODEL node.
+"""
+
+import numpy as np
+
+
+class FraudDetector:
+    def __init__(self, threshold=4.0):
+        # training-set stats for (amount, oldBalance, newBalance), pretend-fit
+        self.means = np.asarray([178197.0, 833883.0, 855113.0])
+        self.stds = np.asarray([603858.0, 2888243.0, 2924048.0])
+        self.threshold = float(threshold)
+
+    def score(self, X, feature_names):
+        """Single float per request: worst feature z-score in the batch."""
+        z = np.abs((np.asarray(X, np.float64) - self.means) / self.stds)
+        return float(np.max(z))

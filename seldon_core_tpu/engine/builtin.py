@@ -15,6 +15,7 @@ compiles into ONE XLA program with the models' matmuls batched for the MXU.
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import threading
 from typing import Sequence
@@ -30,6 +31,14 @@ from seldon_core_tpu.graph.spec import PredictiveUnit, PredictiveUnitImplementat
 def _seeded_rng(seed) -> random.Random:
     """seed=None -> OS entropy; any explicit seed (including 0) is honored."""
     return random.Random(int(seed)) if seed is not None else random.Random()
+
+
+def _parse_float_vec(unit_label: str, key: str, raw) -> np.ndarray:
+    """Comma-separated float vector parameter (a single value broadcasts)."""
+    try:
+        return np.asarray([float(v) for v in str(raw).strip().split(",")], np.float32)
+    except ValueError as e:
+        raise ValueError(f"{unit_label} bad '{key}' parameter: {e}") from e
 
 
 class SimpleModelUnit(Unit):
@@ -74,14 +83,9 @@ class MeanTransformerUnit(Unit):
             raise ValueError(
                 f"MEAN_TRANSFORMER '{spec.name}' requires a 'means' parameter"
             )
-        try:
-            self.means = np.asarray([float(v) for v in raw.split(",")], np.float32)
-        except ValueError as e:
-            raise ValueError(
-                f"MEAN_TRANSFORMER '{spec.name}' bad 'means' parameter: {e}"
-            ) from e
+        self.means = _parse_float_vec(f"MEAN_TRANSFORMER '{spec.name}'", "means", raw)
 
-    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+    def _center(self, msg: SeldonMessage) -> SeldonMessage:
         if msg.array is None:
             raise APIException(
                 ErrorCode.ENGINE_INVALID_RESPONSE,
@@ -95,6 +99,39 @@ class MeanTransformerUnit(Unit):
                 f"but input has {x.shape[-1]} features",
             )
         return msg.with_array(x - self.means, msg.names)
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        return self._center(msg)
+
+    # the same container serves either endpoint in the reference — which one
+    # runs is picked by the NODE type (PredictorConfigBean type->methods
+    # map:44-72), so an OUTPUT_TRANSFORMER-typed MEAN_TRANSFORMER centers
+    # the model output instead of the input
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        return self._center(msg)
+
+    def _pure_center(self):
+        name = self.name
+
+        def fn(means, x):
+            # shapes are static under jit, so this check runs at trace time
+            # (first predict per bucket) and surfaces the same structured
+            # error the unfused walker raises
+            if means.shape[0] not in (1, x.shape[-1]):
+                raise APIException(
+                    ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                    f"unit '{name}': means has {means.shape[0]} values "
+                    f"but input has {x.shape[-1]} features",
+                )
+            return x - means.astype(x.dtype)
+
+        return fn, self.means
+
+    def as_pure_input_fn(self):
+        return self._pure_center()
+
+    def as_pure_output_fn(self):
+        return self._pure_center()
 
 
 class RandomABTestUnit(Unit):
@@ -209,6 +246,57 @@ class FaultInjectorUnit(Unit):
         return msg
 
 
+class ZScoreOutlierUnit(Unit):
+    """Built-in outlier detector: scores each request by the max absolute
+    z-score of its features against stored training stats and writes
+    ``meta.tags.outlierScore`` (+ ``outlier`` bool when ``threshold`` is set),
+    passing the data through unchanged.
+
+    Parity: the reference's outlier tier is container-only — a transformer
+    microservice whose /transform-input calls user score() and tags the
+    request (wrappers/python/outlier_detector_microservice.py:40-50). This
+    builtin gives the engine an in-process detector for graphs that don't
+    need custom scoring code; custom scorers use the OUTLIER_DETECTOR
+    service type of serving/microservice.py instead.
+
+    Parameters: ``means``/``stds`` (comma-separated floats, broadcastable;
+    default 0/1), ``threshold`` (optional outlier cutoff)."""
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+
+        label = f"OUTLIER_DETECTOR '{spec.name}'"
+        self.means = _parse_float_vec(label, "means", self.params.get("means", "0"))
+        self.stds = _parse_float_vec(label, "stds", self.params.get("stds", "1"))
+        if np.any(self.stds <= 0):
+            raise ValueError(
+                f"OUTLIER_DETECTOR '{spec.name}': stds must be positive"
+            )
+        self.threshold = (
+            float(self.params["threshold"]) if "threshold" in self.params else None
+        )
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if msg.array is None:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_RESPONSE,
+                f"unit '{self.name}' needs tensor data",
+            )
+        x = np.asarray(msg.array, dtype=np.float32)
+        for name, vec in (("means", self.means), ("stds", self.stds)):
+            if vec.size not in (1, x.shape[-1]):
+                raise APIException(
+                    ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                    f"unit '{self.name}': {name} has {vec.size} values "
+                    f"but input has {x.shape[-1]} features",
+                )
+        score = float(np.max(np.abs((x - self.means) / self.stds)))
+        tags = {**msg.meta.tags, "outlierScore": score}
+        if self.threshold is not None:
+            tags["outlier"] = score > self.threshold
+        return msg.with_meta(dataclasses.replace(msg.meta, tags=tags))
+
+
 class AverageCombinerUnit(Unit):
     """Element-wise mean ensemble (reference AverageCombinerUnit.java:53-76).
     Shape mismatch across children is an error (reference AverageCombinerTest
@@ -268,6 +356,10 @@ def register_builtins(registry: UnitRegistry) -> None:
     registry.register(
         PredictiveUnitImplementation.FAULT_INJECTOR,
         lambda spec, ctx: FaultInjectorUnit(spec),
+    )
+    registry.register(
+        PredictiveUnitImplementation.OUTLIER_DETECTOR,
+        lambda spec, ctx: ZScoreOutlierUnit(spec),
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

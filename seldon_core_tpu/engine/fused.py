@@ -15,10 +15,13 @@ Two execution strategies, picked automatically:
 - traced ensemble: heterogeneous children trace sequentially into the same
   program (still one dispatch, XLA schedules them).
 
-Fusable units are those exposing ``as_pure_fn()`` (engine/units.py hook):
-JaxModelUnit leaves and AverageCombinerUnit interior nodes today. Routers
-and stateful/host units never fuse — the executor remains the correct
-fallback around the fused islands.
+Fusable units are those exposing a pure-fn hook (engine/units.py):
+``as_pure_fn`` (combiner aggregate), ``as_pure_input_fn`` /
+``as_pure_output_fn`` (transformer math). JaxModelUnit leaves, pure
+COMBINER interiors, and pure single-child TRANSFORMER / OUTPUT_TRANSFORMER
+interiors all fuse, so a transformer -> models -> combiner DAG compiles to
+one dispatch. Routers and stateful/host units never fuse — the executor
+remains the correct fallback around the fused islands.
 """
 
 from __future__ import annotations
@@ -29,10 +32,16 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 
-from seldon_core_tpu.engine.executor import Node
+from seldon_core_tpu.engine.executor import Node, _has_method
 from seldon_core_tpu.engine.units import Unit
-from seldon_core_tpu.graph.spec import PredictiveUnit, PredictiveUnitType
+from seldon_core_tpu.graph.spec import (
+    PredictiveUnit,
+    PredictiveUnitMethod,
+    PredictiveUnitType,
+)
 from seldon_core_tpu.models.base import JaxModelUnit, ModelRuntime
+
+_IDENTITY = "identity"
 
 
 @dataclass
@@ -42,10 +51,33 @@ class _PureSubtree:
     class_names: tuple[str, ...]
     feature_shape: tuple[int, ...] | None
     n_models: int
+    n_nodes: int  # dispatches the fused program saves (models + transforms)
+
+
+def _pure_transform(node: Node, method: PredictiveUnitMethod):
+    """Pure equivalent of the node's input/output transform under walker
+    dispatch: _IDENTITY when the walker would not run it (method absent for
+    the node type) or the unit inherits the base identity; (fn, params) when
+    the unit exposes a pure form; None when the transform is opaque Python
+    (blocks fusion)."""
+    if not _has_method(node, method):
+        return _IDENTITY
+    unit = node.unit
+    if method is PredictiveUnitMethod.TRANSFORM_INPUT:
+        pure = unit.as_pure_input_fn()
+        overridden = type(unit).transform_input is not Unit.transform_input
+    else:
+        pure = unit.as_pure_output_fn()
+        overridden = type(unit).transform_output is not Unit.transform_output
+    if pure is not None:
+        return pure
+    return None if overridden else _IDENTITY
 
 
 def _collect(node: Node) -> _PureSubtree | None:
-    """Bottom-up: a JaxModelUnit leaf or a pure combiner over pure children."""
+    """Bottom-up: a JaxModelUnit leaf, or a pure interior node — COMBINER
+    (pure aggregate) / single-child TRANSFORMER / OUTPUT_TRANSFORMER — whose
+    transforms are pure, over pure children."""
     unit = node.unit
     if not node.children:
         if isinstance(unit, JaxModelUnit):
@@ -56,18 +88,39 @@ def _collect(node: Node) -> _PureSubtree | None:
                 class_names=rt.class_names,
                 feature_shape=getattr(rt, "feature_shape", None),
                 n_models=1,
+                n_nodes=1,
             )
         return None
 
-    # only genuine COMBINER nodes fuse as interior nodes: a MODEL unit also
-    # exposes as_pure_fn, but its fn applies to the INPUT, not to a list of
-    # child outputs — treating it as a combiner would invert the graph
-    if node.spec.type != PredictiveUnitType.COMBINER:
+    # routers never fuse: routing is per-request host-side control flow
+    if _has_method(node, PredictiveUnitMethod.ROUTE):
         return None
-    pure = unit.as_pure_fn()
-    if pure is None:
+
+    # a MODEL unit with children is a chain head, not a combiner — its pure
+    # fn applies to the INPUT, not to a list of child outputs; fusing it as
+    # an interior node would invert the graph
+    interior_types = (
+        PredictiveUnitType.COMBINER,
+        PredictiveUnitType.TRANSFORMER,
+        PredictiveUnitType.OUTPUT_TRANSFORMER,
+    )
+    if node.spec.type not in interior_types:
         return None
-    combine_fn, combine_params = pure
+
+    t_in = _pure_transform(node, PredictiveUnitMethod.TRANSFORM_INPUT)
+    t_out = _pure_transform(node, PredictiveUnitMethod.TRANSFORM_OUTPUT)
+    if t_in is None or t_out is None:
+        return None
+
+    if _has_method(node, PredictiveUnitMethod.AGGREGATE):
+        pure = unit.as_pure_fn()
+        if pure is None:
+            return None
+        combine_fn, combine_params = pure
+    elif len(node.children) == 1:
+        combine_fn, combine_params = None, None  # pass-through
+    else:  # fan-out without aggregate is an executor error anyway
+        return None
 
     children = [_collect(c) for c in node.children]
     if any(c is None for c in children):
@@ -83,19 +136,41 @@ def _collect(node: Node) -> _PureSubtree | None:
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *(c.params for c in children))
         child_fn = children[0].apply_fn
 
-        def fused(params, x, _combine=combine_fn, _cp=combine_params):
-            ys = jax.vmap(child_fn, in_axes=(0, None))(params["members"], x)
-            return _combine(_cp, [ys[i] for i in range(ys.shape[0])])
+        def inner(params, x):
+            ys = jax.vmap(child_fn, in_axes=(0, None))(params, x)
+            return [ys[i] for i in range(ys.shape[0])]
 
-        params = {"members": stacked}
+        member_params = stacked
     else:
-        child_fns = [c.apply_fn for c in children]
+        child_fns = tuple(c.apply_fn for c in children)
 
-        def fused(params, x, _fns=tuple(child_fns), _combine=combine_fn, _cp=combine_params):
-            ys = [f(p, x) for f, p in zip(_fns, params["members"])]
-            return _combine(_cp, ys)
+        def inner(params, x, _fns=child_fns):
+            return [f(p, x) for f, p in zip(_fns, params)]
 
-        params = {"members": [c.params for c in children]}
+        member_params = [c.params for c in children]
+
+    params: dict[str, Any] = {"members": member_params}
+    if t_in is not _IDENTITY:
+        params["t_in"] = t_in[1]
+    if t_out is not _IDENTITY:
+        params["t_out"] = t_out[1]
+
+    def fused(
+        params,
+        x,
+        _inner=inner,
+        _combine=combine_fn,
+        _cp=combine_params,
+        _tin=None if t_in is _IDENTITY else t_in[0],
+        _tout=None if t_out is _IDENTITY else t_out[0],
+    ):
+        if _tin is not None:
+            x = _tin(params["t_in"], x)
+        ys = _inner(params["members"], x)
+        y = _combine(_cp, ys) if _combine is not None else ys[0]
+        if _tout is not None:
+            y = _tout(params["t_out"], y)
+        return y
 
     names = next((c.class_names for c in children if c.class_names), ())
     shape = next((c.feature_shape for c in children if c.feature_shape), None)
@@ -105,6 +180,7 @@ def _collect(node: Node) -> _PureSubtree | None:
         class_names=names,
         feature_shape=shape,
         n_models=sum(c.n_models for c in children),
+        n_nodes=sum(c.n_nodes for c in children) + 1,
     )
 
 
@@ -117,7 +193,7 @@ def fuse_graph(root: Node, tpu_cfg=None, mesh=None) -> Node:
     top-down: the largest pure island wins. No-op when nothing fuses."""
 
     sub = _collect(root)
-    if sub is not None and sub.n_models > 1:
+    if sub is not None and sub.n_nodes > 1:
         dtype = jnp.float32
         if tpu_cfg is not None:
             dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}.get(

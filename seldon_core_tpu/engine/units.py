@@ -78,9 +78,18 @@ class Unit:
     async def send_feedback(self, feedback: Feedback, routing: int) -> None:
         return None
 
-    # hook for the fused compiler (engine/fused.py): a unit that can express
+    # hooks for the fused compiler (engine/fused.py): a unit that can express
     # itself as a pure jax function returns (fn, params_pytree); others None.
+    # as_pure_fn: combiner aggregate — fn(params, [child_outputs]) -> y
     def as_pure_fn(self):
+        return None
+
+    # as_pure_input_fn: transform_input equivalent — fn(params, x) -> x'
+    def as_pure_input_fn(self):
+        return None
+
+    # as_pure_output_fn: transform_output equivalent — fn(params, y) -> y'
+    def as_pure_output_fn(self):
         return None
 
 
@@ -154,6 +163,37 @@ class PythonClassUnit(Unit):
             else None
         )
         await _maybe_await(fn(x, names, routing, feedback.reward, truth))
+
+
+class OutlierDetectorUnit(PythonClassUnit):
+    """Adapter for outlier-scoring user classes — the reference's fourth
+    microservice flavor (wrappers/python/outlier_detector_microservice.py:
+    40-50): the user class exposes ``score(X, feature_names)`` returning a
+    single float; transform_input passes the data through unchanged and
+    writes the score into ``meta.tags.outlierScore``. A per-row array score
+    is also accepted (stored as a list) — additive over the reference."""
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        fn = getattr(self.user, "score", None)
+        if fn is None:
+            return msg
+        if msg.array is None:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_RESPONSE,
+                f"unit '{self.name}' needs tensor data",
+            )
+        x = np.asarray(msg.array)
+        out = await _maybe_await(fn(x, list(msg.names)))
+        score = np.asarray(out, dtype=np.float64)
+        value: Any = (
+            float(score.reshape(-1)[0])
+            if score.size == 1
+            else [float(v) for v in score.reshape(-1)]
+        )
+        import dataclasses
+
+        tags = {**msg.meta.tags, "outlierScore": value}
+        return msg.with_meta(dataclasses.replace(msg.meta, tags=tags))
 
 
 UnitFactory = Callable[[PredictiveUnit, dict], Unit]

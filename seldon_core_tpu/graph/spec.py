@@ -42,6 +42,9 @@ class PredictiveUnitImplementation(str, enum.Enum):
     MEAN_TRANSFORMER = "MEAN_TRANSFORMER"  # centering input transformer
     # (reference ships this as a container: examples/transformers/mean_transformer)
     FAULT_INJECTOR = "FAULT_INJECTOR"  # chaos testing (reference has none)
+    OUTLIER_DETECTOR = "OUTLIER_DETECTOR"  # z-score request scorer writing
+    # meta.tags.outlierScore (reference ships the tier container-only:
+    # wrappers/python/outlier_detector_microservice.py:40-50)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -244,5 +247,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.JAX_MODEL,
         PredictiveUnitImplementation.MEAN_TRANSFORMER,
         PredictiveUnitImplementation.FAULT_INJECTOR,
+        PredictiveUnitImplementation.OUTLIER_DETECTOR,
     }
 )

@@ -8,9 +8,12 @@ apply function loaded straight into TPU HBM by ModelRuntime.
 TPU design notes:
 - NHWC layout with HWIO kernels — the layout XLA's TPU conv emitter expects;
   channels land on the 128-wide lane dimension of the MXU.
-- BatchNorm is inference-mode (running stats are parameters). The functional
-  training path (batch stats computed in-graph) lives in
-  seldon_core_tpu/training/steps.py so serving apply stays a single pure fn.
+- BatchNorm is inference-mode (running stats are parameters) and is FOLDED
+  into the preceding conv's weights at model-build time (fold_batchnorm) —
+  each conv+BN pair serves as conv+bias, removing the per-channel
+  scale/shift chain and the BN stats from HBM. The functional training path
+  (batch stats computed in-graph) lives in seldon_core_tpu/training/steps.py
+  so serving apply stays a single pure fn.
 - All FLOPs are convs/matmuls; elementwise (BN, relu, add) fuses into the
   preceding conv under XLA. bfloat16 params/activations are one dtype flag
   away (ModelRuntime dtype policy).
@@ -70,6 +73,74 @@ def _bn(x, p, eps=1e-5):
     return x * scale + shift
 
 
+def _norm(x, p, bn_key, bias_key):
+    """Post-conv normalisation: BN when unfolded, plain bias when folded.
+
+    Which branch runs is decided by pytree structure at trace time, so both
+    folded and unfolded params share the same jitted apply code.
+    """
+    if bn_key in p:
+        return _bn(x, p[bn_key])
+    return x + p[bias_key].astype(x.dtype)
+
+
+# (conv key, unfolded bn key, folded bias key) triples for one block
+_FOLD_KEYS = (
+    ("conv1", "bn1", "bias1"),
+    ("conv2", "bn2", "bias2"),
+    ("conv3", "bn3", "bias3"),
+    ("proj", "bn_proj", "bias_proj"),
+)
+
+
+def fold_batchnorm(params: dict, eps: float = 1e-5) -> dict:
+    """Fold inference-mode BN into the preceding conv's weights (host-side).
+
+    conv(x, W)*s + t  ==  conv(x, W*s) + t  for the per-output-channel BN
+    affine s = scale/sqrt(var+eps), t = bias - mean*s, so each conv+BN pair
+    becomes conv + bias — one fewer elementwise chain per conv at serving
+    time and no BN stats in HBM. Equivalent to the unfolded path up to
+    float rounding (folding is computed in float64 and cast to float32).
+    Idempotent: already-folded params pass through unchanged.
+    """
+
+    def fold(kernel, bn):
+        inv = np.asarray(bn["scale"], np.float64) / np.sqrt(
+            np.asarray(bn["var"], np.float64) + eps
+        )
+        w = (np.asarray(kernel, np.float64) * inv).astype(np.float32)
+        b = (
+            np.asarray(bn["bias"], np.float64)
+            - np.asarray(bn["mean"], np.float64) * inv
+        ).astype(np.float32)
+        return w, b
+
+    out: dict[str, Any] = {"head": params["head"]}
+    stem = params["stem"]
+    if "bn" in stem:
+        w, b = fold(stem["conv"], stem["bn"])
+        out["stem"] = {"conv": w, "bias": b}
+    else:
+        out["stem"] = stem
+    stage = 0
+    while f"stage{stage}" in params:
+        blocks = []
+        for bp in params[f"stage{stage}"]:
+            nb: dict[str, Any] = {}
+            for conv_key, bn_key, bias_key in _FOLD_KEYS:
+                if conv_key not in bp:
+                    continue
+                if bn_key in bp:
+                    nb[conv_key], nb[bias_key] = fold(bp[conv_key], bp[bn_key])
+                else:  # already folded
+                    nb[conv_key] = bp[conv_key]
+                    nb[bias_key] = bp[bias_key]
+            blocks.append(nb)
+        out[f"stage{stage}"] = blocks
+        stage += 1
+    return out
+
+
 def _bottleneck_init(rng, c_in, c_mid, stride):
     c_out = c_mid * 4
     p = {
@@ -87,11 +158,11 @@ def _bottleneck_init(rng, c_in, c_mid, stride):
 
 
 def _bottleneck_apply(p, x, stride):
-    y = jax.nn.relu(_bn(_conv(x, p["conv1"]), p["bn1"]))
-    y = jax.nn.relu(_bn(_conv(y, p["conv2"], stride), p["bn2"]))
-    y = _bn(_conv(y, p["conv3"]), p["bn3"])
+    y = jax.nn.relu(_norm(_conv(x, p["conv1"]), p, "bn1", "bias1"))
+    y = jax.nn.relu(_norm(_conv(y, p["conv2"], stride), p, "bn2", "bias2"))
+    y = _norm(_conv(y, p["conv3"]), p, "bn3", "bias3")
     if "proj" in p:
-        x = _bn(_conv(x, p["proj"], stride), p["bn_proj"])
+        x = _norm(_conv(x, p["proj"], stride), p, "bn_proj", "bias_proj")
     return jax.nn.relu(x + y)
 
 
@@ -109,10 +180,10 @@ def _basic_init(rng, c_in, c_out, stride):
 
 
 def _basic_apply(p, x, stride):
-    y = jax.nn.relu(_bn(_conv(x, p["conv1"], stride), p["bn1"]))
-    y = _bn(_conv(y, p["conv2"]), p["bn2"])
+    y = jax.nn.relu(_norm(_conv(x, p["conv1"], stride), p, "bn1", "bias1"))
+    y = _norm(_conv(y, p["conv2"]), p, "bn2", "bias2")
     if "proj" in p:
-        x = _bn(_conv(x, p["proj"], stride), p["bn_proj"])
+        x = _norm(_conv(x, p["proj"], stride), p, "bn_proj", "bias_proj")
     return jax.nn.relu(x + y)
 
 
@@ -149,6 +220,61 @@ def init_resnet(
     return params
 
 
+def space_to_depth_stem(params: dict) -> dict:
+    """Re-express the 7x7/stride-2 stem conv as 4x4/stride-1 on a
+    space-to-depth input (host-side, one-time, exact).
+
+    The stem conv reads a 3-channel image — 3 of the MXU's 128 lanes do
+    work, so the op is ~2% efficient and dominates wall time. Folding a
+    2x2 space-to-depth into the weights turns it into a 12-channel conv:
+      y[i,j,o] = sum_{p,q,c} w[p,q,c,o] x[2i+p-2, 2j+q-2, c]
+    with x[2I+a, 2J+b, c] = X[I, J, (a,b,c)] becomes a 4x4 conv over X
+    where w'[P,Q,(a,b,c),o] = w[2P+a, 2Q+b, c, o] (zero where 2P+a > 6)
+    and explicit padding (1,2) replaces SAME's pixel-space (2,3).
+    apply_resnet performs the matching input reshape at trace time when it
+    sees a 12-channel stem kernel. Requires a folded stem (run
+    fold_batchnorm first); no-op if already transformed.
+    """
+    stem = params["stem"]
+    if "bn" in stem:
+        raise ValueError("space_to_depth_stem requires fold_batchnorm first")
+    w = np.asarray(stem["conv"], np.float32)
+    if w.shape[:3] == (4, 4, 12):  # already transformed
+        return params
+    if w.shape[:3] != (7, 7, 3):
+        raise ValueError(f"unexpected stem kernel shape {w.shape}")
+    c_out = w.shape[3]
+    w2 = np.zeros((4, 4, 12, c_out), np.float32)
+    for big_p in range(4):
+        for big_q in range(4):
+            for a in range(2):
+                for b in range(2):
+                    p, q = 2 * big_p + a, 2 * big_q + b
+                    if p > 6 or q > 6:
+                        continue
+                    for c in range(3):
+                        w2[big_p, big_q, a * 6 + b * 3 + c] = w[p, q, c]
+    out = dict(params)
+    out["stem"] = {"conv": w2, "bias": stem["bias"]}
+    return out
+
+
+def _space_to_depth(x):
+    """[N, 2H, 2W, C] -> [N, H, W, 4C] matching space_to_depth_stem's
+    (a, b, c) channel order. Even H and W required — the transformed stem's
+    explicit (1,2) block padding equals SAME's (2,3) pixel padding only
+    then (shapes are static under jit, so this raises at trace time)."""
+    n, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            f"space-to-depth stem requires even spatial dims, got {h}x{w}; "
+            "build the model with space_to_depth=False for odd image sizes"
+        )
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
 def resnet_logits(params: dict, x: jax.Array) -> jax.Array:
     """x: [batch, H, W, 3] float -> logits [batch, num_classes]."""
     # pytree structure (not traced values) decides the block type, so this
@@ -156,8 +282,18 @@ def resnet_logits(params: dict, x: jax.Array) -> jax.Array:
     bottleneck = "conv3" in params["stage0"][0]
     block_apply = _bottleneck_apply if bottleneck else _basic_apply
 
-    h = _conv(x, params["stem"]["conv"], stride=2)
-    h = jax.nn.relu(_bn(h, params["stem"]["bn"]))
+    stem_kernel = params["stem"]["conv"]
+    if stem_kernel.shape[2] == 12:  # space-to-depth stem (trace-time branch)
+        h = jax.lax.conv_general_dilated(
+            _space_to_depth(x),
+            stem_kernel.astype(x.dtype),
+            window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    else:
+        h = _conv(x, stem_kernel, stride=2)
+    h = jax.nn.relu(_norm(h, params["stem"], "bn", "bias"))
     h = jax.lax.reduce_window(
         h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
     )
@@ -183,9 +319,15 @@ def build_resnet50(
     depth: int = 50,
     width: int = 64,
     image_size: int = 224,
+    fold_bn: bool = True,
+    space_to_depth: bool = False,
     **_,
 ) -> ModelSpec:
     params = init_resnet(seed, depth=depth, num_classes=num_classes, width=width)
+    if fold_bn:
+        params = fold_batchnorm(params)
+    if space_to_depth:
+        params = space_to_depth_stem(params)
     return ModelSpec(
         apply_resnet,
         params,
@@ -196,9 +338,19 @@ def build_resnet50(
 
 
 @register_model("resnet_tiny")
-def build_resnet_tiny(seed: int = 0, num_classes: int = 10, **_) -> ModelSpec:
+def build_resnet_tiny(
+    seed: int = 0,
+    num_classes: int = 10,
+    fold_bn: bool = True,
+    space_to_depth: bool = False,
+    **_,
+) -> ModelSpec:
     """Small resnet (depth-18, width-16, 32x32) for tests and CI."""
     params = init_resnet(seed, depth=18, num_classes=num_classes, width=16)
+    if fold_bn:
+        params = fold_batchnorm(params)
+    if space_to_depth:
+        params = space_to_depth_stem(params)
     return ModelSpec(
         apply_resnet,
         params,

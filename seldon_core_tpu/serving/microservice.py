@@ -41,6 +41,9 @@ SERVICE_TYPES = {
     "TRANSFORMER": PredictiveUnitType.TRANSFORMER,
     "OUTPUT_TRANSFORMER": PredictiveUnitType.OUTPUT_TRANSFORMER,
     "COMBINER": PredictiveUnitType.COMBINER,
+    # the reference's fourth wrapper flavor (microservice.py:140,162): serves
+    # /transform-input, calls user score(), tags meta.tags.outlierScore
+    "OUTLIER_DETECTOR": PredictiveUnitType.TRANSFORMER,
 }
 
 
@@ -109,8 +112,15 @@ async def serve_microservice(
     from seldon_core_tpu.serving.service import PredictionService
 
     predictor = build_single_unit_predictor(name, service_type)
+    # unit_object may wrap user_object; persistence below must keep snapshotting
+    # the RAW user object (its learned state), never the wrapper
+    unit_object = user_object
+    if service_type == "OUTLIER_DETECTOR":
+        from seldon_core_tpu.engine.units import OutlierDetectorUnit
+
+        unit_object = OutlierDetectorUnit(predictor.graph, user_object)
     executor = build_executor(
-        predictor, context={"units": {name: user_object}}
+        predictor, context={"units": {name: unit_object}}
     )
     service = PredictionService(executor, deployment_name=name, metrics=get_metrics(True))
 

@@ -175,3 +175,21 @@ def test_pipeline_rejects_stage_mesh_mismatch():
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
     with pytest.raises(ValueError, match="must match"):
         pipeline_apply(lambda p, x: x, params, jnp.zeros((2, 2, 4)), mesh)
+
+
+async def test_fraud_outlier_example_serves_end_to_end():
+    """The fraud CR (OUTLIER_DETECTOR -> mean_classifier) tags every
+    prediction with an outlier score (reference paysim_fraud_detector
+    worked example)."""
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.operator import DeploymentManager
+
+    m = DeploymentManager()
+    r = m.apply(json.load(open("examples/deployments/fraud_outlier.json")))
+    assert r.action == "created"
+    out = await m.get("fraud").predict(
+        message_from_dict({"data": {"ndarray": [[99000000.0, 10.0, 10.0]]}})
+    )
+    assert out.meta.tags["outlier"] is True
+    assert out.meta.tags["outlierScore"] > 4.0
+    assert out.array.shape == (1, 1)  # mean_classifier proba

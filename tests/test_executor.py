@@ -303,3 +303,87 @@ async def test_fault_injector_seed_zero_is_deterministic():
         return out
 
     assert await sequence(make()) == await sequence(make())  # seed 0 honored
+
+
+async def test_builtin_outlier_detector_tags_response():
+    """OUTLIER_DETECTOR builtin writes meta.tags.outlierScore (+ outlier flag)
+    and passes data through to the child model unchanged (reference tier:
+    wrappers/python/outlier_detector_microservice.py:40-50)."""
+    graph = {
+        "name": "od",
+        "type": "TRANSFORMER",
+        "implementation": "OUTLIER_DETECTOR",
+        "parameters": [
+            {"name": "means", "value": "0,0,0,0", "type": "STRING"},
+            {"name": "stds", "value": "1,1,1,1", "type": "STRING"},
+            {"name": "threshold", "value": "2.0", "type": "FLOAT"},
+        ],
+        "children": [{"name": "m", "implementation": "SIMPLE_MODEL"}],
+    }
+    ex = build_executor(_predictor(graph))
+    out = await ex.execute(_msg())  # all-ones input -> max |z| == 1.0
+    assert out.meta.tags["outlierScore"] == pytest.approx(1.0)
+    assert out.meta.tags["outlier"] is False
+    np.testing.assert_allclose(np.asarray(out.array), [[0.1, 0.9, 0.5]], rtol=1e-6)
+
+    big = SeldonMessage.from_array(
+        np.asarray([[9.0, 0.0, 0.0, 0.0]], np.float32), ("f0", "f1", "f2", "f3")
+    )
+    out2 = await ex.execute(big)
+    assert out2.meta.tags["outlierScore"] == pytest.approx(9.0)
+    assert out2.meta.tags["outlier"] is True
+
+
+async def test_outlier_detector_bad_stats_rejected():
+    for params in (
+        [{"name": "stds", "value": "0", "type": "STRING"}],
+        [{"name": "means", "value": "not,numbers", "type": "STRING"}],
+    ):
+        graph = {
+            "name": "od",
+            "type": "TRANSFORMER",
+            "implementation": "OUTLIER_DETECTOR",
+            "parameters": params,
+        }
+        with pytest.raises(ValueError):
+            build_executor(_predictor(graph))
+
+
+async def test_user_score_class_outlier_adapter():
+    """User classes with score() get the OutlierDetectorUnit adapter — data
+    unchanged, scalar score tagged; array scores stored as a list."""
+    from seldon_core_tpu.engine.units import OutlierDetectorUnit
+
+    class Scorer:
+        def score(self, X, names):
+            return np.max(X, axis=1)  # per-row scores
+
+    graph = {
+        "name": "od",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "implementation": "SIMPLE_MODEL"}],
+    }
+    pred = _predictor(graph)
+    unit = OutlierDetectorUnit(pred.graph, Scorer())
+    ex = build_executor(pred, context={"units": {"od": unit}})
+    out = await ex.execute(_msg(rows=2))
+    assert out.meta.tags["outlierScore"] == [1.0, 1.0]
+    np.testing.assert_allclose(
+        np.asarray(out.array), np.repeat([[0.1, 0.9, 0.5]], 2, axis=0), rtol=1e-6
+    )
+
+
+async def test_outlier_adapter_rejects_non_tensor():
+    from seldon_core_tpu.engine.units import OutlierDetectorUnit
+
+    class Scorer:
+        def score(self, X, names):
+            return 0.0
+
+    graph = {"name": "od", "type": "TRANSFORMER", "children": []}
+    pred = _predictor(graph)
+    ex = build_executor(
+        pred, context={"units": {"od": OutlierDetectorUnit(pred.graph, Scorer())}}
+    )
+    with pytest.raises(APIException):
+        await ex.execute(SeldonMessage(str_data="not a tensor"))

@@ -34,6 +34,92 @@ def test_resnet_tiny_deterministic_across_builds():
     )
 
 
+def _scramble_bn_stats(p, rng):
+    """Give every BN node non-trivial stats so folding actually changes math."""
+    if isinstance(p, dict):
+        if {"scale", "bias", "mean", "var"} <= p.keys():
+            c = p["scale"].shape[0]
+            p["scale"] = rng.uniform(0.5, 2.0, c).astype(np.float32)
+            p["bias"] = rng.standard_normal(c).astype(np.float32)
+            p["mean"] = rng.standard_normal(c).astype(np.float32)
+            p["var"] = rng.uniform(0.2, 3.0, c).astype(np.float32)
+        else:
+            for v in p.values():
+                _scramble_bn_stats(v, rng)
+    elif isinstance(p, list):
+        for v in p:
+            _scramble_bn_stats(v, rng)
+
+
+@pytest.mark.parametrize("depth,width", [(18, 16), (50, 8)])
+def test_fold_batchnorm_matches_unfolded(depth, width):
+    """Folded conv+bias must reproduce the conv+BN numerics (both block types)."""
+    from seldon_core_tpu.models.resnet import apply_resnet, fold_batchnorm, init_resnet
+
+    params = init_resnet(3, depth=depth, num_classes=10, width=width)
+    rng = np.random.default_rng(5)
+    _scramble_bn_stats(params, rng)
+    folded = fold_batchnorm(params)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    ref = np.asarray(apply_resnet(params, x))
+    got = np.asarray(apply_resnet(folded, x))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("depth,width", [(18, 16), (50, 8)])
+def test_space_to_depth_stem_matches(depth, width):
+    """The 4x4/stride-1 stem over a 2x2 space-to-depth input must reproduce
+    the 7x7/stride-2 stem exactly (same weights, same sums)."""
+    from seldon_core_tpu.models.resnet import (
+        apply_resnet,
+        fold_batchnorm,
+        init_resnet,
+        space_to_depth_stem,
+    )
+
+    params = init_resnet(3, depth=depth, num_classes=10, width=width)
+    rng = np.random.default_rng(7)
+    _scramble_bn_stats(params, rng)
+    folded = fold_batchnorm(params)
+    s2d = space_to_depth_stem(folded)
+    assert s2d["stem"]["conv"].shape[:3] == (4, 4, 12)
+    x = jnp.asarray(rng.standard_normal((2, 64, 64, 3)), jnp.float32)
+    ref = np.asarray(apply_resnet(folded, x))
+    got = np.asarray(apply_resnet(s2d, x))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # idempotent + requires folding first
+    assert space_to_depth_stem(s2d)["stem"]["conv"].shape == s2d["stem"]["conv"].shape
+    with pytest.raises(ValueError):
+        space_to_depth_stem(params)  # unfolded stem
+
+
+def test_resnet_build_space_to_depth_flag():
+    ms = get_model("resnet_tiny", num_classes=10, space_to_depth=True)
+    assert ms.params["stem"]["conv"].shape[:3] == (4, 4, 12)
+    x = np.random.default_rng(0).standard_normal((2, 32, 32, 3)).astype(np.float32)
+    y = np.asarray(ms.apply_fn(ms.params, jnp.asarray(x)))
+    ref_ms = get_model("resnet_tiny", num_classes=10)
+    ref = np.asarray(ref_ms.apply_fn(ref_ms.params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fold_batchnorm_idempotent():
+    from seldon_core_tpu.models.resnet import fold_batchnorm, init_resnet
+
+    folded = fold_batchnorm(init_resnet(1, depth=18, num_classes=4, width=16))
+    again = fold_batchnorm(folded)
+    assert jax.tree.structure(folded) == jax.tree.structure(again)
+    for a, b in zip(jax.tree.leaves(folded), jax.tree.leaves(again)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resnet_builds_are_folded_by_default():
+    ms = get_model("resnet_tiny", num_classes=10)
+    stem = ms.params["stem"]
+    assert "bias" in stem and "bn" not in stem
+    assert "bias1" in ms.params["stage0"][0]
+
+
 def test_bert_tiny_forward():
     ms = get_model("bert_tiny")
     ids = jnp.zeros((3, 16), jnp.int32)

@@ -294,3 +294,51 @@ def test_contract_mixed_categorical_and_continuous_is_json_safe():
     names, rows = generate_batch(contract, 4, rng)
     json.dumps({"data": {"names": names, "ndarray": rows}})  # must not raise
     assert isinstance(rows[0][1], float)
+
+
+async def test_microservice_outlier_detector_service_type(tmp_path):
+    """OUTLIER_DETECTOR service tier (reference microservice.py:140,162 +
+    outlier_detector_microservice.py): user score() runs on /transform-input
+    AND on the prediction path, tagging meta.tags.outlierScore while the
+    data passes through unchanged."""
+    import sys as _sys
+
+    from seldon_core_tpu.serving.microservice import (
+        load_user_object,
+        serve_microservice,
+    )
+
+    model_dir = tmp_path / "od"
+    model_dir.mkdir()
+    (model_dir / "MaxScore.py").write_text(
+        "import numpy as np\n"
+        "class MaxScore:\n"
+        "    def score(self, X, names):\n"
+        "        return float(np.max(np.abs(X)))\n"
+    )
+    user = load_user_object("MaxScore", str(model_dir), {})
+    port = _free_port()
+    runner, grpc_server, _ = await serve_microservice(
+        user, "MaxScore", "OUTLIER_DETECTOR", host="127.0.0.1", http_port=port
+    )
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, -7.5, 2.0]]}},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        assert body["meta"]["tags"]["outlierScore"] == 7.5
+        assert body["data"]["ndarray"] == [[1.0, -7.5, 2.0]]  # passthrough
+    finally:
+        await runner.cleanup()
+    _sys.path.remove(str(model_dir))
+
+
+def test_microservice_cli_accepts_outlier_detector():
+    from seldon_core_tpu.serving.microservice import SERVICE_TYPES
+
+    assert "OUTLIER_DETECTOR" in SERVICE_TYPES
