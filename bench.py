@@ -1,42 +1,67 @@
-"""Benchmark: ResNet50 serving throughput on the available accelerator.
+"""Benchmark: kernel + serving-path throughput/latency on the accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line. Primary fields keep the driver contract
+({"metric", "value", "unit", "vs_baseline"}); the additional "serving" and
+"floors" objects carry the platform measurements BASELINE.md asks for
+(SeldonDeployment preds/s AND p99, loadtest-style).
 
-Baseline: the north-star target is 10,000 predictions/sec on a v5e-8
-(BASELINE.json). This runs on ONE chip, so vs_baseline compares against the
-per-chip share of the target: 10000/8 = 1250 preds/sec/chip.
+Baseline: the north-star target is 10,000 predictions/sec at p99 < 50 ms on
+a v5e-8 (BASELINE.md:29-33). This harness has ONE chip, so vs_baseline
+compares the kernel number against the per-chip share (1250 preds/s/chip).
 
-What is measured: steady-state jitted bf16 ResNet50 forward throughput. N
-forward passes run inside ONE compiled lax.scan (each iteration's input
-perturbed by the previous output so XLA cannot hoist the loop body), and the
-scalar result is read back — a single device round trip timing N batches of
-pure compute. Host<->device transfer is excluded: on this harness the chip
-sits behind a network tunnel (~60 MB/s, ~50-100 ms RTT) that does not
-represent a real TPU host's PCIe path, and the serving batcher pipelines
-transfers behind compute anyway.
+What is measured:
+- kernel: steady-state jitted bf16 ResNet50 forward throughput, batch 512,
+  space-to-depth stem. N forwards run inside ONE compiled lax.scan (each
+  iteration's input perturbed by the previous output so XLA cannot hoist the
+  loop body); a scalar readback times N batches of pure compute.
+- serving.iris_chip: the REAL platform path — REST gateway -> json codec ->
+  micro-batcher -> jitted model on the chip -> response, driven by the
+  in-repo loadtest client (tools/loadtest.py, the locust-equivalent).
+- serving.resnet50_chip: same path with 224x224x3 image payloads.
+- serving.stack_ceiling_cpu: the identical serving bench in a subprocess on
+  the host CPU backend — isolates the serving stack's own overhead from the
+  chip tunnel (below).
+- floors: this harness's chip sits behind a network tunnel (~60 MB/s,
+  ~100 ms dispatch round trip — measured and reported as
+  dispatch_rtt_p50_ms). Every on-chip serving p99 is bounded below by that
+  RTT no matter the framework; a real TPU host pays microseconds. The
+  stack-ceiling run shows the framework's own latency without the tunnel.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+import os
+import socket
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
-def main() -> None:
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def measure_kernel() -> dict:
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from seldon_core_tpu.models.zoo import get_model
 
     on_accel = any(d.platform != "cpu" for d in jax.devices())
     if on_accel:
-        name, batch, image, dtype, iters = "resnet50", 256, 224, jnp.bfloat16, 20
+        name, batch, image, dtype, iters = "resnet50", 512, 224, jnp.bfloat16, 10
+        ms = get_model(name, space_to_depth=True)
     else:  # driver smoke-run without a chip
         name, batch, image, dtype, iters = "resnet_tiny", 32, 32, jnp.float32, 5
+        ms = get_model(name)
 
-    ms = get_model(name)
     params = jax.device_put(
         jax.tree.map(
             lambda a: a.astype(np.float32) if a.dtype == np.float64 else a, ms.params
@@ -53,12 +78,11 @@ def main() -> None:
             rng.standard_normal((batch, image, image, 3), dtype=np.float32), dtype
         )
     )
-    from jax import lax
 
     def scan_forward(params, x, n):
         def body(carry, _):
             # data dependency on the previous output blocks loop hoisting;
-            # the extra add fuses into the first conv
+            # the extra add fuses into the input read
             xi = x + carry.astype(x.dtype) * jnp.asarray(1e-12, x.dtype)
             y = ms.apply_fn(params, xi)
             return jnp.sum(y.astype(jnp.float32)), None
@@ -76,19 +100,200 @@ def main() -> None:
     t0 = time.perf_counter()
     float(timed(params, x, iters))  # scalar readback: one RTT for N batches
     elapsed = time.perf_counter() - t0
-    preds_per_sec = iters * batch / elapsed
+    return {
+        "model": name,
+        "batch": batch,
+        "preds_per_sec": round(iters * batch / elapsed, 2),
+    }
 
-    baseline_per_chip = 10000.0 / 8.0  # north-star v5e-8 target, per chip
-    print(
-        json.dumps(
-            {
-                "metric": f"{name}_predictions_per_sec",
-                "value": round(preds_per_sec, 2),
-                "unit": "preds/s",
-                "vs_baseline": round(preds_per_sec / baseline_per_chip, 4),
+
+def measure_dispatch_rtt() -> float:
+    """Bare jitted-dispatch round trip: the floor under any on-chip serving
+    latency on this harness (tunnel RTT; ~us on a real TPU host)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    x = jax.device_put(jnp.ones((8, 4), jnp.float32))
+    float(f(x)[0, 0])  # compile
+    lat = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(f(x)[0, 0])
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return round(lat[len(lat) // 2] * 1e3, 1)
+
+
+def _deployment(graph_params: dict, tpu: dict) -> "object":
+    from seldon_core_tpu.graph.defaulting import default_deployment
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+    from seldon_core_tpu.graph.validation import validate_deployment
+
+    dep = SeldonDeployment.from_dict(
+        {
+            "spec": {
+                "name": "bench",
+                "predictors": [
+                    {
+                        "name": "main",
+                        "graph": {
+                            "name": "model",
+                            "type": "MODEL",
+                            "implementation": "JAX_MODEL",
+                            "parameters": [
+                                {"name": k, "value": str(v), "type": "STRING"}
+                                for k, v in graph_params.items()
+                            ],
+                        },
+                        "tpu": tpu,
+                    }
+                ],
             }
+        }
+    )
+    dep = default_deployment(dep)
+    validate_deployment(dep)
+    return dep.spec.predictors[0]
+
+
+async def _serve_and_load(
+    predictor, *, users: int, batch: int, features, duration_s: float,
+    static_payload: bool = False,
+) -> dict:
+    from seldon_core_tpu.serving.server import PredictorServer
+    from seldon_core_tpu.tools.loadtest import run_load
+
+    server = PredictorServer(predictor, deployment_name="bench")
+    server.warmup()  # compile buckets off the measured path
+    port = _free_port()
+    await server.start(host="127.0.0.1", port=port, grpc_port=None)
+    try:
+        stats = await run_load(
+            f"http://127.0.0.1:{port}",
+            users=users,
+            duration_s=duration_s,
+            features=features,
+            batch=batch,
+            static_payload=static_payload,
+        )
+    finally:
+        await server.stop()
+    s = stats.summary()
+    return {
+        "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
+        "p50_ms": s["p50_ms"],
+        "p99_ms": s["p99_ms"],
+        "requests": s["requests"],
+        "errors": s["errors"],
+        "batch_per_request": batch,
+        "users": users,
+    }
+
+
+def serving_iris(duration_s: float = 10.0) -> dict:
+    pred = _deployment(
+        {"model": "iris_mlp"},
+        {"max_batch": 128, "batch_buckets": [128], "batch_timeout_ms": 2.0},
+    )
+    return asyncio.run(
+        _serve_and_load(pred, users=32, batch=4, features=4, duration_s=duration_s)
+    )
+
+
+def serving_resnet(duration_s: float = 10.0) -> dict:
+    # modest concurrency: each request carries a ~1.2 MB JSON image and the
+    # tunnel moves ~60 MB/s — more users would only queue into timeouts
+    pred = _deployment(
+        {"model_uri": "zoo://resnet50?space_to_depth=1"},
+        {
+            "max_batch": 8,
+            "batch_buckets": [8],
+            "batch_timeout_ms": 20.0,
+            "dtype": "bfloat16",
+        },
+    )
+    return asyncio.run(
+        _serve_and_load(
+            pred,
+            users=8,
+            batch=1,
+            features=(224, 224, 3),
+            duration_s=duration_s,
+            static_payload=True,
         )
     )
+
+
+def stack_ceiling_subprocess() -> dict | None:
+    """Run the iris serving bench on the host CPU backend in a fresh process:
+    the serving stack without the chip tunnel in the dispatch path."""
+    env = dict(os.environ)
+    here = os.path.dirname(os.path.abspath(__file__))
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = here + (os.pathsep + existing if existing else "")
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serving-stack-only"],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+        )
+        if out.returncode == 0:
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"stack-ceiling subprocess failed rc={out.returncode}: "
+            f"{out.stderr.strip()[-500:]}",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001 - diagnostic only, bench continues
+        print(f"stack-ceiling subprocess failed: {e}", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    if "--serving-stack-only" in sys.argv:
+        print(json.dumps(serving_iris(duration_s=8.0)))
+        return
+
+    import jax
+
+    kernel = measure_kernel()
+    on_accel = any(d.platform != "cpu" for d in jax.devices())
+
+    serving: dict = {}
+    floors: dict = {}
+    if on_accel:
+        serving["iris_chip"] = serving_iris()
+        serving["resnet50_chip"] = serving_resnet()
+        ceiling = stack_ceiling_subprocess()
+        if ceiling is not None:
+            serving["stack_ceiling_cpu"] = ceiling
+        floors = {
+            "dispatch_rtt_p50_ms": measure_dispatch_rtt(),
+            "note": (
+                "chip is behind a network tunnel (~60 MB/s transfer, the "
+                "dispatch RTT above); every on-chip serving p99 on this "
+                "harness is bounded below by that RTT — a real TPU host "
+                "pays microseconds. stack_ceiling_cpu isolates the "
+                "framework's own serving overhead from the tunnel."
+            ),
+        }
+
+    baseline_per_chip = 10000.0 / 8.0  # north-star v5e-8 target, per chip
+    out = {
+        "metric": f"{kernel['model']}_predictions_per_sec",
+        "value": kernel["preds_per_sec"],
+        "unit": "preds/s",
+        "vs_baseline": round(kernel["preds_per_sec"] / baseline_per_chip, 4),
+    }
+    if serving:
+        out["serving"] = serving
+    if floors:
+        out["floors"] = floors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

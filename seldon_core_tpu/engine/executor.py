@@ -25,6 +25,8 @@ import dataclasses
 import time
 from typing import Any, Callable, Sequence
 
+import numpy as np
+
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
 from seldon_core_tpu.engine.units import ROUTE_ALL, Unit, UnitRegistry, default_registry
@@ -102,6 +104,157 @@ class GraphExecutor:
                 out.meta.merged_with(Meta(tags={"trace": spans}))
             )
         return out
+
+    # ------------------------------------------------- split-batch execution
+    async def execute_many(self, msgs: list[SeldonMessage]) -> list[SeldonMessage]:
+        """Vectorized walk for a coalesced batch of requests (SURVEY §7 hard
+        parts — routing under batching): data nodes (transform / model /
+        aggregate) run ONCE on the row-merged batch, while ROUTE nodes decide
+        PER REQUEST and the batch regroups by branch, so an A/B router splits
+        traffic per request exactly like the reference engine even with
+        micro-batching on. Each returned message carries its own meta.routing
+        — feedback replays down each request's actual branch.
+
+        Requirements: every message has a tensor payload with equal non-batch
+        shape (the micro-batcher's pending key guarantees this); anything
+        else falls back to per-message walks."""
+        if not msgs:
+            return []
+        arrays = [m.array for m in msgs]
+        if len(msgs) == 1 or any(a is None for a in arrays):
+            return [await self.execute(m) for m in msgs]
+        shapes = {tuple(np.asarray(a).shape[1:]) for a in arrays}
+        if len(shapes) != 1:
+            return [await self.execute(m) for m in msgs]
+        return await self._get_output_many(self.root, list(msgs), None)
+
+    @staticmethod
+    def _merge_rows(msgs: list[SeldonMessage]) -> SeldonMessage:
+        merged = np.concatenate([np.asarray(m.array) for m in msgs], axis=0)
+        return msgs[0].with_array(merged)
+
+    @staticmethod
+    def _scatter_rows(
+        msgs: list[SeldonMessage], out: SeldonMessage
+    ) -> list[SeldonMessage]:
+        """Give each request its own row slice of a merged result, each with
+        its own meta (puid + per-request routing) merged with the unit's
+        additions (tags etc. are shared by batch-mates, as documented)."""
+        rows = [int(np.atleast_2d(np.asarray(m.array)).shape[0]) for m in msgs]
+        out_arr = None if out.array is None else np.asarray(out.array)
+        splittable = out_arr is not None and out_arr.shape[0] == sum(rows)
+        result = []
+        offset = 0
+        for m, r in zip(msgs, rows):
+            meta = m.meta.merged_with(out.meta)
+            # the merged call's meta derives from batch-mate 0 (_merge_rows),
+            # so on conflict the request's OWN routing (and puid) must win —
+            # feedback replays down meta.routing and must follow the branch
+            # THIS request actually took
+            meta = dataclasses.replace(
+                meta,
+                puid=m.meta.puid or out.meta.puid,
+                routing={**out.meta.routing, **m.meta.routing},
+            )
+            if splittable:
+                result.append(out.with_array(out_arr[offset : offset + r]).with_meta(meta))
+                offset += r
+            else:  # graph changed the batch dim (global aggregate): share it
+                result.append(out.with_meta(meta))
+        return result
+
+    async def _merged_call(self, node, method_name, method, msgs, spans):
+        merged = self._merge_rows(msgs)
+        out = await self._timed(node, method_name, method(merged), spans)
+        return self._scatter_rows(msgs, out)
+
+    async def _get_output_many(
+        self, node: Node, msgs: list[SeldonMessage], spans: list | None
+    ) -> list[SeldonMessage]:
+        unit = node.unit
+        msgs = [
+            m.with_meta(m.meta.merged_with(Meta(request_path={node.name: unit.image})))
+            for m in msgs
+        ]
+
+        if _has_method(node, PredictiveUnitMethod.TRANSFORM_INPUT):
+            msgs = await self._merged_call(
+                node, "transform_input", unit.transform_input, msgs, spans
+            )
+
+        if not node.children:
+            return msgs
+
+        if _has_method(node, PredictiveUnitMethod.ROUTE):
+            branches = []
+            for m in msgs:
+                b = await self._timed(node, "route", unit.route(m), spans)
+                if b != ROUTE_ALL and not (0 <= b < len(node.children)):
+                    raise APIException(
+                        ErrorCode.ENGINE_INVALID_ROUTING,
+                        f"unit '{node.name}' routed to {b} with {len(node.children)} children",
+                    )
+                branches.append(b)
+            msgs = [
+                m.with_meta(m.meta.merged_with(Meta(routing={node.name: b})))
+                for m, b in zip(msgs, branches)
+            ]
+            groups: dict[int, list[int]] = {}
+            for i, b in enumerate(branches):
+                groups.setdefault(b, []).append(i)
+            results: list[SeldonMessage | None] = [None] * len(msgs)
+            for b, idxs in groups.items():
+                sub = [msgs[i] for i in idxs]
+                if b == ROUTE_ALL:
+                    outs = await self._fanout_many(node, sub, spans)
+                else:
+                    outs = await self._get_output_many(node.children[b], sub, spans)
+                for i, o in zip(idxs, outs):
+                    results[i] = o
+            out_msgs = results  # type: ignore[assignment]
+        else:
+            out_msgs = await self._fanout_many(node, msgs, spans)
+
+        if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
+            out_msgs = await self._merged_call(
+                node, "transform_output", unit.transform_output, out_msgs, spans
+            )
+        return out_msgs
+
+    async def _fanout_many(
+        self, node: Node, msgs: list[SeldonMessage], spans: list | None
+    ) -> list[SeldonMessage]:
+        """All-children fan-out for a batch: each child walks the whole batch,
+        then AGGREGATE runs once on the row-aligned merged child outputs."""
+        unit = node.unit
+        targets = node.children
+        if len(targets) == 1:
+            child_outs = [await self._get_output_many(targets[0], msgs, spans)]
+        else:
+            child_outs = list(
+                await asyncio.gather(
+                    *(self._get_output_many(c, msgs, spans) for c in targets)
+                )
+            )
+
+        if _has_method(node, PredictiveUnitMethod.AGGREGATE):
+            merged_children = [self._merge_rows(co) for co in child_outs]
+            out = await self._timed(
+                node, "aggregate", unit.aggregate(merged_children), spans
+            )
+            base = []
+            for i, m in enumerate(msgs):
+                meta = m.meta
+                for co in child_outs:
+                    meta = meta.merged_with(co[i].meta)
+                base.append(m.with_meta(meta))
+            return self._scatter_rows(base, out)
+        if len(child_outs) == 1:
+            return child_outs[0]
+        raise APIException(
+            ErrorCode.ENGINE_INVALID_ROUTING,
+            f"unit '{node.name}' fanned out to {len(child_outs)} children without AGGREGATE",
+        )
 
     async def _timed(self, node: Node, method: str, coro, spans):
         t0 = time.perf_counter()

@@ -244,6 +244,7 @@ class DeploymentManager:
         batcher = make_batcher(
             predictor.tpu,
             executor.execute,
+            execute_many=executor.execute_many,
             metrics=self.metrics,
             deployment_name=dep_name,
         )

@@ -10,11 +10,13 @@ split the output rows back per request.
 Semantics notes (SURVEY §7 hard parts — routing under batching):
 - requests are only merged when their non-batch feature shape matches (a
   shape-keyed pending map), so XLA sees only bucket shapes;
-- a ROUTER decision inside the graph applies per *merged batch*. For A/B-style
-  random routers this preserves the traffic split in expectation; per-request
-  isolation can be forced with ``batch_across_requests=False`` per deployment.
-- per-request meta (puid) is preserved: graph-produced tags/routing are shared
-  by all requests in the batch, puid stays the caller's own.
+- ROUTER decisions are made PER REQUEST even under batching: coalesced
+  batches run through GraphExecutor.execute_many, which walks data nodes on
+  the merged rows but regroups the batch at every route node (split-batch
+  dispatch). ``batch_across_requests=False`` survives as an escape hatch
+  that disables coalescing entirely;
+- per-request meta (puid, routing) is preserved; graph-produced tags are
+  shared by all requests in the batch.
 """
 
 from __future__ import annotations
@@ -35,20 +37,23 @@ def make_batcher(
     tpu_spec,
     execute: "ExecuteFn",
     *,
+    execute_many: "ExecuteManyFn | None" = None,
     metrics=None,
     deployment_name: str = "",
 ) -> "MicroBatcher | None":
     """The one place batching policy is decided from a predictor's TpuSpec:
-    None when batching is disabled (batch_across_requests false — a ROUTER
-    then decides per request like the reference engine) or pointless
-    (max_batch <= 1). Used by both the engine server and the reconciler so
-    their gating can't drift."""
+    None when batching is disabled (batch_across_requests false — the
+    per-request escape hatch) or pointless (max_batch <= 1). Used by both
+    the engine server and the reconciler so their gating can't drift.
+    ``execute_many`` (GraphExecutor.execute_many) gives routers per-request
+    decisions under batching; without it the merged batch routes as one."""
     if not getattr(tpu_spec, "batch_across_requests", True):
         return None
     if getattr(tpu_spec, "max_batch", 1) <= 1:
         return None
     return MicroBatcher(
         execute,
+        execute_many=execute_many,
         max_batch=tpu_spec.max_batch,
         batch_timeout_ms=tpu_spec.batch_timeout_ms,
         metrics=metrics,
@@ -65,6 +70,7 @@ class _Pending:
 
 
 ExecuteFn = Callable[[SeldonMessage], Awaitable[SeldonMessage]]
+ExecuteManyFn = Callable[[list], Awaitable[list]]
 
 
 class MicroBatcher:
@@ -74,6 +80,7 @@ class MicroBatcher:
         self,
         execute: ExecuteFn,
         *,
+        execute_many: ExecuteManyFn | None = None,
         max_batch: int = 64,
         batch_timeout_ms: float = 3.0,
         queue_timeout_ms: float = 2000.0,
@@ -81,6 +88,7 @@ class MicroBatcher:
         deployment_name: str = "",
     ):
         self._execute = execute
+        self._execute_many = execute_many
         self.max_batch = max_batch
         self.batch_timeout_s = batch_timeout_ms / 1000.0
         self.queue_timeout_s = queue_timeout_ms / 1000.0
@@ -157,6 +165,14 @@ class MicroBatcher:
         total_rows = sum(i.rows for i in items)
         self._metrics.batch(self._deployment, total_rows, now - items[0].enqueued_at)
         try:
+            if len(items) > 1 and self._execute_many is not None:
+                # split-batch dispatch: data nodes run merged, route nodes
+                # decide per request (GraphExecutor.execute_many)
+                outs = await self._execute_many([i.msg for i in items])
+                for i, o in zip(items, outs):
+                    if not i.future.done():
+                        i.future.set_result(o)
+                return
             if len(items) == 1:
                 merged_msg = items[0].msg
             else:

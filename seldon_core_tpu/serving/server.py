@@ -74,6 +74,7 @@ class PredictorServer:
             make_batcher(
                 predictor.tpu,
                 self.executor.execute,
+                execute_many=self.executor.execute_many,
                 metrics=self.metrics,
                 deployment_name=deployment_name,
             )

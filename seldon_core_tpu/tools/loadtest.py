@@ -65,31 +65,50 @@ async def _fetch_token(session, base: str, key: str, secret: str) -> str:
         return body["access_token"]
 
 
+def _make_payload(rng: random.Random, batch: int, shape) -> dict:
+    """Random ndarray payload: ``shape`` is an int (flat feature count, the
+    locust-script shape) or a tuple (e.g. (224, 224, 3) images)."""
+
+    def _fill(dims):
+        if not dims:
+            return rng.random()
+        return [_fill(dims[1:]) for _ in range(dims[0])]
+
+    dims = (batch, shape) if isinstance(shape, int) else (batch, *tuple(shape))
+    return {"data": {"ndarray": _fill(dims)}}
+
+
 async def _user(
     session,
     base: str,
     stats: LoadStats,
     stop_at: float,
     *,
-    features: int,
+    features,
     batch: int,
     headers: dict,
     route_rewards: list[float],
     rng: random.Random,
     wait_range: tuple[float, float] | None,
+    static_payload: bool = False,
 ) -> None:
+    # static_payload: generate + encode ONCE per user and re-post the same
+    # bytes — large-tensor benches (images) must not measure the CLIENT's
+    # random-number and json.dumps cost
+    pre_encoded: bytes | None = None
+    if static_payload:
+        pre_encoded = json.dumps(_make_payload(rng, batch, features)).encode()
+    json_headers = {**headers, "Content-Type": "application/json"}
     while time.perf_counter() < stop_at:
-        payload = {
-            "data": {
-                "ndarray": [
-                    [rng.random() for _ in range(features)] for _ in range(batch)
-                ]
-            }
-        }
+        body_bytes = (
+            pre_encoded
+            if pre_encoded is not None
+            else json.dumps(_make_payload(rng, batch, features)).encode()
+        )
         t0 = time.perf_counter()
         try:
             async with session.post(
-                f"{base}/api/v0.1/predictions", json=payload, headers=headers
+                f"{base}/api/v0.1/predictions", data=body_bytes, headers=json_headers
             ) as resp:
                 body = await resp.json()
                 ok = resp.status == 200
@@ -127,13 +146,14 @@ async def run_load(
     *,
     users: int = 10,
     duration_s: float = 10.0,
-    features: int = 4,
+    features=4,
     batch: int = 1,
     oauth_key: str = "",
     oauth_secret: str = "",
     route_rewards: list[float] | None = None,
     locust_pacing: bool = False,
     seed: int = 0,
+    static_payload: bool = False,
 ) -> LoadStats:
     import aiohttp
 
@@ -163,6 +183,7 @@ async def run_load(
                     route_rewards=route_rewards or [],
                     rng=random.Random(seed + i),
                     wait_range=wait_range,
+                    static_payload=static_payload,
                 )
                 for i in range(users)
             )
