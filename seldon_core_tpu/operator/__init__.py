@@ -5,6 +5,10 @@ from seldon_core_tpu.operator.reconciler import (
     RunningDeployment,
     watch_directory,
 )
+from seldon_core_tpu.operator.k8s_watcher import (
+    KubernetesWatcher,
+    watch_kubernetes,
+)
 from seldon_core_tpu.operator.resources import (
     create_resources,
     deployment_service,
@@ -14,6 +18,8 @@ from seldon_core_tpu.operator.resources import (
 
 __all__ = [
     "DeploymentManager",
+    "KubernetesWatcher",
+    "watch_kubernetes",
     "ReconcileResult",
     "RunningDeployment",
     "add_operator_routes",

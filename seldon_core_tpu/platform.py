@@ -82,6 +82,8 @@ class Platform:
         grpc_port: int | None = 5000,
         watch_dir: str | None = None,
         watch_interval_s: float = 5.0,
+        watch_k8s: bool = False,
+        k8s_namespace: str = "default",
     ):
         runner = web.AppRunner(self.build_app())
         await runner.setup()
@@ -101,6 +103,13 @@ class Platform:
             watch_task = asyncio.create_task(
                 watch_directory(self.manager, watch_dir, watch_interval_s)
             )
+        elif watch_k8s:
+            from seldon_core_tpu.operator.k8s_watcher import KubernetesWatcher
+
+            # construct BEFORE create_task: a missing kubernetes client must
+            # fail the boot loudly, not kill a background task silently
+            watcher = KubernetesWatcher(self.manager, namespace=k8s_namespace)
+            watch_task = asyncio.create_task(watcher.run(interval_s=watch_interval_s))
         return runner, grpc_server, watch_task
 
 
@@ -125,6 +134,8 @@ async def _amain(args) -> None:
         port=args.port,
         grpc_port=args.grpc_port,
         watch_dir=args.watch_dir,
+        watch_k8s=args.watch_k8s,
+        k8s_namespace=args.k8s_namespace,
     )
 
     stop = asyncio.Event()
@@ -145,7 +156,15 @@ def main() -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument("--grpc-port", type=int, default=5000)
-    parser.add_argument("--watch-dir", default=None)
+    watch_group = parser.add_mutually_exclusive_group()
+    watch_group.add_argument("--watch-dir", default=None)
+    watch_group.add_argument(
+        "--watch-k8s",
+        action="store_true",
+        help="watch SeldonDeployment CRs on the Kubernetes API server "
+        "(needs the 'kubernetes' package); mutually exclusive with --watch-dir",
+    )
+    parser.add_argument("--k8s-namespace", default="default")
     parser.add_argument("--apply", nargs="*", help="CR JSON files to apply at boot")
     parser.add_argument("--token-store", default="", help="'' | file://p | redis://h")
     parser.add_argument("--audit-sink", default="", help="'' | mem:// | file://d | kafka://h")

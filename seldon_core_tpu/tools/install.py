@@ -114,7 +114,13 @@ def rbac(namespace: str) -> list[dict]:
     ]
 
 
-def platform_deployment(namespace: str, image: str, tpu_chips: int = 1) -> list[dict]:
+def platform_deployment(
+    namespace: str,
+    image: str,
+    tpu_chips: int = 1,
+    pull_policy: str = "IfNotPresent",
+    service_type: str = "",
+) -> list[dict]:
     """The platform pod hosts the engines, so IT is the pod that needs the
     chips: with tpu_chips > 0 it gets GKE TPU node selectors + a
     google.com/tpu request (rounded up to a valid v5e slice)."""
@@ -152,6 +158,7 @@ def platform_deployment(namespace: str, image: str, tpu_chips: int = 1) -> list[
                             {
                                 "name": "platform",
                                 "image": image,
+                                "imagePullPolicy": pull_policy,
                                 "command": [
                                     "python",
                                     "-m",
@@ -186,6 +193,8 @@ def platform_deployment(namespace: str, image: str, tpu_chips: int = 1) -> list[
                     {"name": "http", "port": 8080, "targetPort": 8080},
                     {"name": "grpc", "port": 5000, "targetPort": 5000},
                 ],
+                # reference knob apife_service_type (values.yaml:5)
+                **({"type": service_type} if service_type else {}),
             },
         },
     ]
@@ -225,21 +234,199 @@ def redis_manifests(namespace: str) -> list[dict]:
     ]
 
 
+def zookeeper_manifests(namespace: str, image: str) -> list[dict]:
+    """Zookeeper for the kafka broker (reference zookeeper-k8s/ — a 3-node
+    ensemble of per-server Services; rendered single-node here, the dev
+    shape, with the same client/follower/election port layout)."""
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "zookeeper", "namespace": namespace},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "zookeeper"}},
+                "template": {
+                    "metadata": {"labels": {"app": "zookeeper"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "zookeeper",
+                                "image": image,
+                                "env": [
+                                    {"name": "ALLOW_ANONYMOUS_LOGIN", "value": "yes"}
+                                ],
+                                "ports": [
+                                    {"containerPort": 2181, "name": "client"},
+                                    {"containerPort": 2888, "name": "followers"},
+                                    {"containerPort": 3888, "name": "election"},
+                                ],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "zookeeper", "namespace": namespace},
+            "spec": {
+                "selector": {"app": "zookeeper"},
+                "ports": [
+                    {"name": "client", "port": 2181},
+                    {"name": "followers", "port": 2888},
+                    {"name": "election", "port": 3888},
+                ],
+            },
+        },
+    ]
+
+
+def kafka_manifests(namespace: str, image: str, zookeeper_image: str) -> list[dict]:
+    """Kafka broker + zookeeper (reference kafka/kafka.json:1-130 +
+    zookeeper-k8s/) so the gateway audit sink's kafka:// mode
+    (gateway/audit.py) has a deployable broker. Single broker on port 9092
+    advertising its pod IP, like the reference's one-replica deployment."""
+    return zookeeper_manifests(namespace, zookeeper_image) + [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "kafka", "namespace": namespace},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "kafka"}},
+                "template": {
+                    "metadata": {"labels": {"app": "kafka"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "kafka",
+                                "image": image,
+                                "env": [
+                                    {"name": "KAFKA_BROKER_ID", "value": "1"},
+                                    {
+                                        "name": "KAFKA_CFG_ZOOKEEPER_CONNECT",
+                                        "value": "zookeeper:2181",
+                                    },
+                                    {
+                                        "name": "KAFKA_CFG_LISTENERS",
+                                        "value": "PLAINTEXT://:9092",
+                                    },
+                                    # reference advertises the pod host
+                                    # (kafka.json KAFKA_ADVERTISED_HOST_NAME
+                                    # from fieldRef)
+                                    {
+                                        "name": "KAFKA_CFG_ADVERTISED_LISTENERS",
+                                        "value": "PLAINTEXT://kafka:9092",
+                                    },
+                                    {"name": "ALLOW_PLAINTEXT_LISTENER", "value": "yes"},
+                                ],
+                                "ports": [{"containerPort": 9092, "name": "kafka"}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "kafka", "namespace": namespace},
+            "spec": {
+                "selector": {"app": "kafka"},
+                "ports": [{"name": "kafka", "port": 9092}],
+            },
+        },
+    ]
+
+
+# -------------------------------------------------------------- values layer
+
+# The reference's helm values.yaml knobs (helm-charts/seldon-core/values.yaml:
+# 1-20) mapped onto this platform. apife + cluster_manager + engine collapse
+# into the single platform image (platform.py runs all three in-process);
+# their shared knobs live under "platform".
+DEFAULT_VALUES: dict = {
+    "namespace": "seldon",
+    "rbac": True,  # reference cluster_manager.rbac
+    "platform": {
+        "image": "seldon-core-tpu/platform:latest",  # apife/cluster_manager/engine image+tag
+        "pull_policy": "IfNotPresent",  # apife.image.pull_policy
+        "service_type": "NodePort",  # apife_service_type
+        "tpu_chips": 1,
+    },
+    "redis": {"enabled": False, "image": "redis:7-alpine"},  # redis.image.tag
+    "kafka": {
+        "enabled": False,
+        "image": "bitnami/kafka:3.6",
+        "zookeeper_image": "bitnami/zookeeper:3.9",
+    },
+}
+
+
+def merge_values(overrides: dict | None) -> dict:
+    """Deep-merge user values over DEFAULT_VALUES (dicts merge, scalars and
+    lists replace) — helm's values semantics."""
+
+    def merge(base, over):
+        if over is None:
+            # an empty section in a values file ("kafka:" with children
+            # commented out) parses as None — keep the defaults
+            return base
+        if isinstance(base, dict) and isinstance(over, dict):
+            out = dict(base)
+            for k, v in over.items():
+                out[k] = merge(base.get(k), v) if k in base else v
+            return out
+        return over
+
+    return merge(DEFAULT_VALUES, overrides or {})
+
+
+def build_bundle_from_values(values: dict | None = None) -> list[dict]:
+    """Values-file equivalent of the CLI flags: one dict parameterizes the
+    whole bundle, so installs are reproducible from a single artifact."""
+    v = merge_values(values)
+    namespace = v["namespace"]
+    bundle: list[dict] = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
+        CRD,
+    ]
+    if v["rbac"]:
+        bundle += rbac(namespace)
+    p = v["platform"]
+    bundle += platform_deployment(
+        namespace,
+        p["image"],
+        tpu_chips=p["tpu_chips"],
+        pull_policy=p["pull_policy"],
+        service_type=p["service_type"],
+    )
+    if v["redis"]["enabled"]:
+        bundle += redis_manifests(namespace)
+    if v["kafka"]["enabled"]:
+        bundle += kafka_manifests(
+            namespace, v["kafka"]["image"], v["kafka"]["zookeeper_image"]
+        )
+    return bundle
+
+
 def build_bundle(
     namespace: str = "seldon",
     image: str = "seldon-core-tpu/platform:latest",
     with_redis: bool = False,
     tpu_chips: int = 1,
+    with_kafka: bool = False,
 ) -> list[dict]:
-    bundle: list[dict] = [
-        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
-        CRD,
-    ]
-    bundle += rbac(namespace)
-    bundle += platform_deployment(namespace, image, tpu_chips=tpu_chips)
-    if with_redis:
-        bundle += redis_manifests(namespace)
-    return bundle
+    return build_bundle_from_values(
+        {
+            "namespace": namespace,
+            "platform": {"image": image, "tpu_chips": tpu_chips},
+            "redis": {"enabled": with_redis},
+            "kafka": {"enabled": with_kafka},
+        }
+    )
 
 
 def to_yaml(manifests: list[dict]) -> str:
@@ -254,14 +441,38 @@ def main() -> None:
     p.add_argument("--image", default="seldon-core-tpu/platform:latest")
     p.add_argument("--with-redis", action="store_true")
     p.add_argument(
+        "--with-kafka",
+        action="store_true",
+        help="render kafka + zookeeper (audit-stream broker, reference kafka/ + zookeeper-k8s/)",
+    )
+    p.add_argument(
         "--tpu-chips",
         type=int,
         default=1,
         help="TPU chips for the platform pod (0 = CPU-only, for dev clusters)",
     )
+    p.add_argument(
+        "--values",
+        default=None,
+        help="values file (YAML or JSON) deep-merged over the defaults — the "
+        "helm values.yaml equivalent; other flags are ignored when set",
+    )
     p.add_argument("-o", "--out-dir", default=None)
     args = p.parse_args()
-    bundle = build_bundle(args.namespace, args.image, args.with_redis, args.tpu_chips)
+    if args.values:
+        import yaml
+
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f) or {}
+        bundle = build_bundle_from_values(overrides)
+    else:
+        bundle = build_bundle(
+            args.namespace,
+            args.image,
+            args.with_redis,
+            args.tpu_chips,
+            with_kafka=args.with_kafka,
+        )
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
         for m in bundle:

@@ -193,3 +193,93 @@ async def test_fraud_outlier_example_serves_end_to_end():
     assert out.meta.tags["outlier"] is True
     assert out.meta.tags["outlierScore"] > 4.0
     assert out.array.shape == (1, 1)  # mean_classifier proba
+
+
+def test_install_bundle_kafka_manifests():
+    """--with-kafka renders a deployable broker story for the audit sink
+    (reference kafka/kafka.json + zookeeper-k8s/; VERDICT r1 item 8)."""
+    from seldon_core_tpu.tools.install import build_bundle
+
+    bundle = build_bundle(namespace="ns1", with_kafka=True)
+    by_name = {(m["kind"], m["metadata"]["name"]): m for m in bundle}
+    assert ("Deployment", "kafka") in by_name
+    assert ("Service", "kafka") in by_name
+    assert ("Deployment", "zookeeper") in by_name
+    assert ("Service", "zookeeper") in by_name
+    kafka_env = {
+        e["name"]: e.get("value")
+        for e in by_name[("Deployment", "kafka")]["spec"]["template"]["spec"][
+            "containers"
+        ][0]["env"]
+    }
+    assert kafka_env["KAFKA_CFG_ZOOKEEPER_CONNECT"] == "zookeeper:2181"
+    svc = by_name[("Service", "kafka")]
+    assert svc["spec"]["ports"][0]["port"] == 9092
+    # without the flag, no broker is rendered
+    assert all(
+        m["metadata"]["name"] not in ("kafka", "zookeeper")
+        for m in build_bundle(namespace="ns1")
+    )
+
+
+def test_install_bundle_values_layer(tmp_path):
+    """A single values file parameterizes the whole bundle (reference
+    helm-charts/seldon-core/values.yaml knobs; VERDICT r1 item 10)."""
+    import yaml
+
+    from seldon_core_tpu.tools.install import (
+        DEFAULT_VALUES,
+        build_bundle_from_values,
+        merge_values,
+    )
+
+    # deep-merge: nested override keeps sibling defaults
+    v = merge_values({"platform": {"image": "custom:1"}, "kafka": {"enabled": True}})
+    assert v["platform"]["image"] == "custom:1"
+    assert v["platform"]["service_type"] == DEFAULT_VALUES["platform"]["service_type"]
+    assert v["kafka"]["image"] == DEFAULT_VALUES["kafka"]["image"]
+
+    bundle = build_bundle_from_values(
+        {
+            "namespace": "ns2",
+            "rbac": False,
+            "platform": {"image": "custom:1", "service_type": "LoadBalancer"},
+            "kafka": {"enabled": True},
+        }
+    )
+    kinds = [m["kind"] for m in bundle]
+    assert "ClusterRole" not in kinds  # rbac: false honored
+    platform = next(
+        m
+        for m in bundle
+        if m["kind"] == "Deployment"
+        and m["metadata"]["name"] == "seldon-core-tpu-platform"
+    )
+    c = platform["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "custom:1"
+    svc = next(
+        m
+        for m in bundle
+        if m["kind"] == "Service" and m["metadata"]["name"] == "seldon-core-tpu"
+    )
+    assert svc["spec"]["type"] == "LoadBalancer"
+    assert any(m["metadata"]["name"] == "kafka" for m in bundle)
+
+    # the shipped sample values file renders
+    overrides = yaml.safe_load(open("deploy/values.yaml"))
+    sample = build_bundle_from_values(overrides)
+    assert any(m["kind"] == "CustomResourceDefinition" for m in sample)
+
+
+def test_values_empty_section_keeps_defaults():
+    """'kafka:' with children commented out parses as None — defaults stay."""
+    from seldon_core_tpu.tools.install import (
+        DEFAULT_VALUES,
+        build_bundle_from_values,
+        merge_values,
+    )
+
+    v = merge_values({"kafka": None, "platform": None})
+    assert v["kafka"] == DEFAULT_VALUES["kafka"]
+    assert v["platform"] == DEFAULT_VALUES["platform"]
+    build_bundle_from_values({"kafka": None})  # must not raise
