@@ -1,0 +1,32 @@
+"""Fitted-MLP user model (reference parity:
+examples/models/sigmoid_predictor/SigmoidPredictor.py — fits an sklearn
+MLPClassifier at init on a synthetic sigmoid(x0*x1) task and serves
+predict_proba).
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice SigmoidPredictor REST \
+        --model-dir examples/models/sigmoid_predictor
+"""
+
+import numpy as np
+from sklearn.neural_network import MLPClassifier
+
+from seldon_core_tpu.models.adapters import SklearnModelAdapter
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class SigmoidPredictor:
+    def __init__(self, nb_samples: int = 2000, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(int(nb_samples), 10))
+        y = (sigmoid(X[:, 0] * X[:, 1]) >= 0.5).astype(int)
+        ffnn = MLPClassifier(hidden_layer_sizes=(32,), max_iter=200, random_state=0)
+        ffnn.fit(X, y)
+        self._adapter = SklearnModelAdapter(ffnn, class_names=["p0", "p1"])
+        self.class_names = self._adapter.class_names
+
+    def predict(self, X, feature_names):
+        return self._adapter.predict(X, feature_names)

@@ -1,0 +1,19 @@
+"""Input-normalizing transformer user class (reference parity:
+examples/transformers/mean_transformer/MeanTransformer.py — min-max scales
+the request before it reaches the model).
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice MeanTransformer REST \
+        --service-type TRANSFORMER \
+        --model-dir examples/transformers/mean_transformer
+"""
+
+import numpy as np
+
+
+class MeanTransformer:
+    def transform_input(self, X, feature_names):
+        X = np.asarray(X, dtype=np.float64)
+        if X.max() == X.min():
+            return np.zeros_like(X)
+        return (X - X.min()) / (X.max() - X.min())

@@ -65,13 +65,19 @@ CRD = {
 }
 
 
+def service_account(namespace: str) -> dict:
+    """Always rendered — the platform pod names it in serviceAccountName, so
+    it must exist even with rbac: false (only the cluster-wide grants are
+    optional)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": "seldon-core-tpu", "namespace": namespace},
+    }
+
+
 def rbac(namespace: str) -> list[dict]:
     return [
-        {
-            "apiVersion": "v1",
-            "kind": "ServiceAccount",
-            "metadata": {"name": "seldon-core-tpu", "namespace": namespace},
-        },
         {
             "apiVersion": "rbac.authorization.k8s.io/v1",
             "kind": "ClusterRole",
@@ -167,6 +173,12 @@ def platform_deployment(
                                     "8080",
                                     "--grpc-port",
                                     "5000",
+                                    # reconcile SeldonDeployment CRs on the
+                                    # API server — the reason the RBAC watch
+                                    # verbs and CRD status subresource exist
+                                    "--watch-k8s",
+                                    "--k8s-namespace",
+                                    namespace,
                                 ],
                                 "ports": [
                                     {"containerPort": 8080, "name": "http"},
@@ -304,7 +316,12 @@ def kafka_manifests(namespace: str, image: str, zookeeper_image: str) -> list[di
                                 "name": "kafka",
                                 "image": image,
                                 "env": [
-                                    {"name": "KAFKA_BROKER_ID", "value": "1"},
+                                    # bitnami/kafka 3.x defaults to KRaft;
+                                    # zookeeper mode (the reference topology)
+                                    # must be selected explicitly or the
+                                    # broker aborts at config validation
+                                    {"name": "KAFKA_ENABLE_KRAFT", "value": "no"},
+                                    {"name": "KAFKA_CFG_BROKER_ID", "value": "1"},
                                     {
                                         "name": "KAFKA_CFG_ZOOKEEPER_CONNECT",
                                         "value": "zookeeper:2181",
@@ -392,6 +409,7 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
     bundle: list[dict] = [
         {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
         CRD,
+        service_account(namespace),
     ]
     if v["rbac"]:
         bundle += rbac(namespace)
@@ -419,10 +437,12 @@ def build_bundle(
     tpu_chips: int = 1,
     with_kafka: bool = False,
 ) -> list[dict]:
+    # service_type "" keeps the legacy CLI's ClusterIP default — only the
+    # values path defaults to NodePort (the reference apife_service_type)
     return build_bundle_from_values(
         {
             "namespace": namespace,
-            "platform": {"image": image, "tpu_chips": tpu_chips},
+            "platform": {"image": image, "tpu_chips": tpu_chips, "service_type": ""},
             "redis": {"enabled": with_redis},
             "kafka": {"enabled": with_kafka},
         }

@@ -1,0 +1,144 @@
+"""Every shipped example model dir serves and passes its contract test —
+the reference's de-facto model conformance flow (wrappers/tester.py +
+contract.json, SURVEY §4), driven through the real microservice server.
+
+The sklearn_iris case is the required real-weights path: a pipeline FITTED
+on the actual iris dataset flows through models/adapters.SklearnModelAdapter
+into a served deployment and is verified by tools/contract.py."""
+
+import json
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _serve_and_contract(model_dir, name, service_type="MODEL", parameters=None):
+    from seldon_core_tpu.serving.microservice import (
+        load_user_object,
+        serve_microservice,
+    )
+    from seldon_core_tpu.tools.contract import run
+
+    user = load_user_object(name, model_dir, parameters or {})
+    port = _free_port()
+    runner, _, _ = await serve_microservice(
+        user, name, service_type, host="127.0.0.1", http_port=port
+    )
+    try:
+        contract = json.load(open(os.path.join(model_dir, "contract.json")))
+        import asyncio
+
+        responses = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: run(contract, "127.0.0.1", port, rounds=2, batch_size=3, seed=0),
+        )
+    finally:
+        await runner.cleanup()
+        if model_dir in sys.path:
+            sys.path.remove(model_dir)
+    assert len(responses) == 2
+    for resp in responses:
+        assert "data" in resp, resp
+        arr = np.asarray(resp["data"]["ndarray"], dtype=np.float64)
+        assert arr.shape[0] == 3
+        assert np.all(np.isfinite(arr))
+    return user, responses
+
+
+async def test_sklearn_iris_real_weights_through_adapter(tmp_path):
+    model_dir = "examples/models/sklearn_iris"
+    artifact = str(tmp_path / "IrisClassifier.joblib")
+    user, responses = await _serve_and_contract(
+        model_dir, "IrisClassifier", parameters={"model_file": artifact}
+    )
+    assert os.path.exists(artifact)  # actually trained + persisted
+    # the model genuinely learned iris: a canonical setosa sample wins class 0
+    proba = np.asarray(user.predict(np.asarray([[5.1, 3.5, 1.4, 0.2]]), []))
+    assert proba.shape == (1, 3)
+    assert int(np.argmax(proba)) == 0
+    np.testing.assert_allclose(proba.sum(), 1.0, rtol=1e-6)
+    for resp in responses:
+        assert resp["data"]["names"] == ["setosa", "versicolor", "virginica"]
+
+
+async def test_sigmoid_predictor_example_contract():
+    user, responses = await _serve_and_contract(
+        "examples/models/sigmoid_predictor",
+        "SigmoidPredictor",
+        parameters={"nb_samples": 500},
+    )
+    for resp in responses:
+        arr = np.asarray(resp["data"]["ndarray"])
+        np.testing.assert_allclose(arr.sum(axis=1), 1.0, rtol=1e-5)
+    # fitted on sigmoid(x0*x1): strongly positive product -> class 1
+    proba = np.asarray(user.predict(np.asarray([[2.0, 2.0] + [0.0] * 8]), []))
+    assert int(np.argmax(proba)) == 1
+
+
+async def test_deep_mnist_example_contract():
+    user, responses = await _serve_and_contract(
+        "examples/models/deep_mnist", "DeepMnist", parameters={"train_steps": 30}
+    )
+    for resp in responses:
+        arr = np.asarray(resp["data"]["ndarray"])
+        assert arr.shape == (3, 10)
+        np.testing.assert_allclose(arr.sum(axis=1), 1.0, rtol=1e-5)
+        assert resp["data"]["names"] == [f"class:{i}" for i in range(10)]
+
+
+async def test_fraud_detector_example_contract():
+    user, responses = await _serve_and_contract(
+        "examples/models/fraud_detector",
+        "FraudDetector",
+        service_type="OUTLIER_DETECTOR",
+    )
+    for resp in responses:
+        assert "outlierScore" in resp["meta"]["tags"]
+
+
+async def test_mean_transformer_example_serves():
+    from seldon_core_tpu.serving.microservice import (
+        load_user_object,
+        serve_microservice,
+    )
+
+    model_dir = "examples/transformers/mean_transformer"
+    user = load_user_object("MeanTransformer", model_dir, {})
+    port = _free_port()
+    runner, _, _ = await serve_microservice(
+        user, "MeanTransformer", "TRANSFORMER", host="127.0.0.1", http_port=port
+    )
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                json={"data": {"ndarray": [[0.0, 5.0, 10.0]]}},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+    finally:
+        await runner.cleanup()
+        if model_dir in sys.path:
+            sys.path.remove(model_dir)
+    np.testing.assert_allclose(body["data"]["ndarray"], [[0.0, 0.5, 1.0]])
+
+
+def test_example_dirs_have_contracts():
+    """The reference ships contract.json per model dir; ours must too."""
+    import glob
+
+    dirs = [d for d in glob.glob("examples/models/*") if os.path.isdir(d)]
+    assert len(dirs) >= 5
+    for d in dirs:
+        assert os.path.exists(os.path.join(d, "contract.json")), d

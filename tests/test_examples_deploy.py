@@ -283,3 +283,52 @@ def test_values_empty_section_keeps_defaults():
     assert v["kafka"] == DEFAULT_VALUES["kafka"]
     assert v["platform"] == DEFAULT_VALUES["platform"]
     build_bundle_from_values({"kafka": None})  # must not raise
+
+
+def test_values_rbac_false_still_renders_service_account():
+    """rbac: false drops cluster-wide grants but the SA the platform pod
+    names must still exist, and the pod command must start the CR watcher."""
+    from seldon_core_tpu.tools.install import build_bundle_from_values
+
+    bundle = build_bundle_from_values({"namespace": "ns3", "rbac": False})
+    kinds = [m["kind"] for m in bundle]
+    assert "ClusterRole" not in kinds and "ClusterRoleBinding" not in kinds
+    assert "ServiceAccount" in kinds
+    platform = next(
+        m
+        for m in bundle
+        if m["kind"] == "Deployment"
+        and m["metadata"]["name"] == "seldon-core-tpu-platform"
+    )
+    cmd = platform["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--watch-k8s" in cmd
+    assert cmd[cmd.index("--k8s-namespace") + 1] == "ns3"
+
+
+def test_legacy_build_bundle_keeps_clusterip():
+    from seldon_core_tpu.tools.install import build_bundle
+
+    bundle = build_bundle()
+    svc = next(
+        m
+        for m in bundle
+        if m["kind"] == "Service" and m["metadata"]["name"] == "seldon-core-tpu"
+    )
+    assert "type" not in svc["spec"]  # ClusterIP, the pre-values behavior
+
+
+def test_kafka_broker_selects_zookeeper_mode():
+    from seldon_core_tpu.tools.install import build_bundle
+
+    bundle = build_bundle(with_kafka=True)
+    kafka = next(
+        m
+        for m in bundle
+        if m["kind"] == "Deployment" and m["metadata"]["name"] == "kafka"
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in kafka["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["KAFKA_ENABLE_KRAFT"] == "no"  # bitnami 3.x defaults to KRaft
+    assert env["KAFKA_CFG_BROKER_ID"] == "1"
