@@ -13,7 +13,13 @@ import numpy as np
 
 class MeanTransformer:
     def transform_input(self, X, feature_names):
+        # per-ROW min-max (the reference scales over its whole call batch,
+        # which is one request's rows; under this engine's micro-batching a
+        # call batch can merge several requests, so per-row scaling keeps
+        # each request's output independent of its batch-mates)
         X = np.asarray(X, dtype=np.float64)
-        if X.max() == X.min():
-            return np.zeros_like(X)
-        return (X - X.min()) / (X.max() - X.min())
+        lo = X.min(axis=-1, keepdims=True)
+        hi = X.max(axis=-1, keepdims=True)
+        span = hi - lo
+        span[span == 0] = 1.0
+        return (X - lo) / span

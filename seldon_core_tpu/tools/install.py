@@ -358,6 +358,53 @@ def kafka_manifests(namespace: str, image: str, zookeeper_image: str) -> list[di
     ]
 
 
+def loadtest_job(
+    namespace: str,
+    image: str,
+    host: str = "http://seldon-core-tpu:8080",
+    users: int = 10,
+    duration_s: int = 60,
+    oauth_key: str = "",
+    oauth_secret: str = "",
+) -> list[dict]:
+    """Load-test Job (reference helm-charts/seldon-core-loadtesting — a
+    locust master + slave pair with clients/hatchRate/oauth knobs,
+    values.yaml:1-20). The asyncio loadtester (tools/loadtest.py) needs no
+    master/slave split: one Job pod drives the configured user count."""
+    cmd = [
+        "python",
+        "-m",
+        "seldon_core_tpu.tools.loadtest",
+        host,
+        "--users",
+        str(users),
+        "--duration",
+        str(duration_s),
+        "--json",
+    ]
+    if oauth_key:
+        cmd += ["--oauth-key", oauth_key, "--oauth-secret", oauth_secret]
+    return [
+        {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": "seldon-loadtest", "namespace": namespace},
+            "spec": {
+                "backoffLimit": 0,
+                "template": {
+                    "metadata": {"labels": {"app": "seldon-loadtest"}},
+                    "spec": {
+                        "restartPolicy": "Never",
+                        "containers": [
+                            {"name": "loadtest", "image": image, "command": cmd}
+                        ],
+                    },
+                },
+            },
+        }
+    ]
+
+
 # -------------------------------------------------------------- values layer
 
 # The reference's helm values.yaml knobs (helm-charts/seldon-core/values.yaml:
@@ -378,6 +425,17 @@ DEFAULT_VALUES: dict = {
         "enabled": False,
         "image": "bitnami/kafka:3.6",
         "zookeeper_image": "bitnami/zookeeper:3.9",
+    },
+    # reference helm-charts/seldon-core-loadtesting values (locust.clients ->
+    # users, locust.host -> host, oauth.key/secret)
+    "loadtest": {
+        "enabled": False,
+        "image": "",  # "" -> the platform image
+        "host": "http://seldon-core-tpu:8080",
+        "users": 10,
+        "duration_s": 60,
+        "oauth_key": "",
+        "oauth_secret": "",
     },
 }
 
@@ -426,6 +484,17 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
     if v["kafka"]["enabled"]:
         bundle += kafka_manifests(
             namespace, v["kafka"]["image"], v["kafka"]["zookeeper_image"]
+        )
+    lt = v["loadtest"]
+    if lt["enabled"]:
+        bundle += loadtest_job(
+            namespace,
+            lt["image"] or p["image"],
+            host=lt["host"],
+            users=lt["users"],
+            duration_s=lt["duration_s"],
+            oauth_key=lt["oauth_key"],
+            oauth_secret=lt["oauth_secret"],
         )
     return bundle
 

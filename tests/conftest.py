@@ -27,8 +27,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
+import socket  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+def free_port() -> int:
+    """Ephemeral localhost port for test servers (shared test utility)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -8,17 +8,12 @@ into a served deployment and is verified by tools/contract.py."""
 
 import json
 import os
-import socket
 import sys
 
 import numpy as np
 import pytest
 
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.conftest import free_port as _free_port
 
 
 async def _serve_and_contract(model_dir, name, service_type="MODEL", parameters=None):

@@ -332,3 +332,29 @@ def test_kafka_broker_selects_zookeeper_mode():
     }
     assert env["KAFKA_ENABLE_KRAFT"] == "no"  # bitnami 3.x defaults to KRaft
     assert env["KAFKA_CFG_BROKER_ID"] == "1"
+
+
+def test_values_loadtest_job_renders():
+    """loadtest.enabled renders the loadtesting-chart equivalent Job
+    (reference helm-charts/seldon-core-loadtesting)."""
+    from seldon_core_tpu.tools.install import build_bundle_from_values
+
+    bundle = build_bundle_from_values(
+        {
+            "loadtest": {
+                "enabled": True,
+                "users": 25,
+                "oauth_key": "k",
+                "oauth_secret": "s",
+            }
+        }
+    )
+    job = next(m for m in bundle if m["kind"] == "Job")
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "seldon_core_tpu.tools.loadtest" in cmd
+    assert cmd[cmd.index("--users") + 1] == "25"
+    assert "--oauth-key" in cmd
+    # disabled by default
+    assert not any(
+        m["kind"] == "Job" for m in build_bundle_from_values({})
+    )

@@ -85,10 +85,7 @@ def test_generate_categorical_strings():
     assert all(r[0] in ("red", "green") for r in rows)
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.conftest import free_port as _free_port
 
 
 def _iris_cr(name="irisdep", key="lkey"):
