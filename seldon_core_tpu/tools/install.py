@@ -371,6 +371,11 @@ def loadtest_job(
     locust master + slave pair with clients/hatchRate/oauth knobs,
     values.yaml:1-20). The asyncio loadtester (tools/loadtest.py) needs no
     master/slave split: one Job pod drives the configured user count."""
+    if oauth_secret and not oauth_key:
+        raise ValueError(
+            "loadtest.oauth_secret was provided without loadtest.oauth_key; "
+            "the Job would run unauthenticated and every request would 401"
+        )
     cmd = [
         "python",
         "-m",
@@ -382,9 +387,42 @@ def loadtest_job(
         str(duration_s),
         "--json",
     ]
+    container: dict = {"name": "loadtest", "image": image, "command": cmd}
+    out: list[dict] = []
     if oauth_key:
-        cmd += ["--oauth-key", oauth_key, "--oauth-secret", oauth_secret]
-    return [
+        # credentials ride a Secret -> env (LOADTEST_OAUTH_* fallbacks in
+        # tools/loadtest.py), never the pod spec's command args, which any
+        # Job/Pod reader could see via `kubectl get -o yaml`
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": "seldon-loadtest-oauth",
+                    "namespace": namespace,
+                },
+                "type": "Opaque",
+                "stringData": {"key": oauth_key, "secret": oauth_secret},
+            }
+        )
+        container["env"] = [
+            {
+                "name": "LOADTEST_OAUTH_KEY",
+                "valueFrom": {
+                    "secretKeyRef": {"name": "seldon-loadtest-oauth", "key": "key"}
+                },
+            },
+            {
+                "name": "LOADTEST_OAUTH_SECRET",
+                "valueFrom": {
+                    "secretKeyRef": {
+                        "name": "seldon-loadtest-oauth",
+                        "key": "secret",
+                    }
+                },
+            },
+        ]
+    out.append(
         {
             "apiVersion": "batch/v1",
             "kind": "Job",
@@ -395,14 +433,13 @@ def loadtest_job(
                     "metadata": {"labels": {"app": "seldon-loadtest"}},
                     "spec": {
                         "restartPolicy": "Never",
-                        "containers": [
-                            {"name": "loadtest", "image": image, "command": cmd}
-                        ],
+                        "containers": [container],
                     },
                 },
             },
         }
-    ]
+    )
+    return out
 
 
 # -------------------------------------------------------------- values layer

@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import random
 import time
 from dataclasses import dataclass, field
@@ -199,8 +200,12 @@ def main() -> None:
     p.add_argument("--duration", type=float, default=10.0)
     p.add_argument("--features", type=int, default=4)
     p.add_argument("--batch", type=int, default=1)
-    p.add_argument("--oauth-key", default="")
-    p.add_argument("--oauth-secret", default="")
+    # env fallbacks let a k8s Job inject credentials from a Secret instead
+    # of exposing them in the pod spec's command args
+    p.add_argument("--oauth-key", default=os.environ.get("LOADTEST_OAUTH_KEY", ""))
+    p.add_argument(
+        "--oauth-secret", default=os.environ.get("LOADTEST_OAUTH_SECRET", "")
+    )
     p.add_argument(
         "--feedback-route-rewards",
         default="",

@@ -350,10 +350,26 @@ def test_values_loadtest_job_renders():
         }
     )
     job = next(m for m in bundle if m["kind"] == "Job")
-    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    cmd = container["command"]
     assert "seldon_core_tpu.tools.loadtest" in cmd
     assert cmd[cmd.index("--users") + 1] == "25"
-    assert "--oauth-key" in cmd
+    # credentials must ride a Secret -> env, never the pod-spec command args
+    assert "s" not in cmd and "--oauth-secret" not in cmd
+    secret = next(
+        m
+        for m in bundle
+        if m["kind"] == "Secret" and m["metadata"]["name"] == "seldon-loadtest-oauth"
+    )
+    assert secret["stringData"] == {"key": "k", "secret": "s"}
+    env = {e["name"]: e["valueFrom"]["secretKeyRef"] for e in container["env"]}
+    assert env["LOADTEST_OAUTH_KEY"]["name"] == "seldon-loadtest-oauth"
+    assert env["LOADTEST_OAUTH_SECRET"]["key"] == "secret"
+    # secret without key fails loud at render time (silent 401s otherwise)
+    with pytest.raises(ValueError, match="oauth_key"):
+        build_bundle_from_values(
+            {"loadtest": {"enabled": True, "oauth_secret": "s"}}
+        )
     # disabled by default
     assert not any(
         m["kind"] == "Job" for m in build_bundle_from_values({})

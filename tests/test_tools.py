@@ -8,7 +8,6 @@ it runs against a live in-process platform over real HTTP.
 import asyncio
 import json
 import os
-import socket
 import sys
 
 import numpy as np
@@ -18,6 +17,7 @@ from aiohttp import web
 from seldon_core_tpu.tools.contract import generate_batch, generate_column, run as contract_run
 from seldon_core_tpu.tools.loadtest import LoadStats, run_load
 from seldon_core_tpu.tools.wrap import deployment_cr, wrap_model
+from tests.conftest import free_port as _free_port
 
 IRIS_CONTRACT = {
     "features": [
@@ -83,9 +83,6 @@ def test_generate_categorical_strings():
     names, rows = generate_batch(contract, 5, rng)
     assert names == ["color"]
     assert all(r[0] in ("red", "green") for r in rows)
-
-
-from tests.conftest import free_port as _free_port
 
 
 def _iris_cr(name="irisdep", key="lkey"):
