@@ -1,0 +1,36 @@
+"""Gradient-boosted-trees classifier (reference parity:
+examples/models/h2o_example — an H2O GBM on the bad-loans binary task,
+exported and served through the wrapper). The H2O JVM runtime is out of
+scope here; the contract the example demonstrates — a boosted-trees model
+from a tabular-ML stack, trained on a real dataset and served through the
+framework adapter tier — is kept: an sklearn HistGradientBoostingClassifier
+fitted on the bundled breast-cancer dataset (binary, 30 features), served
+via models/adapters.SklearnModelAdapter.
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice GbmClassifier REST \
+        --model-dir examples/models/gbm_classifier
+"""
+
+import numpy as np
+from sklearn.datasets import load_breast_cancer
+from sklearn.ensemble import HistGradientBoostingClassifier
+
+from seldon_core_tpu.models.adapters import SklearnModelAdapter
+
+
+class GbmClassifier:
+    def __init__(self, max_iter: int = 60, seed: int = 0):
+        data = load_breast_cancer()
+        gbm = HistGradientBoostingClassifier(
+            max_iter=int(max_iter), random_state=int(seed)
+        )
+        gbm.fit(data.data, data.target)
+        self._adapter = SklearnModelAdapter(
+            gbm, class_names=["malignant", "benign"]
+        )
+        self.class_names = self._adapter.class_names
+        self.feature_names = list(data.feature_names)
+
+    def predict(self, X, feature_names):
+        return self._adapter.predict(X, feature_names)

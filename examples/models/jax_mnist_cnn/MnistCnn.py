@@ -1,0 +1,90 @@
+"""JAX-native MNIST CNN (reference parity:
+examples/models/keras_mnist/MnistClassifier.py — the second-deep-learning-
+framework slot, a Keras conv net trained on MNIST and served through the
+wrapper). The TPU inversion skips the foreign framework entirely: the conv
+net is pure JAX (params pytree + jit-compiled apply), trained in-process
+with optax on a synthetic digit-prototype task (MNIST itself is not bundled
+offline), and the compiled forward IS the serving path — no adapter hop,
+no host framework in the loop.
+
+Serve standalone:
+    python -m seldon_core_tpu.serving.microservice MnistCnn REST \
+        --model-dir examples/models/jax_mnist_cnn
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _init_params(rng: np.random.Generator) -> dict:
+    def he(shape, fan_in):
+        return jnp.asarray(
+            rng.standard_normal(shape) * np.sqrt(2.0 / fan_in), jnp.float32
+        )
+
+    return {
+        "conv1": {"w": he((3, 3, 1, 8), 9), "b": jnp.zeros((8,))},
+        "conv2": {"w": he((3, 3, 8, 16), 72), "b": jnp.zeros((16,))},
+        "dense": {"w": he((7 * 7 * 16, 10), 784), "b": jnp.zeros((10,))},
+    }
+
+
+def _apply(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """[N, 784] float -> [N, 10] logits.
+
+    Downsampling is stride-2 convolution, not maxpool: pooling's backward
+    pass (select-and-scatter) is a TPU compile hog, while strided convs
+    keep both passes on the MXU.
+    """
+    h = x.reshape(-1, 28, 28, 1)
+    for name in ("conv1", "conv2"):
+        h = jax.lax.conv_general_dilated(
+            h,
+            params[name]["w"],
+            window_strides=(2, 2),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        h = jax.nn.relu(h + params[name]["b"])
+    h = h.reshape(h.shape[0], -1)
+    return h @ params["dense"]["w"] + params["dense"]["b"]
+
+
+class MnistCnn:
+    def __init__(self, train_steps: int = 80, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        prototypes = rng.standard_normal((10, 784)).astype(np.float32)
+        labels = rng.integers(0, 10, 512)
+        X = prototypes[labels] + 0.3 * rng.standard_normal((512, 784)).astype(
+            np.float32
+        )
+
+        params = _init_params(rng)
+        optimizer = optax.adam(1e-3)
+        opt_state = optimizer.init(params)
+
+        @jax.jit
+        def step(params, opt_state, x, y):
+            def loss_fn(p):
+                logits = _apply(p, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        x = jnp.asarray(X)
+        y = jnp.asarray(labels)
+        for _ in range(int(train_steps)):
+            params, opt_state, _ = step(params, opt_state, x, y)
+
+        self._params = params
+        self._forward = jax.jit(lambda x: jax.nn.softmax(_apply(params, x), axis=-1))
+        self.class_names = [f"class:{i}" for i in range(10)]
+
+    def predict(self, X, feature_names):
+        return np.asarray(self._forward(jnp.asarray(np.asarray(X, np.float32))))

@@ -333,6 +333,46 @@ class AverageCombinerUnit(Unit):
         return fn, None
 
 
+def make_python_class_unit(spec: PredictiveUnit, context: dict):
+    """PYTHON_CLASS: load a duck-typed user class in-process from the CR.
+
+    Parameters: ``module`` (module name == class name, the reference
+    wrappers/python convention), optional ``model_dir`` added to sys.path,
+    and every remaining parameter is passed to the class constructor. This
+    is the single-host platform inversion of the reference's
+    container-endpoint mechanism — the user class joins the executor's
+    process instead of sitting behind an RPC hop. Only use with CRs you
+    trust: the CR names code that runs in the platform process.
+    """
+    from seldon_core_tpu.engine.units import PythonClassUnit
+    from seldon_core_tpu.graph.spec import parameters_dict
+    from seldon_core_tpu.serving.microservice import load_user_object
+
+    # Declarative ingestion paths (reconciler watchers / control API) pass
+    # allow_python_class=False unless the operator opted in — a CR author
+    # with only CR-create rights must not gain code execution here. Direct
+    # build_executor embedders (already code) default to allowed.
+    if not context.get("allow_python_class", True):
+        raise APIException(
+            ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            f"PYTHON_CLASS unit '{spec.name}' refused: this platform was not "
+            "started with allow_python_class (set "
+            "SELDON_TPU_ALLOW_PYTHON_CLASS=1 or Reconciler("
+            "allow_python_class=True) to let CRs load local code in-process)",
+        )
+    params = parameters_dict(spec.parameters)
+    try:
+        module = params.pop("module")
+    except KeyError:
+        raise APIException(
+            ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            f"PYTHON_CLASS unit '{spec.name}' needs a 'module' parameter",
+        )
+    model_dir = params.pop("model_dir", None)
+    user = load_user_object(str(module), model_dir, params)
+    return PythonClassUnit(spec, user)
+
+
 def register_builtins(registry: UnitRegistry) -> None:
     registry.register(
         PredictiveUnitImplementation.SIMPLE_MODEL, lambda spec, ctx: SimpleModelUnit(spec)
@@ -360,6 +400,9 @@ def register_builtins(registry: UnitRegistry) -> None:
     registry.register(
         PredictiveUnitImplementation.OUTLIER_DETECTOR,
         lambda spec, ctx: ZScoreOutlierUnit(spec),
+    )
+    registry.register(
+        PredictiveUnitImplementation.PYTHON_CLASS, make_python_class_unit
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

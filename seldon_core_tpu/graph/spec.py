@@ -45,6 +45,9 @@ class PredictiveUnitImplementation(str, enum.Enum):
     OUTLIER_DETECTOR = "OUTLIER_DETECTOR"  # z-score request scorer writing
     # meta.tags.outlierScore (reference ships the tier container-only:
     # wrappers/python/outlier_detector_microservice.py:40-50)
+    PYTHON_CLASS = "PYTHON_CLASS"  # duck-typed user class loaded in-process
+    # from params module/model_dir (single-host platform mode; the reference
+    # always puts user classes behind a container endpoint)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -248,5 +251,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.MEAN_TRANSFORMER,
         PredictiveUnitImplementation.FAULT_INJECTOR,
         PredictiveUnitImplementation.OUTLIER_DETECTOR,
+        PredictiveUnitImplementation.PYTHON_CLASS,
     }
 )

@@ -20,6 +20,7 @@ import asyncio
 import hashlib
 import json
 import logging
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -204,6 +205,7 @@ class DeploymentManager:
         state_store_url: str = "",
         state_period_s: float = 60.0,
         hbm_budget_bytes: int | None = None,
+        allow_python_class: bool | None = None,
     ):
         self.store = store
         self.backend = backend
@@ -211,6 +213,17 @@ class DeploymentManager:
         self._service_factory = service_factory or self._default_service_factory
         self.state_store_url = state_store_url
         self.state_period_s = state_period_s
+        # PYTHON_CLASS units run arbitrary code from the CR in THIS process.
+        # CRs reach the reconciler declaratively (dir watcher, control API,
+        # k8s watcher) — i.e. from actors who may only hold CR-create rights,
+        # not authority over the platform process — so the capability is
+        # opt-in here, while direct build_executor embedders (who are already
+        # code) keep it. Default comes from SELDON_TPU_ALLOW_PYTHON_CLASS.
+        if allow_python_class is None:
+            allow_python_class = os.environ.get(
+                "SELDON_TPU_ALLOW_PYTHON_CLASS", ""
+            ).strip().lower() in ("1", "true", "yes")
+        self.allow_python_class = allow_python_class
         # None -> unlimited; set to (a fraction of) the slice's HBM so a new
         # deployment that would not fit is rejected instead of OOM-killing
         # every deployment already serving
@@ -240,7 +253,11 @@ class DeploymentManager:
             def unit_call_hook(unit_name, method, duration_s):  # noqa: E306
                 metrics.unit_call(dep_name, predictor.name, unit_name, method, duration_s)
 
-        executor = build_executor(predictor, unit_call_hook=unit_call_hook)
+        executor = build_executor(
+            predictor,
+            context={"allow_python_class": self.allow_python_class},
+            unit_call_hook=unit_call_hook,
+        )
         batcher = make_batcher(
             predictor.tpu,
             executor.execute,

@@ -65,13 +65,43 @@ def parse_parameters(raw: str | None) -> dict:
     return out
 
 
+def _import_user_module(name: str, model_dir: str):
+    """Load ``<model_dir>/<name>.py`` under a key unique to that path.
+
+    A long-lived multi-CR platform process cannot use the bare module name:
+    ``importlib.import_module`` caches by name, so two CRs whose modules are
+    both called ``Model`` (different dirs) would silently share the first
+    dir's code, and a re-applied CR would never pick up an edited file.
+    Loading by file location under a per-path key gives each dir its own
+    module and re-executes the file on every build. model_dir still joins
+    sys.path (deduped) so the user module can import its siblings.
+    """
+    import hashlib
+    import importlib.util
+
+    path = os.path.abspath(os.path.join(model_dir, name + ".py"))
+    if not os.path.exists(path):  # fall back to the plain import contract
+        if model_dir not in sys.path:
+            sys.path.insert(0, model_dir)
+        return importlib.import_module(name)
+    if model_dir not in sys.path:
+        sys.path.insert(0, model_dir)
+    key = f"_seldon_user_{hashlib.sha1(path.encode()).hexdigest()[:12]}_{name}"
+    spec = importlib.util.spec_from_file_location(key, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[key] = module
+    spec.loader.exec_module(module)
+    return module
+
+
 def load_user_object(name: str, model_dir: str | None = None, parameters: dict | None = None):
     """Import module ``name``, instantiate class ``name`` with the typed
     parameters as kwargs — the reference contract (interface_name == module
     name == class name, microservice.py:136-140)."""
     if model_dir:
-        sys.path.insert(0, model_dir)
-    module = importlib.import_module(name)
+        module = _import_user_module(name, model_dir)
+    else:
+        module = importlib.import_module(name)
     cls = getattr(module, name)
     return cls(**(parameters or {}))
 

@@ -371,10 +371,12 @@ def loadtest_job(
     locust master + slave pair with clients/hatchRate/oauth knobs,
     values.yaml:1-20). The asyncio loadtester (tools/loadtest.py) needs no
     master/slave split: one Job pod drives the configured user count."""
-    if oauth_secret and not oauth_key:
+    if bool(oauth_key) != bool(oauth_secret):
         raise ValueError(
-            "loadtest.oauth_secret was provided without loadtest.oauth_key; "
-            "the Job would run unauthenticated and every request would 401"
+            "loadtest oauth credentials must be given together "
+            f"(oauth_key {'set' if oauth_key else 'empty'}, oauth_secret "
+            f"{'set' if oauth_secret else 'empty'}); a half-configured Job "
+            "would fail every request with 401 at runtime"
         )
     cmd = [
         "python",
