@@ -209,6 +209,10 @@ def _encode_meta(meta: Meta) -> dict[str, Any]:
     return out
 
 
+# public alias: binary-response paths ship meta out-of-band (HTTP header)
+meta_to_dict = _encode_meta
+
+
 def message_to_dict(msg: SeldonMessage) -> dict[str, Any]:
     out: dict[str, Any] = {"meta": _encode_meta(msg.meta)}
     if msg.status is not None:

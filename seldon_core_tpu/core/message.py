@@ -149,9 +149,18 @@ class SeldonMessage:
         return self.data.names if self.data is not None else ()
 
     def with_array(self, array: Array, names: Sequence[str] | None = None) -> "SeldonMessage":
-        """Functional update of the payload, preserving meta/kind."""
+        """Functional update of the payload, preserving meta/kind. Setting
+        the tensor arm REPLACES the payload: the other oneof arms clear (a
+        unit that produces a tensor from a binData/strData request must not
+        leave the stale bytes beside it)."""
         base = self.data if self.data is not None else DefaultData()
-        return dataclasses.replace(self, data=base.with_array(array, names))
+        return dataclasses.replace(
+            self,
+            data=base.with_array(array, names),
+            bin_data=None,
+            str_data=None,
+            json_data=None,
+        )
 
     def with_meta(self, meta: Meta) -> "SeldonMessage":
         return dataclasses.replace(self, meta=meta)

@@ -182,7 +182,7 @@ class Gateway:
 
 
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import payload_dict
+from seldon_core_tpu.serving.http_util import is_npy_request, npy_response, payload_dict
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -224,13 +224,24 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         try:
             principal = gw._principal(request)
             dep = gw._deployment(principal)
-            msg = message_from_dict(await _payload_dict(request))
+            npy = is_npy_request(request)
+            if npy:
+                # binary tensor fast path, same contract as the engine REST
+                # surface: raw npy body in, raw npy body + Seldon-Meta out.
+                # The in-process backend decodes it at the service ingress;
+                # the remote backend forwards it as binData in the JSON
+                # envelope (base64) — correct either way.
+                msg = SeldonMessage(bin_data=await request.read())
+            else:
+                msg = message_from_dict(await _payload_dict(request))
             out = await gw.backend.predict(dep, msg)
             gw.audit.send(principal, msg, out)  # RestClientController.java:164
             if gw.metrics is not None:
                 gw.metrics.ingress_request(
                     dep.name, "predict", _time.perf_counter() - start
                 )
+            if npy and out.bin_data is not None:
+                return npy_response(out)
             return web.json_response(message_to_dict(out))
         except APIException as e:
             if gw.metrics is not None:

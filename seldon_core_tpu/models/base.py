@@ -58,16 +58,51 @@ class ModelRuntime:
         dtype: Any = jnp.float32,
         class_names: Sequence[str] = (),
         donate: bool = True,
+        int_inputs: str = "cast",
     ):
         self.apply_fn = apply_fn
         self.mesh = mesh
         self.data_axis = data_axis
         self.dtype = dtype
+        if int_inputs not in ("cast", "ids"):
+            raise ValueError(f"int_inputs must be 'cast' or 'ids', got {int_inputs!r}")
+        # "cast": integer payloads are VALUES (images/tabular) — normalize to
+        # the model dtype. "ids": integers are token ids — normalize to int32
+        # so every id stays exact (casting ids through bf16 corrupts >= 257).
+        self.int_inputs = int_inputs
         self.class_names = tuple(class_names)
         self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
         self._lock = threading.Lock()
 
         params = jax.tree.map(lambda a: jnp.asarray(a, dtype=self._param_dtype(a)), params)
+
+        # Wire-dtype policy, enforced at the jit boundary:
+        # - uint8 inputs (the binary image wire dtype) cast to the model
+        #   dtype ON DEVICE — the uint8 batch crosses host->device at 1
+        #   byte/value and the cast fuses into the first op. Other integer
+        #   dtypes pass through untouched: they are token ids, and casting
+        #   ids to bf16 would corrupt every id >= 257 (bf16 has an 8-bit
+        #   mantissa); models that take ids cast to int32 themselves.
+        # - outputs come back float32: bf16 is a compute/storage dtype, not
+        #   a wire dtype — clients can't decode it (npy has no bf16) and
+        #   bf16 device->host readback pays a slow conversion fallback
+        #   (measured ~5x the f32 readback on this harness). The cast runs
+        #   inside jit, fused into the last op; integer outputs pass through.
+        low_precision = jnp.dtype(self.dtype).itemsize < 4
+
+        def serving_fn(p, x):
+            if x.dtype == jnp.uint8:
+                x = x.astype(self.dtype)
+            y = apply_fn(p, x)
+            if low_precision:
+                y = jax.tree.map(
+                    lambda a: a.astype(jnp.float32)
+                    if jnp.issubdtype(a.dtype, jnp.floating)
+                    else a,
+                    y,
+                )
+            return y
+
         if mesh is not None:
             pspecs = param_pspecs if param_pspecs is not None else jax.tree.map(
                 lambda _: P(), params
@@ -120,7 +155,7 @@ class ModelRuntime:
             self._in_sharding = NamedSharding(mesh, batch_spec)
             self._out_sharding = NamedSharding(mesh, batch_spec)
             self._jit = jax.jit(
-                apply_fn,
+                serving_fn,
                 in_shardings=(shardings, self._in_sharding),
                 out_shardings=self._out_sharding,
                 donate_argnums=(1,) if donate else (),
@@ -128,7 +163,7 @@ class ModelRuntime:
         else:
             self.params = jax.device_put(params)
             self._in_sharding = None
-            self._jit = jax.jit(apply_fn, donate_argnums=(1,) if donate else ())
+            self._jit = jax.jit(serving_fn, donate_argnums=(1,) if donate else ())
 
     def _param_dtype(self, a) -> Any:
         a = jnp.asarray(a)
@@ -143,7 +178,18 @@ class ModelRuntime:
     def predict_device(self, x: np.ndarray) -> jax.Array:
         """Like predict but leaves the result on device (graph-internal hops
         between JAX nodes never touch the host)."""
-        x = np.asarray(x, dtype=self.dtype)
+        x = np.asarray(x)
+        # Dtype normalization keeps the compiled-signature set small (one
+        # float form + at most one integer form per bucket, both warmed):
+        if x.dtype == np.uint8 and self.int_inputs == "cast":
+            pass  # binary image wire dtype: 1 byte/value over the wire,
+            # cast to model dtype INSIDE jit (serving_fn)
+        elif x.dtype.kind in "iu" and self.int_inputs == "ids":
+            x = np.asarray(x, dtype=np.int32)  # token ids stay exact
+        else:
+            # floats (f64 json, f32/f16 npy) and value-like ints normalize
+            # to the model dtype
+            x = np.asarray(x, dtype=self.dtype)
         n = x.shape[0]
         bucket = bucket_for(n, self.buckets)
         if bucket is None:
@@ -160,12 +206,25 @@ class ModelRuntime:
         return y[:valid]
 
     def warmup(self) -> None:
-        """Compile every bucket ahead of traffic (first XLA compile is tens of
-        seconds on TPU; serving must not pay that on a live request)."""
+        """Compile every bucket ahead of traffic (first XLA compile is tens
+        of seconds on TPU; serving must not pay that on a live request).
+
+        Signatures warmed per bucket: the model float dtype (every float
+        wire form normalizes to it), plus the one integer wire form this
+        model can receive — uint8 for image-shaped inputs (rank >= 2
+        features; tabular models never see binary image payloads, so they
+        skip the extra compile), int32 for token-id models."""
         feat_shape = self._example_feature_shape()
+        int_wire_dtype = None
+        if self.int_inputs == "ids":
+            int_wire_dtype = np.int32
+        elif len(feat_shape) >= 2:
+            int_wire_dtype = np.uint8
         for b in self.buckets:
             x = np.zeros((b, *feat_shape), dtype=self.dtype)
             _ = self.predict(x[:1]) if b == self.buckets[0] else self.predict(x)
+            if int_wire_dtype is not None:
+                self.predict(np.zeros((b, *feat_shape), dtype=int_wire_dtype))
 
     def _example_feature_shape(self) -> tuple[int, ...]:
         shape = getattr(self, "feature_shape", None)

@@ -229,6 +229,7 @@ def build_bert_base(seed: int = 0, num_classes: int = 2, max_len: int = 512, **_
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         apply_factory=_bert_apply_factory,
+        int_inputs="ids",
     )
 
 
@@ -260,4 +261,5 @@ def build_bert_tiny(
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         apply_factory=_bert_apply_factory,
+        int_inputs="ids",
     )

@@ -36,6 +36,10 @@ class ModelSpec:
     # sharded apply (e.g. ring attention over the "seq" axis); apply_fn
     # remains the single-device/no-mesh path
     apply_factory: Callable[[Any], Callable] | None = None
+    # integer-payload semantics: "cast" = integers are values (images,
+    # tabular) and normalize to the model dtype; "ids" = integers are token
+    # ids and stay exact int32 (ModelRuntime wire-dtype policy)
+    int_inputs: str = "cast"
 
 
 Builder = Callable[..., ModelSpec]
@@ -162,6 +166,7 @@ def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
         dtype=dtype,
         class_names=ms.class_names,
         donate=getattr(tpu_cfg, "donate_input", True),
+        int_inputs=ms.int_inputs,
     )
     rt.feature_shape = ms.feature_shape
     return rt

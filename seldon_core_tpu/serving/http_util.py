@@ -38,3 +38,36 @@ async def payload_dict(request: web.Request, invalid_code: ErrorCode) -> dict:
 
 def error_response(exc: APIException) -> web.Response:
     return web.json_response(exc.to_status_json(), status=exc.error.http_status)
+
+
+NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
+
+
+def is_npy_request(request: web.Request) -> bool:
+    return (request.content_type or "") in NPY_CONTENT_TYPES
+
+
+def npy_response(out) -> web.Response:
+    """Raw npy body + meta in the ``Seldon-Meta`` header.
+
+    Meta must fit HTTP header limits (aiohttp rejects ~8 KB values): when it
+    does not, tags are dropped but puid AND routing survive — routing is one
+    int per router node and the bandit feedback loop reconstructs feedback
+    solely from this header on the binary path.
+    """
+    from seldon_core_tpu.core.codec_json import meta_to_dict
+
+    meta_json = json.dumps(meta_to_dict(out.meta))
+    if len(meta_json) > 6144:
+        meta_json = json.dumps(
+            {
+                "puid": out.meta.puid,
+                "routing": dict(out.meta.routing),
+                "truncated": True,
+            }
+        )
+    return web.Response(
+        body=out.bin_data,
+        content_type="application/x-npy",
+        headers={"Seldon-Meta": meta_json},
+    )

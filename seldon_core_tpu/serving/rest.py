@@ -14,8 +14,6 @@ Errors return the reference's status-JSON shape with its numeric codes.
 
 from __future__ import annotations
 
-import json
-
 from aiohttp import web
 
 from seldon_core_tpu.core.codec_json import (
@@ -26,11 +24,12 @@ from seldon_core_tpu.core.codec_json import (
     message_to_json_fast,
 )
 from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.serving.service import PredictionService
 
 
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import payload_dict
+from seldon_core_tpu.serving.http_util import is_npy_request, npy_response, payload_dict
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -46,6 +45,19 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
     async def predictions(request: web.Request) -> web.Response:
         try:
             ctype = request.content_type or ""
+            if is_npy_request(request):
+                # binary tensor fast path: the raw body IS the npy tensor —
+                # no JSON envelope, no base64 (codec_npy rationale); the
+                # service mirrors the kind, so out.bin_data is npy too
+                raw = await request.read()
+                out = await service.predict(SeldonMessage(bin_data=raw))
+                if out.bin_data is not None:
+                    return npy_response(out)
+                # non-npy binData passed through the graph untouched: the
+                # JSON envelope is the only faithful encoding left
+                return web.Response(
+                    body=message_to_json_fast(out), content_type="application/json"
+                )
             if ctype.startswith("application/json"):
                 # hot path: ndarray matrix parses/serializes in C
                 # (native/fastcodec); envelope in Python json

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import time
 
+from seldon_core_tpu.core.codec_npy import array_from_npy, is_npy, npy_from_array
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
 from seldon_core_tpu.core.puid import new_puid
 from seldon_core_tpu.engine.executor import GraphExecutor
@@ -33,6 +34,14 @@ class PredictionService:
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         start = time.perf_counter()
+        # binary tensor fast path: npy binData decodes to the tensor arm
+        # before the batcher; the response mirrors the request's kind.
+        # Non-npy binData stays opaque passthrough (reference semantics).
+        npy_requested = is_npy(msg.bin_data)
+        if npy_requested:
+            msg = SeldonMessage.from_array(
+                array_from_npy(msg.bin_data), meta=msg.meta
+            )
         if not msg.meta.puid:  # assign-if-missing (PredictionService.java:74-78)
             msg = msg.with_meta(
                 Meta(
@@ -55,6 +64,25 @@ class PredictionService:
                     routing=dict(out.meta.routing),
                     request_path=dict(out.meta.request_path),
                 )
+            )
+        if npy_requested and out.data is not None:
+            # mirror the request kind; class names ride a tag so the binary
+            # response does not silently drop them
+            tags = dict(out.meta.tags)
+            # names ride a tag so the binary response keeps them — but only
+            # when small: a 1000-class model's names would dwarf the payload
+            # metadata (and overflow HTTP header limits on the raw path)
+            if out.names and len(out.names) <= 64:
+                tags["names"] = list(out.names)
+            out = SeldonMessage(
+                bin_data=npy_from_array(out.array),
+                meta=Meta(
+                    puid=out.meta.puid,
+                    tags=tags,
+                    routing=dict(out.meta.routing),
+                    request_path=dict(out.meta.request_path),
+                ),
+                status=out.status,
             )
         self.metrics.ingress_request(
             self.deployment_name, "predict", time.perf_counter() - start

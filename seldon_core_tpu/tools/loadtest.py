@@ -92,27 +92,50 @@ async def _user(
     rng: random.Random,
     wait_range: tuple[float, float] | None,
     static_payload: bool = False,
+    payload_format: str = "json",
 ) -> None:
     # static_payload: generate + encode ONCE per user and re-post the same
     # bytes — large-tensor benches (images) must not measure the CLIENT's
     # random-number and json.dumps cost
-    pre_encoded: bytes | None = None
-    if static_payload:
-        pre_encoded = json.dumps(_make_payload(rng, batch, features)).encode()
-    json_headers = {**headers, "Content-Type": "application/json"}
+    npy = payload_format == "npy"
+
+    def encode() -> bytes:
+        if npy:
+            # binary tensor wire path: uint8 npy (images' natural wire dtype,
+            # ~8x smaller than JSON text; the server casts to model dtype)
+            import numpy as np
+
+            from seldon_core_tpu.core.codec_npy import npy_from_array
+
+            shape = (
+                (batch, *tuple(features))
+                if not isinstance(features, int)
+                else (batch, features)
+            )
+            nprng = np.random.default_rng(rng.randrange(2**31))
+            return npy_from_array(nprng.integers(0, 256, shape, dtype=np.uint8))
+        return json.dumps(_make_payload(rng, batch, features)).encode()
+
+    pre_encoded: bytes | None = encode() if static_payload else None
+    post_headers = {
+        **headers,
+        "Content-Type": "application/x-npy" if npy else "application/json",
+    }
     while time.perf_counter() < stop_at:
-        body_bytes = (
-            pre_encoded
-            if pre_encoded is not None
-            else json.dumps(_make_payload(rng, batch, features)).encode()
-        )
+        body_bytes = pre_encoded if pre_encoded is not None else encode()
         t0 = time.perf_counter()
         try:
             async with session.post(
-                f"{base}/api/v0.1/predictions", data=body_bytes, headers=json_headers
+                f"{base}/api/v0.1/predictions", data=body_bytes, headers=post_headers
             ) as resp:
-                body = await resp.json()
-                ok = resp.status == 200
+                if npy:
+                    raw = await resp.read()
+                    ok = resp.status == 200
+                    meta = json.loads(resp.headers.get("Seldon-Meta", "{}"))
+                    body = {"meta": meta} if ok else {}
+                else:
+                    body = await resp.json()
+                    ok = resp.status == 200
         except Exception:  # noqa: BLE001
             ok = False
             body = {}
@@ -155,6 +178,7 @@ async def run_load(
     locust_pacing: bool = False,
     seed: int = 0,
     static_payload: bool = False,
+    payload_format: str = "json",
 ) -> LoadStats:
     import aiohttp
 
@@ -185,6 +209,7 @@ async def run_load(
                     rng=random.Random(seed + i),
                     wait_range=wait_range,
                     static_payload=static_payload,
+                    payload_format=payload_format,
                 )
                 for i in range(users)
             )
@@ -212,6 +237,13 @@ def main() -> None:
         help="comma list of per-route reward probabilities, e.g. 0.4,0.9",
     )
     p.add_argument("--locust-pacing", action="store_true", help="~1 req/s/user")
+    p.add_argument(
+        "--payload",
+        choices=("json", "npy"),
+        default="json",
+        dest="payload_format",
+        help="wire format: json ndarray envelope or raw npy (binary fast path)",
+    )
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args()
     rewards = (
@@ -230,6 +262,7 @@ def main() -> None:
             oauth_secret=args.oauth_secret,
             route_rewards=rewards,
             locust_pacing=args.locust_pacing,
+            payload_format=args.payload_format,
         )
     )
     out = stats.summary()

@@ -190,3 +190,32 @@ def test_oauth_key_rotation_revokes_old_key():
     with pytest.raises(PermissionError):
         oauth.issue_token("old", "s")  # old client cannot mint tokens
     assert store.by_principal("new") is not None
+
+
+async def test_gateway_npy_binary_path_with_oauth():
+    """Raw npy body through the OAuth gateway: token -> binary predict ->
+    binary response with Seldon-Meta header (same contract as the engine
+    REST surface, so `loadtest --payload npy` works against a gateway)."""
+    from seldon_core_tpu.core.codec_npy import array_from_npy, npy_from_array
+
+    gw = _gateway()
+    client = await _client(gw)
+    try:
+        token = await _token(client)
+        body = npy_from_array(np.ones((2, 4), np.float32))
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=body,
+            headers={
+                "Content-Type": "application/x-npy",
+                "Authorization": f"Bearer {token}",
+            },
+        )
+        assert resp.status == 200
+        assert resp.content_type == "application/x-npy"
+        out = array_from_npy(await resp.read())
+        np.testing.assert_allclose(out, [[0.1, 0.9, 0.5]] * 2, rtol=1e-6)
+        meta = json.loads(resp.headers["Seldon-Meta"])
+        assert meta["puid"]
+    finally:
+        await client.close()

@@ -355,3 +355,224 @@ async def test_manager_deployments_get_batcher():
     outs = await asyncio.gather(*(svc.predict(msg) for msg in msgs))
     assert all(o.array.shape == (1, 3) for o in outs)
     m.delete("bdep2")
+
+
+# --------------------------------------------------------------- npy binary
+
+
+def test_npy_codec_roundtrip_and_safety():
+    from seldon_core_tpu.core.codec_npy import (
+        array_from_npy,
+        is_npy,
+        npy_from_array,
+    )
+
+    for arr in (
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+        np.asarray([[1.5, -2.5]], np.float64),
+    ):
+        raw = npy_from_array(arr)
+        assert is_npy(raw)
+        out = array_from_npy(raw)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+    import ml_dtypes
+
+    bf = np.asarray([[1.5, -2.0]], dtype=ml_dtypes.bfloat16)
+    out = array_from_npy(npy_from_array(bf))
+    assert out.dtype == np.float32  # bf16 is not npy-native; f32 interop form
+    np.testing.assert_allclose(out, [[1.5, -2.0]])
+    assert not is_npy(b"not npy")
+    assert not is_npy(None)
+    with pytest.raises(APIException):
+        array_from_npy(b"\x93NUMPYgarbage")
+    # pickled object payloads must be refused (code execution vector)
+    import io
+    import pickle
+
+    obj_arr = np.empty((1,), dtype=object)
+    obj_arr[0] = {"x": 1}
+    buf = io.BytesIO()
+    np.save(buf, obj_arr, allow_pickle=True)
+    with pytest.raises(APIException):
+        array_from_npy(buf.getvalue())
+    assert pickle  # silence unused warning paranoia
+
+
+async def test_rest_npy_raw_body_roundtrip():
+    """Raw npy body in -> raw npy body out, meta in the Seldon-Meta header;
+    class names ride meta.tags.names so the binary response keeps them."""
+    from seldon_core_tpu.core.codec_npy import array_from_npy, npy_from_array
+
+    client = await _client(_default_service(batch=True))
+    try:
+        body = npy_from_array(np.ones((2, 4), np.float32))
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=body,
+            headers={"Content-Type": "application/x-npy"},
+        )
+        assert resp.status == 200
+        assert resp.content_type == "application/x-npy"
+        out = array_from_npy(await resp.read())
+        np.testing.assert_allclose(out, [[0.1, 0.9, 0.5]] * 2, rtol=1e-6)
+        meta = json.loads(resp.headers["Seldon-Meta"])
+        assert meta["puid"]
+        assert meta["tags"]["names"] == ["c0", "c1", "c2"]
+    finally:
+        await client.close()
+
+
+async def test_rest_json_bindata_npy_mirrors_kind():
+    """npy tensors inside the JSON envelope's binData arm decode before the
+    batcher and the response binData is npy again."""
+    import base64
+
+    from seldon_core_tpu.core.codec_npy import array_from_npy, npy_from_array
+
+    client = await _client(_default_service())
+    try:
+        b64 = base64.b64encode(npy_from_array(np.ones((1, 4), np.uint8))).decode()
+        resp = await client.post("/api/v0.1/predictions", json={"binData": b64})
+        assert resp.status == 200
+        body = await resp.json()
+        out = array_from_npy(base64.b64decode(body["binData"]))
+        np.testing.assert_allclose(out, [[0.1, 0.9, 0.5]], rtol=1e-6)
+    finally:
+        await client.close()
+
+
+async def test_non_npy_bindata_stays_opaque_passthrough():
+    """Reference semantics: binData that is not npy flows untouched through
+    the ingress and any unit that does not compute on the payload
+    (prediction.proto oneof passthrough). A unit that DOES produce a tensor
+    replaces the payload — with_array clears the stale bytes arm."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class NoOpUser:  # no predict/transform methods -> payload untouched
+        pass
+
+    unit = PythonClassUnit(pred.graph, NoOpUser())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    service = PredictionService(ex, deployment_name="d")
+    out = await service.predict(SeldonMessage(bin_data=b"opaque-bytes"))
+    assert out.bin_data == b"opaque-bytes"
+
+    # and a computing unit replaces the payload cleanly (no oneof violation)
+    ex2 = build_executor(pred)
+    out2 = await PredictionService(ex2, deployment_name="d").predict(
+        SeldonMessage(bin_data=b"opaque-bytes")
+    )
+    assert out2.bin_data is None and out2.array is not None
+
+
+async def test_rest_npy_bad_payload_is_json_error_101():
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=b"\x93NUMPYgarbage",
+            headers={"Content-Type": "application/x-npy"},
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["code"] == 101
+    finally:
+        await client.close()
+
+
+def test_wire_dtype_policy_int_handling():
+    """Value models cast wide ints to the model dtype; token-id models keep
+    ids exact int32 (bf16 would corrupt every id >= 257)."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+
+    seen = {}
+
+    def probe_apply(params, x):
+        seen["dtype"] = x.dtype
+        return jnp.zeros((x.shape[0], 2), jnp.float32)
+
+    rt = ModelRuntime(
+        probe_apply, {}, buckets=[4], max_batch=4, dtype=jnp.bfloat16
+    )
+    rt.predict(np.asarray([[1000, 2000]], dtype=np.int64))
+    assert seen["dtype"] == jnp.bfloat16  # values: cast
+
+    rt_ids = ModelRuntime(
+        probe_apply,
+        {},
+        buckets=[4],
+        max_batch=4,
+        dtype=jnp.bfloat16,
+        int_inputs="ids",
+    )
+    rt_ids.predict(np.asarray([[1000, 2000]], dtype=np.int64))
+    assert seen["dtype"] == jnp.int32  # ids: exact
+
+    # uint8 travels host->device raw (1 byte/value) and serving_fn casts it
+    # before apply — so apply sees the model dtype while the transferred
+    # buffer was uint8
+    seen.clear()
+    rt.predict(np.zeros((4, 2), np.uint8))
+    assert seen["dtype"] == jnp.bfloat16
+
+    with pytest.raises(ValueError, match="int_inputs"):
+        ModelRuntime(probe_apply, {}, buckets=[4], int_inputs="bogus")
+
+
+def test_warmup_compiles_int_wire_signature_only_when_plausible():
+    """Tabular models skip the uint8 warm (they never see binary images);
+    image-shaped models warm uint8; id models warm int32."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+
+    def probe(params, x):
+        return jnp.zeros((x.shape[0], 2), jnp.float32)
+
+    rt = ModelRuntime(probe, {}, buckets=[2], max_batch=2, dtype=jnp.float32)
+    rt.feature_shape = (4,)  # tabular
+    rt.warmup()
+    # tabular: only the float signature compiled
+    assert rt._jit._cache_size() == 1
+
+    rt_img = ModelRuntime(probe, {}, buckets=[2], max_batch=2, dtype=jnp.float32)
+    rt_img.feature_shape = (8, 8, 3)
+    rt_img.warmup()
+    assert rt_img._jit._cache_size() == 2  # float + uint8
+
+    rt_ids = ModelRuntime(
+        probe, {}, buckets=[2], max_batch=2, dtype=jnp.float32, int_inputs="ids"
+    )
+    rt_ids.feature_shape = (16,)
+    rt_ids.warmup()
+    assert rt_ids._jit._cache_size() == 2  # float + int32
+
+
+def test_npy_response_truncation_keeps_routing():
+    """Oversized meta drops tags but keeps puid AND routing — the bandit
+    feedback loop reads routing from this header on the binary path."""
+    from seldon_core_tpu.core.message import Meta
+    from seldon_core_tpu.serving.http_util import npy_response
+
+    out = SeldonMessage(
+        bin_data=b"\x93NUMPYx",
+        meta=Meta(
+            puid="p1",
+            tags={"names": ["x" * 100] * 100},  # ~10 KB of tags
+            routing={"ab": 1},
+        ),
+    )
+    resp = npy_response(out)
+    meta = json.loads(resp.headers["Seldon-Meta"])
+    assert len(resp.headers["Seldon-Meta"]) < 7000
+    assert meta["truncated"] is True
+    assert meta["puid"] == "p1" and meta["routing"] == {"ab": 1}
+    assert "names" not in str(meta)
