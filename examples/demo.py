@@ -5,11 +5,18 @@ Boots the whole platform in-process, then walks every major capability:
 apply, OAuth, predict, A/B routing, reward feedback training a bandit,
 request tracing, HBM accounting, metrics.
 
-    PYTHONPATH=. python examples/demo.py
+    python examples/demo.py
 """
 
 import asyncio
 import json
+import os
+import sys
+
+# self-contained: put the repo root on sys.path instead of asking for
+# PYTHONPATH=. — overriding PYTHONPATH would displace this environment's
+# sitecustomize (which registers the TPU platform plugin) and break jax
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 async def main() -> None:

@@ -182,7 +182,7 @@ class Gateway:
 
 
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import is_npy_request, npy_response, payload_dict
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict, read_npy_body
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -224,14 +224,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         try:
             principal = gw._principal(request)
             dep = gw._deployment(principal)
-            npy = is_npy_request(request)
+            raw_npy = await read_npy_body(request)
+            npy = raw_npy is not None
             if npy:
                 # binary tensor fast path, same contract as the engine REST
                 # surface: raw npy body in, raw npy body + Seldon-Meta out.
                 # The in-process backend decodes it at the service ingress;
                 # the remote backend forwards it as binData in the JSON
                 # envelope (base64) — correct either way.
-                msg = SeldonMessage(bin_data=await request.read())
+                msg = SeldonMessage(bin_data=raw_npy)
             else:
                 msg = message_from_dict(await _payload_dict(request))
             out = await gw.backend.predict(dep, msg)

@@ -179,13 +179,18 @@ class ModelRuntime:
         """Like predict but leaves the result on device (graph-internal hops
         between JAX nodes never touch the host)."""
         x = np.asarray(x)
-        # Dtype normalization keeps the compiled-signature set small (one
-        # float form + at most one integer form per bucket, both warmed):
-        if x.dtype == np.uint8 and self.int_inputs == "cast":
+        # Dtype normalization: every wire form maps onto exactly the
+        # signatures warmup compiled (a live request must never hit a fresh
+        # XLA compile).
+        if self.int_inputs == "ids":
+            # ids models consume int32 whatever the wire encoding — the
+            # JSON wire delivers floats, and float32 holds every id < 2^24
+            # exactly, so this round-trip is lossless (casting ids through
+            # bf16 would corrupt >= 257)
+            x = np.asarray(x, dtype=np.int32)
+        elif x.dtype == np.uint8 and self._uint8_wire():
             pass  # binary image wire dtype: 1 byte/value over the wire,
-            # cast to model dtype INSIDE jit (serving_fn)
-        elif x.dtype.kind in "iu" and self.int_inputs == "ids":
-            x = np.asarray(x, dtype=np.int32)  # token ids stay exact
+            # cast to model dtype INSIDE jit (serving_fn); warmed
         else:
             # floats (f64 json, f32/f16 npy) and value-like ints normalize
             # to the model dtype
@@ -205,26 +210,37 @@ class ModelRuntime:
         y = self._jit(self.params, padded)
         return y[:valid]
 
+    def _uint8_wire(self) -> bool:
+        """uint8 rides to the device raw only for image-shaped value models
+        — exactly the signature set warmup compiles. Unknown feature shape
+        (no warmup ran) means no warmed uint8 program, so cast on host."""
+        if self.int_inputs != "cast":
+            return False
+        shape = getattr(self, "feature_shape", None)
+        return shape is not None and len(tuple(shape)) >= 2
+
     def warmup(self) -> None:
         """Compile every bucket ahead of traffic (first XLA compile is tens
         of seconds on TPU; serving must not pay that on a live request).
 
-        Signatures warmed per bucket: the model float dtype (every float
-        wire form normalizes to it), plus the one integer wire form this
-        model can receive — uint8 for image-shaped inputs (rank >= 2
-        features; tabular models never see binary image payloads, so they
-        skip the extra compile), int32 for token-id models."""
+        Signatures warmed per bucket mirror predict_device's normalization
+        exactly: ids models compile int32 only (every wire form maps to
+        it); value models compile the model float dtype, plus uint8 for
+        image-shaped inputs (rank >= 2 features — tabular payloads always
+        normalize to the float form)."""
         feat_shape = self._example_feature_shape()
-        int_wire_dtype = None
         if self.int_inputs == "ids":
-            int_wire_dtype = np.int32
-        elif len(feat_shape) >= 2:
-            int_wire_dtype = np.uint8
+            wire_dtypes = [np.int32]
+        elif self._uint8_wire():
+            wire_dtypes = [self.dtype, np.uint8]
+        else:
+            wire_dtypes = [self.dtype]
+        first = True
         for b in self.buckets:
-            x = np.zeros((b, *feat_shape), dtype=self.dtype)
-            _ = self.predict(x[:1]) if b == self.buckets[0] else self.predict(x)
-            if int_wire_dtype is not None:
-                self.predict(np.zeros((b, *feat_shape), dtype=int_wire_dtype))
+            for dt in wire_dtypes:
+                x = np.zeros((b, *feat_shape), dtype=dt)
+                _ = self.predict(x[:1]) if first else self.predict(x)
+                first = False
 
     def _example_feature_shape(self) -> tuple[int, ...]:
         shape = getattr(self, "feature_shape", None)

@@ -1,0 +1,92 @@
+"""CRD openAPIV3 validation schema, generated from the pydantic contract.
+
+Parity (C26): the reference ships CRD validation produced by expanding
+swagger ``$ref``s to finite depth so the recursive ``PredictiveUnit`` graph
+can be validated by the API server
+(util/custom-resource-definitions/expand-validation.py — it inlines
+definitions and depth-limits the children recursion; the output is embedded
+in helm-charts/seldon-core/templates/seldon-deployment-crd.json).
+
+TPU inversion: there is no second schema to keep in sync — the pydantic
+models in graph/spec.py ARE the contract, and this module compiles their
+JSON schema into a Kubernetes *structural* schema:
+
+- every ``$ref`` is inlined (k8s forbids refs);
+- the recursive ``PredictiveUnit.children`` ref expands to a finite depth
+  (deeper graphs still apply — the leaf level degrades to a permissive
+  object and the operator's full validation (graph/validation.py) takes
+  over, exactly the reference's split of API-server vs operator checks);
+- pydantic's ``anyOf [X, null]`` optionals collapse to ``X`` +
+  ``nullable: true`` (k8s structural schemas reject general anyOf);
+- objects without declared properties carry
+  ``x-kubernetes-preserve-unknown-fields`` (e.g. embedded PodTemplateSpec
+  content, which the reference also leaves unvalidated).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# deep enough for every shipped example (deepest: transformer -> router ->
+# model over combiner = 4) with headroom; the API server rejects absurdly
+# nested schemas, so this is a bound, not a target
+DEFAULT_GRAPH_DEPTH = 8
+
+_DROP_KEYS = ("title", "default", "discriminator", "definitions", "$defs")
+
+
+def _is_nullable_anyof(node: dict) -> Any:
+    opts = [o for o in node.get("anyOf", ()) if o != {"type": "null"}]
+    if len(opts) == 1 and len(opts) + 1 == len(node["anyOf"]):
+        return opts[0]
+    return None
+
+
+def _compile(node: Any, defs: dict, depth_left: int) -> Any:
+    if isinstance(node, list):
+        return [_compile(n, defs, depth_left) for n in node]
+    if not isinstance(node, dict):
+        return node
+
+    if "$ref" in node:
+        name = node["$ref"].rsplit("/", 1)[-1]
+        if name == "PredictiveUnit":
+            if depth_left <= 0:
+                # graph deeper than the expansion: API server passes it
+                # through, operator validation still applies in full
+                return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+            depth_left -= 1
+        return _compile(defs[name], defs, depth_left)
+
+    inner = _is_nullable_anyof(node)
+    if inner is not None:
+        out = _compile(inner, defs, depth_left)
+        if isinstance(out, dict):
+            out = {**out, "nullable": True}
+        return out
+
+    out = {}
+    for key, value in node.items():
+        if key in _DROP_KEYS:
+            continue
+        if key == "anyOf":
+            # residual general anyOf is not structural; degrade to permissive
+            return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+        if key == "additionalProperties" and value is True:
+            out["x-kubernetes-preserve-unknown-fields"] = True
+            continue
+        out[key] = _compile(value, defs, depth_left)
+
+    if out.get("type") == "object" and "properties" not in out:
+        out.setdefault("x-kubernetes-preserve-unknown-fields", True)
+    return out
+
+
+def deployment_validation_schema(max_graph_depth: int = DEFAULT_GRAPH_DEPTH) -> dict:
+    """Structural openAPIV3 schema for the SeldonDeployment ``spec`` field."""
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+
+    schema = SeldonDeployment.model_json_schema()
+    defs = schema.get("$defs", {})
+    spec_schema = schema["properties"]["spec"]
+    return _compile(spec_schema, defs, max_graph_depth)

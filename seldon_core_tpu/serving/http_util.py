@@ -43,8 +43,27 @@ def error_response(exc: APIException) -> web.Response:
 NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
 
 
-def is_npy_request(request: web.Request) -> bool:
-    return (request.content_type or "") in NPY_CONTENT_TYPES
+async def read_npy_body(request: web.Request) -> bytes | None:
+    """Return the raw npy body when this request takes the binary path.
+
+    ``application/x-npy`` commits to it by declaration. For
+    ``application/octet-stream`` the body must carry the npy magic: aiohttp
+    reports octet-stream for requests with NO Content-Type header at all,
+    so a header-less JSON body must keep flowing to the JSON parser instead
+    of being swallowed as opaque bytes. Callers get None for the non-npy
+    case and must parse ``await request.read()`` themselves (the body is
+    cached by aiohttp, so a second read() returns the same bytes).
+    """
+    from seldon_core_tpu.core.codec_npy import is_npy
+
+    ctype = request.content_type or ""
+    if ctype == "application/x-npy":
+        return await request.read()
+    if ctype == "application/octet-stream":
+        raw = await request.read()
+        if is_npy(raw):
+            return raw
+    return None
 
 
 def npy_response(out) -> web.Response:

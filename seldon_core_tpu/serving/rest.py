@@ -29,7 +29,7 @@ from seldon_core_tpu.serving.service import PredictionService
 
 
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import is_npy_request, npy_response, payload_dict
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict, read_npy_body
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -45,12 +45,12 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
     async def predictions(request: web.Request) -> web.Response:
         try:
             ctype = request.content_type or ""
-            if is_npy_request(request):
+            raw_npy = await read_npy_body(request)
+            if raw_npy is not None:
                 # binary tensor fast path: the raw body IS the npy tensor —
                 # no JSON envelope, no base64 (codec_npy rationale); the
                 # service mirrors the kind, so out.bin_data is npy too
-                raw = await request.read()
-                out = await service.predict(SeldonMessage(bin_data=raw))
+                out = await service.predict(SeldonMessage(bin_data=raw_npy))
                 if out.bin_data is not None:
                     return npy_response(out)
                 # non-npy binData passed through the graph untouched: the

@@ -20,7 +20,7 @@ import json
 import os
 import sys
 
-CRD = {
+_CRD_TEMPLATE = {
     "apiVersion": "apiextensions.k8s.io/v1",
     "kind": "CustomResourceDefinition",
     "metadata": {"name": "seldondeployments.machinelearning.seldon.io"},
@@ -43,14 +43,13 @@ CRD = {
                     "openAPIV3Schema": {
                         "type": "object",
                         "properties": {
-                            "spec": {
-                                "type": "object",
-                                # full graph validation happens in the
-                                # operator (graph/validation.py); the CRD
-                                # keeps a permissive schema like the
-                                # reference's expand-validation output
-                                "x-kubernetes-preserve-unknown-fields": True,
-                            },
+                            # generated from the pydantic contract with the
+                            # recursive graph expanded to finite depth —
+                            # the reference's expand-validation.py flow,
+                            # single-sourced (operator/crd_schema.py); full
+                            # graph validation still happens in the
+                            # operator (graph/validation.py)
+                            "spec": "__GENERATED__",
                             "status": {
                                 "type": "object",
                                 "x-kubernetes-preserve-unknown-fields": True,
@@ -63,6 +62,18 @@ CRD = {
         ],
     },
 }
+
+
+def crd() -> dict:
+    """CRD manifest with the generated validation schema filled in."""
+    import copy
+
+    from seldon_core_tpu.operator.crd_schema import deployment_validation_schema
+
+    out = copy.deepcopy(_CRD_TEMPLATE)
+    props = out["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]
+    props["spec"] = deployment_validation_schema()
+    return out
 
 
 def service_account(namespace: str) -> dict:
@@ -505,7 +516,7 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
     namespace = v["namespace"]
     bundle: list[dict] = [
         {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}},
-        CRD,
+        crd(),
         service_account(namespace),
     ]
     if v["rbac"]:

@@ -516,11 +516,15 @@ def test_wire_dtype_policy_int_handling():
     rt_ids.predict(np.asarray([[1000, 2000]], dtype=np.int64))
     assert seen["dtype"] == jnp.int32  # ids: exact
 
-    # uint8 travels host->device raw (1 byte/value) and serving_fn casts it
-    # before apply — so apply sees the model dtype while the transferred
-    # buffer was uint8
+    # uint8 to an IMAGE-shaped value model travels host->device raw
+    # (1 byte/value) and serving_fn casts it before apply — apply sees the
+    # model dtype while the transferred buffer was uint8
+    rt_img = ModelRuntime(
+        probe_apply, {}, buckets=[4], max_batch=4, dtype=jnp.bfloat16
+    )
+    rt_img.feature_shape = (2, 2)
     seen.clear()
-    rt.predict(np.zeros((4, 2), np.uint8))
+    rt_img.predict(np.zeros((4, 2, 2), np.uint8))
     assert seen["dtype"] == jnp.bfloat16
 
     with pytest.raises(ValueError, match="int_inputs"):
@@ -553,7 +557,9 @@ def test_warmup_compiles_int_wire_signature_only_when_plausible():
     )
     rt_ids.feature_shape = (16,)
     rt_ids.warmup()
-    assert rt_ids._jit._cache_size() == 2  # float + int32
+    # ids models compile int32 ONLY: every wire form (JSON floats included)
+    # normalizes to int32 before dispatch
+    assert rt_ids._jit._cache_size() == 1
 
 
 def test_npy_response_truncation_keeps_routing():
@@ -576,3 +582,62 @@ def test_npy_response_truncation_keeps_routing():
     assert meta["truncated"] is True
     assert meta["puid"] == "p1" and meta["routing"] == {"ab": 1}
     assert "names" not in str(meta)
+
+
+def test_ids_model_json_float_wire_keeps_ids_exact():
+    """The JSON wire delivers token ids as floats; an ids model must get
+    them back as exact int32 (bf16 would corrupt every id >= 257)."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+
+    seen = {}
+
+    def probe(params, x):
+        seen["dtype"] = x.dtype
+        return x.astype(jnp.float32)  # echo so the test sees the ids
+
+    rt = ModelRuntime(
+        probe, {}, buckets=[4], max_batch=4, dtype=jnp.bfloat16, int_inputs="ids"
+    )
+    out = rt.predict(np.asarray([[1001.0, 30521.0, 257.0]], dtype=np.float32))
+    assert seen["dtype"] == jnp.int32
+    np.testing.assert_array_equal(out, [[1001.0, 30521.0, 257.0]])
+
+
+def test_uint8_to_tabular_model_hits_warmed_signature():
+    """loadtest --payload npy sends uint8 even for tabular features; the
+    runtime must normalize it onto the warmed float signature instead of
+    compiling a fresh uint8 program on a live request."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+
+    def probe(params, x):
+        return jnp.zeros((x.shape[0], 2), jnp.float32)
+
+    rt = ModelRuntime(probe, {}, buckets=[4], max_batch=4, dtype=jnp.float32)
+    rt.feature_shape = (4,)
+    rt.warmup()
+    assert rt._jit._cache_size() == 1
+    rt.predict(np.zeros((2, 4), np.uint8))
+    assert rt._jit._cache_size() == 1  # no live compile
+
+
+async def test_headerless_json_body_still_parses():
+    """aiohttp reports octet-stream for requests with NO Content-Type; a
+    JSON body must keep flowing to the JSON parser, not become binData."""
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}}).encode(),
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        np.testing.assert_allclose(
+            body["data"]["ndarray"], [[0.1, 0.9, 0.5]], rtol=1e-6
+        )
+    finally:
+        await client.close()

@@ -336,3 +336,32 @@ def test_microservice_cli_accepts_outlier_detector():
     from seldon_core_tpu.serving.microservice import SERVICE_TYPES
 
     assert "OUTLIER_DETECTOR" in SERVICE_TYPES
+
+
+async def test_audit_tail_reads_back_served_traffic(tmp_path):
+    """The audit consumer (reference kafka read_predictions.py parity) reads
+    the JSONL stream the gateway's sink wrote, with client attribution."""
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.gateway.audit import JsonlAuditSink
+    from seldon_core_tpu.tools.audit_tail import iter_records
+
+    sink = JsonlAuditSink(str(tmp_path))
+    req = SeldonMessage.from_array(np.ones((1, 2), np.float32))
+    resp = SeldonMessage.from_array(np.zeros((1, 3), np.float32))
+    sink.send("client-a", req, resp)
+    sink.send("client-b", req, resp)
+    sink.send("client-a", req, resp)
+
+    records = list(iter_records(f"file://{tmp_path}", None, follow=False))
+    assert len(records) == 3
+    assert sorted(r["client"] for r in records) == ["client-a", "client-a", "client-b"]
+    for r in records:
+        assert r["request"]["data"]["tensor"]["values"] == [1.0, 1.0]
+        assert r["response"]["data"]["tensor"]["shape"] == [1, 3]
+
+    only_a = list(iter_records(f"file://{tmp_path}", "client-a", follow=False))
+    assert len(only_a) == 2
+
+    # torn/corrupt lines don't kill the stream
+    (tmp_path / "client-a.jsonl").open("a").write("{torn")
+    assert len(list(iter_records(f"file://{tmp_path}", "client-a", False))) == 2
