@@ -56,7 +56,10 @@ def measure_kernel() -> dict:
 
     on_accel = any(d.platform != "cpu" for d in jax.devices())
     if on_accel:
-        name, batch, image, dtype, iters = "resnet50", 512, 224, jnp.bfloat16, 10
+        # batch 128 beats 512 by ~28% on this chip (swept 64..1024): large
+        # batches push ResNet's early-layer activations through HBM, small
+        # ones keep them resident; 80 scan iterations amortize dispatch
+        name, batch, image, dtype, iters = "resnet50", 128, 224, jnp.bfloat16, 80
         ms = get_model(name, space_to_depth=True)
     else:  # driver smoke-run without a chip
         name, batch, image, dtype, iters = "resnet_tiny", 32, 32, jnp.float32, 5

@@ -117,26 +117,37 @@ class PythonClassUnit(Unit):
         cn = getattr(self.user, "class_names", None)
         return tuple(cn) if cn is not None else tuple(fallback)
 
+    @staticmethod
+    def _payload(msg: SeldonMessage):
+        """What the user method receives: the tensor when the data arm is
+        set, else the raw bytes/str payload — the reference microservice
+        hands binData/strData to user predict() as-is
+        (wrappers/python/microservice.py get_data_from_json semantics)."""
+        if msg.data is not None:
+            return np.asarray(msg.array)
+        if msg.bin_data is not None:
+            return msg.bin_data
+        return msg.str_data
+
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         fn = getattr(self.user, "predict", None) or getattr(self.user, "transform_input", None)
         if fn is None:
             return msg
-        x = np.asarray(msg.array)
-        out = await _maybe_await(fn(x, list(msg.names)))
+        out = await _maybe_await(fn(self._payload(msg), list(msg.names)))
         return msg.with_array(np.asarray(out), self._names_out(msg.names))
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
         fn = getattr(self.user, "transform_output", None)
         if fn is None:
             return msg
-        out = await _maybe_await(fn(np.asarray(msg.array), list(msg.names)))
+        out = await _maybe_await(fn(self._payload(msg), list(msg.names)))
         return msg.with_array(np.asarray(out), self._names_out(msg.names))
 
     async def route(self, msg: SeldonMessage) -> int:
         fn = getattr(self.user, "route", None)
         if fn is None:
             return ROUTE_ALL
-        out = await _maybe_await(fn(np.asarray(msg.array), list(msg.names)))
+        out = await _maybe_await(fn(self._payload(msg), list(msg.names)))
         arr = np.asarray(out)
         return int(arr.reshape(-1)[0])
 
@@ -144,7 +155,7 @@ class PythonClassUnit(Unit):
         fn = getattr(self.user, "aggregate", None)
         if fn is None:
             return await super().aggregate(msgs)
-        xs = [np.asarray(m.array) for m in msgs]
+        xs = [self._payload(m) for m in msgs]
         names = [list(m.names) for m in msgs]
         out = await _maybe_await(fn(xs, names))
         base = msgs[0]

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 
 from aiohttp import web
@@ -181,8 +182,11 @@ class Gateway:
         return dep
 
 
+from seldon_core_tpu.serving.http_util import classify_binary_body
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict, read_npy_body
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict
+
+_log = logging.getLogger(__name__)
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -224,15 +228,16 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         try:
             principal = gw._principal(request)
             dep = gw._deployment(principal)
-            raw_npy = await read_npy_body(request)
-            npy = raw_npy is not None
-            if npy:
-                # binary tensor fast path, same contract as the engine REST
-                # surface: raw npy body in, raw npy body + Seldon-Meta out.
-                # The in-process backend decodes it at the service ingress;
-                # the remote backend forwards it as binData in the JSON
-                # envelope (base64) — correct either way.
-                msg = SeldonMessage(bin_data=raw_npy)
+            kind, raw = await classify_binary_body(request)
+            npy = kind == "npy"
+            if kind != "json":
+                # npy: binary tensor fast path, same contract as the engine
+                # REST surface (raw npy in, raw npy + Seldon-Meta out).
+                # bin: deliberate octet-stream, opaque binData passthrough.
+                # The in-process backend hands either to the service
+                # ingress; the remote backend forwards them as binData in
+                # the JSON envelope (base64) — correct either way.
+                msg = SeldonMessage(bin_data=raw)
             else:
                 msg = message_from_dict(await _payload_dict(request))
             out = await gw.backend.predict(dep, msg)
@@ -248,6 +253,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if gw.metrics is not None:
                 gw.metrics.ingress_error("", "predict", e.error.code)
             return _error_response(e)
+        except web.HTTPException:
+            raise  # aiohttp control flow (413 etc.) keeps its own status
+        except Exception as e:  # noqa: BLE001 - wire boundary: failures come
+            # back in the reference status-JSON shape, never an HTML 500
+            _log.exception("unhandled error at gateway predict")
+            err = APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(e))
+            if gw.metrics is not None:
+                gw.metrics.ingress_error("", "predict", err.error.code)
+            return _error_response(err)
 
     async def feedback(request: web.Request) -> web.Response:
         import time as _time
@@ -268,6 +282,14 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if gw.metrics is not None:
                 gw.metrics.ingress_error("", "feedback", e.error.code)
             return _error_response(e)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 - same invariant as predict
+            _log.exception("unhandled error at gateway feedback")
+            err = APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(e))
+            if gw.metrics is not None:
+                gw.metrics.ingress_error("", "feedback", err.error.code)
+            return _error_response(err)
 
     async def ready(request: web.Request) -> web.Response:
         return web.Response(text="ready")

@@ -257,6 +257,19 @@ class JaxModelUnit(Unit):
         self.runtime = runtime
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if msg.data is None:
+            # opaque binData/strData reached a tensor model: reject with the
+            # reference error taxonomy instead of np.asarray(None) blowing
+            # up into a bare 500 (npy binData was already decoded at the
+            # serving ingress; anything left here is undecodable)
+            from seldon_core_tpu.core.errors import APIException, ErrorCode
+
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_JSON,
+                f"MODEL node '{self.spec.name}' needs tensor data; opaque "
+                "binData/strData is not a tensor (use npy binData or the "
+                "data arm)",
+            )
         x = np.asarray(msg.array)
         y = self.runtime.predict_device(x)
         return msg.with_array(y, self.runtime.class_names or msg.names)

@@ -77,7 +77,14 @@ def _compile(node: Any, defs: dict, depth_left: int) -> Any:
             continue
         out[key] = _compile(value, defs, depth_left)
 
-    if out.get("type") == "object" and "properties" not in out:
+    if (
+        out.get("type") == "object"
+        and "properties" not in out
+        and "additionalProperties" not in out
+    ):
+        # a map with a typed additionalProperties schema has no unknown
+        # fields to preserve (and the flag beside it can trip structural
+        # validation); only truly shapeless objects get the escape hatch
         out.setdefault("x-kubernetes-preserve-unknown-fields", True)
     return out
 

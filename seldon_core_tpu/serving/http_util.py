@@ -43,27 +43,31 @@ def error_response(exc: APIException) -> web.Response:
 NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
 
 
-async def read_npy_body(request: web.Request) -> bytes | None:
-    """Return the raw npy body when this request takes the binary path.
+async def classify_binary_body(request: web.Request) -> tuple[str, bytes | None]:
+    """Route a predictions body to its wire handler: ``("npy", raw)``,
+    ``("bin", raw)`` or ``("json", None)``.
 
-    ``application/x-npy`` commits to it by declaration. For
-    ``application/octet-stream`` the body must carry the npy magic: aiohttp
-    reports octet-stream for requests with NO Content-Type header at all,
-    so a header-less JSON body must keep flowing to the JSON parser instead
-    of being swallowed as opaque bytes. Callers get None for the non-npy
-    case and must parse ``await request.read()`` themselves (the body is
-    cached by aiohttp, so a second read() returns the same bytes).
+    - ``application/x-npy`` commits to the npy tensor path by declaration;
+    - ``application/octet-stream`` with the npy magic is npy too;
+    - ``application/octet-stream`` WITHOUT the magic splits on whether the
+      client actually sent the header: a deliberate octet-stream is opaque
+      binData (reference oneof passthrough semantics), but aiohttp reports
+      octet-stream for requests with NO Content-Type header at all, and
+      those must keep flowing to the JSON parser;
+    - everything else is the JSON/form path (callers parse it themselves;
+      aiohttp caches the body, so their read() sees the same bytes).
     """
     from seldon_core_tpu.core.codec_npy import is_npy
 
     ctype = request.content_type or ""
-    if ctype == "application/x-npy":
-        return await request.read()
-    if ctype == "application/octet-stream":
-        raw = await request.read()
-        if is_npy(raw):
-            return raw
-    return None
+    if ctype not in NPY_CONTENT_TYPES:
+        return ("json", None)
+    raw = await request.read()
+    if ctype == "application/x-npy" or is_npy(raw):
+        return ("npy", raw)
+    if "Content-Type" in request.headers:
+        return ("bin", raw)
+    return ("json", None)
 
 
 def npy_response(out) -> web.Response:

@@ -14,6 +14,8 @@ Errors return the reference's status-JSON shape with its numeric codes.
 
 from __future__ import annotations
 
+import logging
+
 from aiohttp import web
 
 from seldon_core_tpu.core.codec_json import (
@@ -28,8 +30,11 @@ from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.serving.service import PredictionService
 
 
+from seldon_core_tpu.serving.http_util import classify_binary_body
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict, read_npy_body
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict
+
+log = logging.getLogger(__name__)
 
 
 async def _payload_dict(request: web.Request) -> dict:
@@ -45,16 +50,18 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
     async def predictions(request: web.Request) -> web.Response:
         try:
             ctype = request.content_type or ""
-            raw_npy = await read_npy_body(request)
-            if raw_npy is not None:
-                # binary tensor fast path: the raw body IS the npy tensor —
-                # no JSON envelope, no base64 (codec_npy rationale); the
-                # service mirrors the kind, so out.bin_data is npy too
-                out = await service.predict(SeldonMessage(bin_data=raw_npy))
-                if out.bin_data is not None:
+            kind, raw = await classify_binary_body(request)
+            if kind != "json":
+                # "npy": binary tensor fast path — the raw body IS the npy
+                # tensor, no JSON envelope, no base64 (codec_npy rationale);
+                # the service mirrors the kind, so out.bin_data is npy too.
+                # "bin": deliberate octet-stream — opaque binData flowing
+                # through the graph untouched (reference oneof semantics).
+                out = await service.predict(SeldonMessage(bin_data=raw))
+                if kind == "npy" and out.bin_data is not None:
                     return npy_response(out)
-                # non-npy binData passed through the graph untouched: the
-                # JSON envelope is the only faithful encoding left
+                # opaque binData (and any tensor produced from bytes) keeps
+                # the JSON envelope — base64 binData, the pre-npy contract
                 return web.Response(
                     body=message_to_json_fast(out), content_type="application/json"
                 )
@@ -73,6 +80,17 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
                 service.deployment_name, "predict", e.error.code
             )
             return _error_response(e)
+        except web.HTTPException:
+            raise  # aiohttp control flow (413 etc.) keeps its own status
+        except Exception as e:  # noqa: BLE001 - wire boundary: every failure
+            # must come back in the reference status-JSON shape, never an
+            # aiohttp HTML 500
+            log.exception("unhandled error serving predict")
+            err = APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, str(e))
+            service.metrics.ingress_error(
+                service.deployment_name, "predict", err.error.code
+            )
+            return _error_response(err)
 
     async def feedback(request: web.Request) -> web.Response:
         try:
@@ -84,6 +102,15 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
                 service.deployment_name, "feedback", e.error.code
             )
             return _error_response(e)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 - same invariant as predict
+            log.exception("unhandled error serving feedback")
+            err = APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, str(e))
+            service.metrics.ingress_error(
+                service.deployment_name, "feedback", err.error.code
+            )
+            return _error_response(err)
 
     async def ready(request: web.Request) -> web.Response:
         if state["paused"] or not service.executor.ready():

@@ -33,6 +33,10 @@ def _iter_jsonl(
         pattern = os.path.join(directory, f"{client}.jsonl" if client else "*.jsonl")
         for path in sorted(glob.glob(pattern)):
             try:
+                if os.path.getsize(path) < positions.get(path, 0):
+                    # file truncated/rotated under us: restart from the top
+                    # instead of seeking past EOF forever
+                    positions[path] = 0
                 with open(path) as f:
                     f.seek(positions.get(path, 0))
                     # readline (not iteration): f.tell() is illegal inside a

@@ -632,12 +632,169 @@ async def test_headerless_json_body_still_parses():
         resp = await client.post(
             "/api/v0.1/predictions",
             data=json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}}).encode(),
-            headers={"Content-Type": "application/octet-stream"},
+            skip_auto_headers=("Content-Type",),  # truly header-less request
         )
         assert resp.status == 200
         body = await resp.json()
         np.testing.assert_allclose(
             body["data"]["ndarray"], [[0.1, 0.9, 0.5]], rtol=1e-6
         )
+    finally:
+        await client.close()
+
+
+async def test_declared_octet_stream_non_npy_is_opaque_passthrough():
+    """A client that SENDS Content-Type: application/octet-stream with
+    non-npy bytes gets reference binData passthrough (JSON envelope out),
+    not a JSON-parse 400 — only header-LESS bodies fall to the JSON parser."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class NoOpUser:
+        pass
+
+    unit = PythonClassUnit(pred.graph, NoOpUser())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    service = PredictionService(ex, deployment_name="d")
+    client = await _client(service)
+    try:
+        import base64
+
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=b"\x00\x01opaque-not-npy",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert base64.b64decode(body["binData"]) == b"\x00\x01opaque-not-npy"
+    finally:
+        await client.close()
+
+
+async def test_opaque_bindata_to_tensor_model_is_clean_400():
+    """Opaque bytes reaching a JAX tensor model return the reference 101
+    error shape, not an unhandled-exception HTML 500 (found by live drive)."""
+    pred = _predictor(
+        {
+            "name": "m",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [{"name": "model", "value": "iris_mlp", "type": "STRING"}],
+        }
+    )
+    ex = build_executor(pred)
+    client = await _client(PredictionService(ex, deployment_name="d"))
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=b"\x00\x01opaque",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert resp.status == 400
+        body = await resp.json()
+        assert body["code"] == 101 and body["status"] == "FAILURE"
+        assert "tensor" in body["info"]
+    finally:
+        await client.close()
+
+
+async def test_unhandled_exception_returns_status_json_500():
+    """A crashing user class comes back as the reference status-JSON 500,
+    never aiohttp's HTML error page."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class Boom:
+        def predict(self, X, names):
+            raise RuntimeError("kaboom")
+
+    unit = PythonClassUnit(pred.graph, Boom())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    client = await _client(PredictionService(ex, deployment_name="d"))
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions", json={"data": {"ndarray": [[1.0]]}}
+        )
+        assert resp.status == 500
+        body = await resp.json()  # JSON, not HTML
+        assert body["status"] == "FAILURE" and body["code"] == 103
+        assert "kaboom" in body["info"]
+    finally:
+        await client.close()
+
+
+async def test_python_class_unit_receives_raw_bytes_payload():
+    """Reference microservice semantics: binData reaches user predict() as
+    raw bytes (get_data_from_json passes binData through)."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BytesModel:
+        def predict(self, X, names):
+            assert isinstance(X, bytes)
+            return [[float(len(X))]]
+
+    unit = PythonClassUnit(pred.graph, BytesModel())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    out = await PredictionService(ex, deployment_name="d").predict(
+        SeldonMessage(bin_data=b"12345")
+    )
+    np.testing.assert_allclose(np.asarray(out.array), [[5.0]])
+
+
+async def test_feedback_unhandled_exception_is_status_json_500():
+    """The status-JSON invariant holds on the feedback path too."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BoomFb:
+        def send_feedback(self, X, names, routing, reward, truth):
+            raise RuntimeError("fb-kaboom")
+
+    # feedback only walks nodes that declare SEND_FEEDBACK (defaulting
+    # gives it to routers; a MODEL must opt in explicitly)
+    pred.graph.methods.append("SEND_FEEDBACK")
+    unit = PythonClassUnit(pred.graph, BoomFb())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    client = await _client(PredictionService(ex, deployment_name="d"))
+    try:
+        resp = await client.post(
+            "/api/v0.1/feedback",
+            json={
+                "request": {"data": {"ndarray": [[1.0]]}},
+                "response": {"meta": {"routing": {}}},
+                "reward": 1.0,
+            },
+        )
+        assert resp.status == 500
+        body = await resp.json()
+        assert body["status"] == "FAILURE" and "fb-kaboom" in body["info"]
+    finally:
+        await client.close()
+
+
+async def test_oversized_body_keeps_aiohttp_413():
+    """web.HTTPException control flow is not converted into a 500."""
+    client = await _client(_default_service())
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=b"x" * (65 * 1024 * 1024),
+            headers={"Content-Type": "application/json"},
+        )
+        assert resp.status == 413
     finally:
         await client.close()

@@ -362,6 +362,24 @@ async def test_audit_tail_reads_back_served_traffic(tmp_path):
     only_a = list(iter_records(f"file://{tmp_path}", "client-a", follow=False))
     assert len(only_a) == 2
 
-    # torn/corrupt lines don't kill the stream
-    (tmp_path / "client-a.jsonl").open("a").write("{torn")
+    # torn (no newline) AND corrupt (newline-terminated invalid JSON)
+    # lines both leave the stream alive
+    with (tmp_path / "client-a.jsonl").open("a") as f:
+        f.write('{"corrupt": \n')  # invalid JSON, complete line
+        f.write("{torn")  # partial write, no newline
     assert len(list(iter_records(f"file://{tmp_path}", "client-a", False))) == 2
+
+    # truncation/rotation recovery inside one --follow stream: the offset
+    # resets when the file shrinks instead of seeking past EOF forever
+    gen = iter_records(f"file://{tmp_path}", "client-b", follow=True)
+    first = next(gen)
+    assert first["client"] == "client-b"
+    (tmp_path / "client-b.jsonl").write_text("")  # logrotate-style truncation
+    # smaller record than the consumed offset so the shrink is observable
+    # (size-based reset; an equal-size rewrite is indistinguishable without
+    # inode tracking)
+    tiny = SeldonMessage.from_array(np.ones((1, 1), np.float32))
+    sink.send("client-b", tiny, tiny)
+    again = next(gen)  # would hang/starve without the getsize reset
+    assert again["client"] == "client-b"
+    assert again["request"]["data"]["tensor"]["shape"] == [1, 1]
