@@ -162,6 +162,18 @@ class SeldonMessage:
             json_data=None,
         )
 
+    def with_bin_data(self, raw: bytes) -> "SeldonMessage":
+        """Replace the payload with bytes (clears the other oneof arms)."""
+        return dataclasses.replace(
+            self, data=None, bin_data=bytes(raw), str_data=None, json_data=None
+        )
+
+    def with_str_data(self, text: str) -> "SeldonMessage":
+        """Replace the payload with a string (clears the other oneof arms)."""
+        return dataclasses.replace(
+            self, data=None, bin_data=None, str_data=text, json_data=None
+        )
+
     def with_meta(self, meta: Meta) -> "SeldonMessage":
         return dataclasses.replace(self, meta=meta)
 

@@ -129,19 +129,30 @@ class PythonClassUnit(Unit):
             return msg.bin_data
         return msg.str_data
 
+    def _wrap_output(self, msg: SeldonMessage, out) -> SeldonMessage:
+        """Mirror the user's return type onto the oneof: bytes -> binData,
+        str -> strData, everything else the tensor arm — the other half of
+        the reference binData contract (a bytes-in bytes-out transformer
+        responds with binData, not a mangled |S numpy array)."""
+        if isinstance(out, (bytes, bytearray)):
+            return msg.with_bin_data(out)
+        if isinstance(out, str):
+            return msg.with_str_data(out)
+        return msg.with_array(np.asarray(out), self._names_out(msg.names))
+
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         fn = getattr(self.user, "predict", None) or getattr(self.user, "transform_input", None)
         if fn is None:
             return msg
         out = await _maybe_await(fn(self._payload(msg), list(msg.names)))
-        return msg.with_array(np.asarray(out), self._names_out(msg.names))
+        return self._wrap_output(msg, out)
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
         fn = getattr(self.user, "transform_output", None)
         if fn is None:
             return msg
         out = await _maybe_await(fn(self._payload(msg), list(msg.names)))
-        return msg.with_array(np.asarray(out), self._names_out(msg.names))
+        return self._wrap_output(msg, out)
 
     async def route(self, msg: SeldonMessage) -> int:
         fn = getattr(self.user, "route", None)
@@ -158,15 +169,15 @@ class PythonClassUnit(Unit):
         xs = [self._payload(m) for m in msgs]
         names = [list(m.names) for m in msgs]
         out = await _maybe_await(fn(xs, names))
-        base = msgs[0]
-        return base.with_array(np.asarray(out), self._names_out(base.names))
+        return self._wrap_output(msgs[0], out)
 
     async def send_feedback(self, feedback: Feedback, routing: int) -> None:
         fn = getattr(self.user, "send_feedback", None)
         if fn is None:
             return
         req = feedback.request
-        x = np.asarray(req.array) if req is not None and req.array is not None else None
+        # same payload semantics as predict: tensor, else raw bytes/str
+        x = self._payload(req) if req is not None else None
         names = list(req.names) if req is not None else []
         truth = (
             np.asarray(feedback.truth.array)

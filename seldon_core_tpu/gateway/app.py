@@ -184,7 +184,7 @@ class Gateway:
 
 from seldon_core_tpu.serving.http_util import classify_binary_body
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict, wire_failure
 
 _log = logging.getLogger(__name__)
 
@@ -249,19 +249,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             if npy and out.bin_data is not None:
                 return npy_response(out)
             return web.json_response(message_to_dict(out))
-        except APIException as e:
-            if gw.metrics is not None:
-                gw.metrics.ingress_error("", "predict", e.error.code)
-            return _error_response(e)
-        except web.HTTPException:
-            raise  # aiohttp control flow (413 etc.) keeps its own status
-        except Exception as e:  # noqa: BLE001 - wire boundary: failures come
-            # back in the reference status-JSON shape, never an HTML 500
-            _log.exception("unhandled error at gateway predict")
-            err = APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(e))
-            if gw.metrics is not None:
-                gw.metrics.ingress_error("", "predict", err.error.code)
-            return _error_response(err)
+        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
+            return wire_failure(
+                e,
+                fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
+                op="gateway predict",
+                log=_log,
+                metrics_error=lambda c: gw.metrics is not None
+                and gw.metrics.ingress_error("", "predict", c),
+            )
 
     async def feedback(request: web.Request) -> web.Response:
         import time as _time
@@ -278,18 +274,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 )
                 gw.metrics.feedback(dep.name, "", "", fb.reward)
             return web.json_response(message_to_dict(out))
-        except APIException as e:
-            if gw.metrics is not None:
-                gw.metrics.ingress_error("", "feedback", e.error.code)
-            return _error_response(e)
-        except web.HTTPException:
-            raise
-        except Exception as e:  # noqa: BLE001 - same invariant as predict
-            _log.exception("unhandled error at gateway feedback")
-            err = APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(e))
-            if gw.metrics is not None:
-                gw.metrics.ingress_error("", "feedback", err.error.code)
-            return _error_response(err)
+        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
+            return wire_failure(
+                e,
+                fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
+                op="gateway feedback",
+                log=_log,
+                metrics_error=lambda c: gw.metrics is not None
+                and gw.metrics.ingress_error("", "feedback", c),
+            )
 
     async def ready(request: web.Request) -> web.Response:
         return web.Response(text="ready")

@@ -40,6 +40,31 @@ def error_response(exc: APIException) -> web.Response:
     return web.json_response(exc.to_status_json(), status=exc.error.http_status)
 
 
+def wire_failure(
+    e: BaseException,
+    *,
+    fallback_code: ErrorCode,
+    op: str,
+    log,
+    metrics_error,
+) -> web.Response:
+    """The wire-boundary invariant, in ONE place for engine and gateway:
+    every failure comes back in the reference status-JSON shape (never an
+    HTML 500), aiohttp control-flow exceptions (413 etc.) keep their own
+    status, and unhandled errors are logged with their stack before being
+    wrapped in the caller's tier code (ENGINE_* / APIFE_*).
+
+    ``metrics_error(code)`` records the ingress error for the caller's tier.
+    """
+    if isinstance(e, web.HTTPException):
+        raise e
+    if not isinstance(e, APIException):
+        log.exception("unhandled error serving %s", op)
+        e = APIException(fallback_code, str(e))
+    metrics_error(e.error.code)
+    return error_response(e)
+
+
 NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
 
 

@@ -32,7 +32,7 @@ from seldon_core_tpu.serving.service import PredictionService
 
 from seldon_core_tpu.serving.http_util import classify_binary_body
 from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict
+from seldon_core_tpu.serving.http_util import npy_response, payload_dict, wire_failure
 
 log = logging.getLogger(__name__)
 
@@ -75,42 +75,32 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
             return web.Response(
                 body=message_to_json_fast(out), content_type="application/json"
             )
-        except APIException as e:
-            service.metrics.ingress_error(
-                service.deployment_name, "predict", e.error.code
+        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
+            return wire_failure(
+                e,
+                fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                op="predict",
+                log=log,
+                metrics_error=lambda c: service.metrics.ingress_error(
+                    service.deployment_name, "predict", c
+                ),
             )
-            return _error_response(e)
-        except web.HTTPException:
-            raise  # aiohttp control flow (413 etc.) keeps its own status
-        except Exception as e:  # noqa: BLE001 - wire boundary: every failure
-            # must come back in the reference status-JSON shape, never an
-            # aiohttp HTML 500
-            log.exception("unhandled error serving predict")
-            err = APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, str(e))
-            service.metrics.ingress_error(
-                service.deployment_name, "predict", err.error.code
-            )
-            return _error_response(err)
 
     async def feedback(request: web.Request) -> web.Response:
         try:
             fb = feedback_from_dict(await _payload_dict(request))
             out = await service.send_feedback(fb)
             return web.json_response(message_to_dict(out))
-        except APIException as e:
-            service.metrics.ingress_error(
-                service.deployment_name, "feedback", e.error.code
+        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
+            return wire_failure(
+                e,
+                fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                op="feedback",
+                log=log,
+                metrics_error=lambda c: service.metrics.ingress_error(
+                    service.deployment_name, "feedback", c
+                ),
             )
-            return _error_response(e)
-        except web.HTTPException:
-            raise
-        except Exception as e:  # noqa: BLE001 - same invariant as predict
-            log.exception("unhandled error serving feedback")
-            err = APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, str(e))
-            service.metrics.ingress_error(
-                service.deployment_name, "feedback", err.error.code
-            )
-            return _error_response(err)
 
     async def ready(request: web.Request) -> web.Response:
         if state["paused"] or not service.executor.ready():

@@ -798,3 +798,64 @@ async def test_oversized_body_keeps_aiohttp_413():
         assert resp.status == 413
     finally:
         await client.close()
+
+
+async def test_bytes_in_bytes_out_user_transformer():
+    """Reference binData contract, both halves: user predict() receives raw
+    bytes AND a bytes return value ships as binData out (base64 in the JSON
+    envelope), not a mangled |S numpy array."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BinTransformer:
+        def predict(self, X, names):
+            assert isinstance(X, bytes)
+            return X + b"-processed"
+
+    unit = PythonClassUnit(pred.graph, BinTransformer())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    client = await _client(PredictionService(ex, deployment_name="d"))
+    try:
+        import base64
+
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=b"\x00payload",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert base64.b64decode(body["binData"]) == b"\x00payload-processed"
+    finally:
+        await client.close()
+
+
+async def test_feedback_payload_matches_predict_payload():
+    """send_feedback sees the same payload form predict saw (raw bytes for
+    binData requests), not None."""
+    from seldon_core_tpu.core.message import Feedback, Meta
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+    seen = {}
+
+    class FbUser:
+        def send_feedback(self, X, names, routing, reward, truth):
+            seen["x"] = X
+
+    pred.graph.methods.append("SEND_FEEDBACK")
+    unit = PythonClassUnit(pred.graph, FbUser())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    await ex.send_feedback(
+        Feedback(
+            request=SeldonMessage(bin_data=b"raw-bytes"),
+            response=SeldonMessage(meta=Meta(routing={})),
+            reward=1.0,
+        )
+    )
+    assert seen["x"] == b"raw-bytes"
