@@ -182,9 +182,12 @@ class Gateway:
         return dep
 
 
-from seldon_core_tpu.serving.http_util import classify_binary_body
-from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict, wire_failure
+from seldon_core_tpu.serving.http_util import (
+    classify_binary_body,
+    npy_response,
+    payload_dict,
+    wire_failure,
+)
 
 _log = logging.getLogger(__name__)
 

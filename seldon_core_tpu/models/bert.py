@@ -113,7 +113,12 @@ def _attention(p, x, num_heads, attn_impl=None):
 
 def _layer_apply(p, x, num_heads, attn_impl=None):
     x = _ln(p["ln1"], x + _attention(p, x, num_heads, attn_impl))
-    h = jax.nn.gelu(x @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype))
+    # erf gelu, not the tanh approximation: BERT (paper and HF) uses the
+    # exact form, so imported checkpoints reproduce their torch logits
+    h = jax.nn.gelu(
+        x @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
     h = h @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
     return _ln(p["ln2"], x + h)
 
@@ -157,13 +162,16 @@ def bert_pspecs(params: dict) -> dict:
             "ln2": {"scale": P(), "bias": P()},
         }
 
-    return {
+    specs = {
         "tok_emb": P(),
         "pos_emb": P(),
         "ln_emb": {"scale": P(), "bias": P()},
         "layers": [layer_spec(l) for l in params["layers"]],
         "head": {"w": P(), "b": P()},
     }
+    if "pooler" in params:  # imported checkpoints carry the HF tanh pooler
+        specs["pooler"] = {"w": P(), "b": P()}
+    return specs
 
 
 def bert_logits(params: dict, x: jax.Array, attn_impl=None) -> jax.Array:
@@ -176,6 +184,14 @@ def bert_logits(params: dict, x: jax.Array, attn_impl=None) -> jax.Array:
     for lp in params["layers"]:
         h = _layer_apply(lp, h, num_heads, attn_impl)
     cls = h[:, 0, :]  # [CLS] pooling
+    pooler = params.get("pooler")
+    if pooler is not None:
+        # HF/original BERT classification head: tanh pooler before the
+        # classifier (BertPooler) — present only on imported checkpoints,
+        # init_bert's native head classifies [CLS] directly
+        cls = jnp.tanh(
+            cls @ pooler["w"].astype(cls.dtype) + pooler["b"].astype(cls.dtype)
+        )
     return cls @ params["head"]["w"].astype(cls.dtype) + params["head"]["b"].astype(
         cls.dtype
     )

@@ -6,6 +6,8 @@ are JAX builders that the JAX_MODEL graph unit loads straight into HBM.
 ``model_uri`` schemes understood by unit_from_container:
     zoo://<name>[?k=v...]   build from this registry (fresh deterministic init)
     file://<path>           orbax checkpoint dir (params restored to device)
+    hf-bert://<path>[?seq=N]  local HF BertForSequenceClassification dir
+                            (save_pretrained), mapped via models/hf_import
 """
 
 from __future__ import annotations
@@ -196,6 +198,48 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
         from seldon_core_tpu.persistence.checkpoint import restore_model
 
         ms = restore_model(uri[len("file://") :])
+        return _runtime_from_modelspec(ms, tpu_cfg, mesh)
+    if uri.startswith("hf-bert://"):
+        # a LOCAL Hugging Face BertForSequenceClassification checkpoint dir
+        # (from save_pretrained): trained torch weights map into the
+        # jit-compiled BERT (models/hf_import.py) — torch leaves the loop
+        import transformers
+
+        from seldon_core_tpu.models.bert import (
+            _bert_apply_factory,
+            apply_bert,
+            bert_pspecs,
+        )
+        from seldon_core_tpu.models.hf_import import bert_params_from_hf
+
+        rest = uri[len("hf-bert://") :]
+        path, _, query = rest.partition("?")
+        kwargs = dict(urllib.parse.parse_qsl(query))
+        hf = transformers.BertForSequenceClassification.from_pretrained(path)
+        params = bert_params_from_hf(hf.eval())
+        id2label = getattr(hf.config, "id2label", None) or {}
+        class_names = tuple(
+            str(id2label[i]) for i in sorted(id2label)
+        ) or tuple(f"class_{i}" for i in range(params["head"]["w"].shape[1]))
+        seq = int(kwargs.get("seq", 128))
+        max_len = int(params["pos_emb"].shape[0])
+        if seq > max_len:
+            raise ValueError(
+                f"hf-bert seq={seq} exceeds the checkpoint's "
+                f"max_position_embeddings={max_len} — failing fast instead "
+                "of an opaque XLA broadcast error at warmup"
+            )
+        ms = ModelSpec(
+            apply_bert,
+            params,
+            (seq,),
+            class_names,
+            param_pspecs=bert_pspecs(params),
+            # same mesh-aware apply as zoo bert builders: a 'seq' mesh axis
+            # turns on ring attention for imported checkpoints too
+            apply_factory=_bert_apply_factory,
+            int_inputs="ids",
+        )
         return _runtime_from_modelspec(ms, tpu_cfg, mesh)
     raise ValueError(f"unsupported model_uri '{uri}'")
 

@@ -25,14 +25,15 @@ from seldon_core_tpu.core.codec_json import (
     message_to_dict,
     message_to_json_fast,
 )
-from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.errors import ErrorCode
 from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.serving.service import PredictionService
-
-
-from seldon_core_tpu.serving.http_util import classify_binary_body
-from seldon_core_tpu.serving.http_util import error_response as _error_response
-from seldon_core_tpu.serving.http_util import npy_response, payload_dict, wire_failure
+from seldon_core_tpu.serving.http_util import (
+    classify_binary_body,
+    npy_response,
+    payload_dict,
+    wire_failure,
+)
 
 log = logging.getLogger(__name__)
 
