@@ -18,6 +18,9 @@ What is measured:
   micro-batcher -> jitted model on the chip -> response, driven by the
   in-repo loadtest client (tools/loadtest.py, the locust-equivalent).
 - serving.resnet50_chip: same path with 224x224x3 image payloads.
+- serving.bert_base_chip: the transformer serving path (BASELINE's full-DAG
+  config centers on BERT-base) — npy integer token ids, seq 128, bucket 32,
+  ids->exact-int32 wire policy, bf16 compute.
 - serving.stack_ceiling_cpu: the identical serving bench in a subprocess on
   the host CPU backend — isolates the serving stack's own overhead from the
   chip tunnel (below).
@@ -254,6 +257,33 @@ def serving_resnet(duration_s: float = 10.0) -> dict:
     )
 
 
+def serving_bert(duration_s: float = 10.0) -> dict:
+    # the BASELINE full-DAG config centers on BERT-base; this measures the
+    # transformer serving path (ids wire -> int32 -> bucketed bf16 compute)
+    pred = _deployment(
+        {"model": "bert_base"},
+        {
+            "max_batch": 32,
+            "batch_buckets": [32],
+            "batch_timeout_ms": 10.0,
+            "dtype": "bfloat16",
+        },
+    )
+    # npy integer payloads: distinct random ids per request (JSON floats in
+    # [0,1) would truncate to all-zero ids — byte-identical buffers the
+    # tunnel content-caches, flattering the wire cost)
+    return asyncio.run(
+        _serve_and_load(
+            pred,
+            users=32,
+            batch=1,
+            features=128,
+            duration_s=duration_s,
+            payload_format="npy",
+        )
+    )
+
+
 def stack_ceiling_subprocess() -> dict | None:
     """Run the iris serving bench on the host CPU backend in a fresh process:
     the serving stack without the chip tunnel in the dispatch path."""
@@ -310,6 +340,7 @@ def main() -> None:
     if on_accel:
         serving["iris_chip"] = serving_iris()
         serving["resnet50_chip"] = serving_resnet()
+        serving["bert_base_chip"] = serving_bert()
         ceiling = stack_ceiling_subprocess()
         if ceiling is not None:
             serving["stack_ceiling_cpu"] = ceiling
