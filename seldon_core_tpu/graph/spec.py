@@ -148,6 +148,9 @@ class TpuSpec(_Spec):
     # and per-member observability
     fuse_graph: bool = True
     dtype: str = "float32"  # computation dtype: float32 | bfloat16
+    # weight-only int8 quantization ("int8" | ""): halves weight HBM traffic
+    # and residency; dequant fuses into the matmul inside jit (models/quant.py)
+    weight_quant: str = ""
     # donation only pays when output aliases input shape (e.g. transformers);
     # classifier heads change shape, so default off
     donate_input: bool = False

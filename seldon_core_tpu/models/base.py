@@ -59,11 +59,15 @@ class ModelRuntime:
         class_names: Sequence[str] = (),
         donate: bool = True,
         int_inputs: str = "cast",
+        weight_quant: str = "",
     ):
         self.apply_fn = apply_fn
         self.mesh = mesh
         self.data_axis = data_axis
         self.dtype = dtype
+        if weight_quant not in ("", "int8"):
+            raise ValueError(f"weight_quant must be '' or 'int8', got {weight_quant!r}")
+        self.weight_quant = weight_quant
         if int_inputs not in ("cast", "ids"):
             raise ValueError(f"int_inputs must be 'cast' or 'ids', got {int_inputs!r}")
         # "cast": integer payloads are VALUES (images/tabular) — normalize to
@@ -74,7 +78,52 @@ class ModelRuntime:
         self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
         self._lock = threading.Lock()
 
-        params = jax.tree.map(lambda a: jnp.asarray(a, dtype=self._param_dtype(a)), params)
+        if weight_quant == "int8":
+            # weight-only int8 (models/quant.py): quantize from the original
+            # precision, keep scales float32, dequantize INSIDE the jitted
+            # program where XLA fuses it into the matmul operand read
+            from seldon_core_tpu.models.quant import (
+                dequantize,
+                is_quantized_leaf,
+                quantize_params,
+                quantized_pspecs,
+            )
+
+            params = quantize_params(params)
+
+            def _place(x):
+                if is_quantized_leaf(x):
+                    # int8 payload as-is; scales STAY float32 (casting the
+                    # scale to bf16 would waste the per-channel precision)
+                    return {
+                        k: jnp.asarray(v) for k, v in x.items()
+                    }
+                return jnp.asarray(x, dtype=self._param_dtype(x))
+
+            params = jax.tree.map(_place, params, is_leaf=is_quantized_leaf)
+            if param_pspecs is not None:
+                param_pspecs = quantized_pspecs(param_pspecs, params)
+            inner_apply = apply_fn
+
+            def apply_fn(p, x):  # noqa: F811 - deliberate wrap
+                return inner_apply(dequantize(p, self.dtype), x)
+
+            # expose the wrapped apply: as_pure_fn consumers (graph fusion)
+            # must pair self.params (quantized) with an apply that dequantizes
+            self.apply_fn = apply_fn
+        else:
+            from seldon_core_tpu.models.quant import is_quantized_leaf
+
+            def _place_plain(a):
+                if is_quantized_leaf(a):
+                    # params may arrive ALREADY quantized (e.g. a fused graph
+                    # rebuilding a runtime from a quantized member): keep the
+                    # int8 payload and the f32 scale exactly as stored —
+                    # _param_dtype would silently downcast the scales
+                    return {k: jnp.asarray(v) for k, v in a.items()}
+                return jnp.asarray(a, dtype=self._param_dtype(a))
+
+            params = jax.tree.map(_place_plain, params, is_leaf=is_quantized_leaf)
 
         # Wire-dtype policy, enforced at the jit boundary:
         # - uint8 inputs (the binary image wire dtype) cast to the model

@@ -1,0 +1,96 @@
+"""Weight-only int8 quantization for serving (opt-in, per-channel).
+
+TPU-native perf lever with no reference analogue: transformer/MLP serving at
+small batch is WEIGHT-bandwidth bound — every forward streams the full
+parameter set from HBM while activations stay small. Storing weights as int8
+with per-output-channel float scales halves that traffic (vs bf16; 4x vs
+f32) and halves the HBM a deployment holds (multi-tenancy admission,
+operator/reconciler.py accounting reads the actual array bytes). The
+dequantize (scale * int8) runs INSIDE the jitted program, where XLA fuses it
+into the matmul operand load — the full-precision weight matrix is never
+materialized in HBM.
+
+Scheme: symmetric per-channel (last axis) int8 — ``w ≈ q * scale`` with
+``scale = max|w| / 127`` per output column. Quantized: floating leaves with
+ndim >= 2 and a leading dim <= 8192 (matmul/conv kernels). Exact: biases
+and norm vectors (ndim 1), and big gathered tables (vocab embeddings) —
+a gather from a fused dequant would MATERIALIZE the whole dequantized
+table per call, spending the bandwidth the scheme saves. Worst-case
+relative weight error is 1/254 per channel; classification outputs
+typically move < 1e-2.
+
+Enable per predictor with ``tpu: {weight_quant: "int8"}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_QKEY = "__int8_weight__"
+
+
+_MAX_LEAD_DIM = 8192  # above this the leaf is a gathered table, not a kernel
+
+
+def _eligible(a: np.ndarray) -> bool:
+    a = np.asarray(a)
+    return a.ndim >= 2 and a.dtype.kind == "f" and a.shape[0] <= _MAX_LEAD_DIM
+
+
+def quantize_params(params: Any) -> Any:
+    """float pytree -> pytree where eligible leaves become
+    {_QKEY: int8[...,], "scale": f32[out]} marker dicts (tree structure of
+    everything else unchanged)."""
+
+    def quant(a):
+        a = np.asarray(a)
+        if not _eligible(a):
+            return a
+        amax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)), keepdims=True)
+        scale = (amax / 127.0 + 1e-30).astype(np.float32)
+        q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+        return {_QKEY: q, "scale": scale.astype(np.float32)}
+
+    return jax.tree.map(quant, params)
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and _QKEY in x
+
+
+def dequantize(params: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Inverse transform, for use INSIDE jit: marker dicts -> dtype arrays.
+    XLA fuses the convert+multiply into the consuming matmul's operand
+    read, so the dequantized matrix never lands in HBM."""
+
+    def dequant(x):
+        if is_quantized_leaf(x):
+            # multiply in float32 THEN cast: rounding the f32 scale to bf16
+            # first would add error of the same magnitude as the int8 step
+            return (x[_QKEY].astype(jnp.float32) * x["scale"]).astype(dtype)
+        return x
+
+    return jax.tree.map(dequant, params, is_leaf=is_quantized_leaf)
+
+
+def quantized_pspecs(pspecs: Any, params: Any) -> Any:
+    """Mirror a PartitionSpec tree onto the quantized structure: a leaf's
+    spec applies to its int8 payload; scales are tiny and replicate.
+    PartitionSpec is itself a tuple-pytree, so it must be declared a leaf."""
+    from jax.sharding import PartitionSpec as P
+
+    def expand(spec, leaf):
+        if is_quantized_leaf(leaf):
+            return {_QKEY: spec, "scale": P()}
+        return spec
+
+    return jax.tree.map(
+        expand,
+        pspecs,
+        params,
+        is_leaf=lambda x: isinstance(x, P) or x is None,
+    )

@@ -169,6 +169,7 @@ def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
         class_names=ms.class_names,
         donate=getattr(tpu_cfg, "donate_input", True),
         int_inputs=ms.int_inputs,
+        weight_quant=getattr(tpu_cfg, "weight_quant", ""),
     )
     rt.feature_shape = ms.feature_shape
     return rt
@@ -264,6 +265,15 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
     if bool_param(params.get("finetune", False)):
         from seldon_core_tpu.graph.spec import TYPE_METHODS, PredictiveUnitMethod
         from seldon_core_tpu.models.online import OnlineFinetuneModelUnit
+
+        if getattr(runtime, "weight_quant", "") == "int8":
+            raise ValueError(
+                f"unit '{spec.name}': finetune=true cannot combine with "
+                "tpu.weight_quant='int8' — gradients over int8 weight "
+                "payloads are undefined and updates would corrupt the "
+                "frozen per-channel scales; serve the finetuning replica "
+                "unquantized"
+            )
 
         effective = tuple(spec.methods) or TYPE_METHODS.get(spec.type, ())
         if PredictiveUnitMethod.SEND_FEEDBACK not in effective:

@@ -172,12 +172,20 @@ def estimate_deployment_bytes(dep: SeldonDeployment) -> int:
                 continue
             import numpy as np
 
-            total += int(
-                sum(
-                    np.asarray(leaf).nbytes * dtype_factor
-                    for leaf in _tree_leaves(ms.params)
-                )
-            )
+            quantized = getattr(pred.tpu, "weight_quant", "") == "int8"
+            if quantized:
+                from seldon_core_tpu.models.quant import _eligible
+
+            def leaf_bytes(leaf) -> float:
+                a = np.asarray(leaf)
+                if quantized and _eligible(a):
+                    # int8 payload (1 byte/value) + per-channel f32 scales —
+                    # admission must see the real residency or a quantized
+                    # deployment that fits gets rejected before build
+                    return a.size + a.shape[-1] * 4
+                return a.nbytes * dtype_factor
+
+            total += int(sum(leaf_bytes(leaf) for leaf in _tree_leaves(ms.params)))
     return total
 
 

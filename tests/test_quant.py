@@ -1,0 +1,235 @@
+"""Weight-only int8 serving: numerics, structure, HBM accounting, TP specs."""
+
+
+import numpy as np
+import pytest
+
+
+def test_quantize_dequantize_roundtrip_and_selectivity():
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.quant import (
+        dequantize,
+        is_quantized_leaf,
+        quantize_params,
+    )
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w": rng.standard_normal((64, 32)).astype(np.float32),
+        "b": rng.standard_normal((32,)).astype(np.float32),  # 1-d: exact
+        "emb": rng.standard_normal((9000, 8)).astype(np.float32),  # table: exact
+        "step": np.int64(7),  # integer leaf: exact
+    }
+    q = quantize_params(params)
+    assert is_quantized_leaf(q["w"]) and q["w"]["__int8_weight__"].dtype == np.int8
+    assert not is_quantized_leaf(q["b"]) and q["b"] is params["b"]
+    assert not is_quantized_leaf(q["emb"])  # leading dim > 8192 stays exact
+    assert q["step"] == 7
+
+    deq = dequantize(q, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(deq["b"]), params["b"])
+    # per-channel error bound: |w - deq| <= scale/2 = max|w|/254 per column
+    err = np.abs(np.asarray(deq["w"]) - params["w"])
+    bound = np.abs(params["w"]).max(axis=0) / 254.0 + 1e-7
+    assert (err <= bound[None, :]).all()
+
+
+def test_int8_runtime_matches_float_and_halves_hbm():
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+    from seldon_core_tpu.models.zoo import get_model
+
+    ms = get_model("iris_mlp")
+    x = np.asarray([[5.1, 3.5, 1.4, 0.2], [6.7, 3.0, 5.2, 2.3]], np.float32)
+
+    rt_f = ModelRuntime(ms.apply_fn, ms.params, buckets=[4], dtype=jnp.float32)
+    rt_q = ModelRuntime(
+        ms.apply_fn, ms.params, buckets=[4], dtype=jnp.float32, weight_quant="int8"
+    )
+    want = rt_f.predict(x)
+    got = rt_q.predict(x)
+    np.testing.assert_allclose(got, want, atol=2e-2)
+    assert (np.argmax(got, 1) == np.argmax(want, 1)).all()
+
+    import jax
+
+    def nbytes(rt):
+        return sum(a.nbytes for a in jax.tree.leaves(rt.params))
+
+    # matmul weights dominate iris_mlp, so int8 storage shrinks params a lot
+    assert nbytes(rt_q) < 0.6 * nbytes(rt_f)
+
+
+def test_int8_bert_logits_close_and_tp_specs_build():
+    """Quantized BERT serves on a TP mesh: pspecs mirror onto the int8
+    structure and logits stay close to the float model."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from seldon_core_tpu.models.base import ModelRuntime
+    from seldon_core_tpu.models.bert import apply_bert, bert_pspecs, init_bert
+
+    params = init_bert(0, vocab=256, hidden=128, layers=2, ffn=256, max_len=32)
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2), ("data", "model"))
+
+    rt_f = ModelRuntime(
+        apply_bert, params, buckets=[4], dtype=jnp.float32, int_inputs="ids"
+    )
+    rt_q = ModelRuntime(
+        apply_bert,
+        params,
+        mesh=mesh,
+        param_pspecs=bert_pspecs(params),
+        buckets=[4],
+        dtype=jnp.float32,
+        int_inputs="ids",
+        weight_quant="int8",
+    )
+    ids = np.random.default_rng(0).integers(0, 256, (2, 16))
+    want = rt_f.predict(ids)
+    got = rt_q.predict(ids)
+    np.testing.assert_allclose(got, want, atol=3e-2)
+    assert (np.argmax(got, 1) == np.argmax(want, 1)).all()
+
+
+async def test_int8_deployment_through_cr():
+    """tpu.weight_quant in the CR flows to the runtime."""
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.engine.executor import build_executor
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+
+    cr = {
+        "spec": {
+            "name": "q",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "clf",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_mlp", "type": "STRING"}
+                        ],
+                    },
+                    "tpu": {"max_batch": 4, "weight_quant": "int8"},
+                }
+            ],
+        }
+    }
+    x = SeldonMessage.from_array(np.asarray([[5.1, 3.5, 1.4, 0.2]], np.float32))
+    pred = SeldonDeployment.from_dict(cr).spec.predictors[0]
+    out = await build_executor(pred).execute(x)
+    arr = np.asarray(out.array)
+    assert arr.shape == (1, 3)
+    np.testing.assert_allclose(arr.sum(axis=1), 1.0, rtol=1e-5)
+
+    # same CR without quantization: predictions agree closely
+    cr["spec"]["predictors"][0]["tpu"].pop("weight_quant")
+    pred_f = SeldonDeployment.from_dict(cr).spec.predictors[0]
+    want = np.asarray((await build_executor(pred_f).execute(x)).array)
+    np.testing.assert_allclose(arr, want, atol=2e-2)
+    assert int(np.argmax(arr)) == int(np.argmax(want))
+
+
+def test_bad_weight_quant_value_rejected():
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+
+    with pytest.raises(ValueError, match="weight_quant"):
+        ModelRuntime(lambda p, x: x, {}, buckets=[2], weight_quant="fp4")
+
+
+
+
+def test_finetune_refuses_quantized_runtime():
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+
+    cr = {
+        "spec": {
+            "name": "q",
+            "predictors": [
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "clf",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "iris_mlp", "type": "STRING"},
+                            {"name": "finetune", "value": "true", "type": "BOOL"},
+                        ],
+                    },
+                    "tpu": {"max_batch": 4, "weight_quant": "int8"},
+                }
+            ],
+        }
+    }
+    from seldon_core_tpu.engine.executor import build_executor
+
+    pred = SeldonDeployment.from_dict(cr).spec.predictors[0]
+    with pytest.raises(ValueError, match="finetune.*int8|int8.*finetune"):
+        build_executor(pred)
+
+
+def test_hbm_estimate_accounts_for_int8():
+    from seldon_core_tpu.operator.reconciler import estimate_deployment_bytes
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+
+    def cr(quant):
+        tpu = {"max_batch": 4}
+        if quant:
+            tpu["weight_quant"] = "int8"
+        return SeldonDeployment.from_dict(
+            {
+                "spec": {
+                    "name": "q",
+                    "predictors": [
+                        {
+                            "name": "p",
+                            "graph": {
+                                "name": "clf",
+                                "type": "MODEL",
+                                "implementation": "JAX_MODEL",
+                                "parameters": [
+                                    {
+                                        "name": "model",
+                                        "value": "iris_mlp",
+                                        "type": "STRING",
+                                    }
+                                ],
+                            },
+                            "tpu": tpu,
+                        }
+                    ],
+                }
+            }
+        )
+
+    full = estimate_deployment_bytes(cr(False))
+    quant = estimate_deployment_bytes(cr(True))
+    assert 0 < quant < 0.6 * full  # admission sees the real int8 residency
+
+
+def test_prequantized_params_keep_f32_scales_in_plain_runtime():
+    """A runtime built WITHOUT weight_quant from already-quantized params
+    (fused-graph rebuild path) must not downcast the stored scales."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+    from seldon_core_tpu.models.quant import dequantize, quantize_params
+
+    w = np.random.default_rng(0).standard_normal((16, 8)).astype(np.float32)
+    qparams = quantize_params({"w": w})
+
+    def apply_fn(p, x):
+        return x @ dequantize(p, x.dtype)["w"]
+
+    rt = ModelRuntime(apply_fn, qparams, buckets=[2], dtype=jnp.bfloat16)
+    assert rt.params["w"]["scale"].dtype == jnp.float32  # not downcast
+    y = rt.predict(np.ones((1, 16), np.float32))
+    assert np.isfinite(y).all()
