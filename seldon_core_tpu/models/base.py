@@ -104,9 +104,12 @@ class ModelRuntime:
             if param_pspecs is not None:
                 param_pspecs = quantized_pspecs(param_pspecs, params)
             inner_apply = apply_fn
+            compute_dtype = self.dtype  # capture the value, not self: the
+            # closure escapes via as_pure_fn into fused runtimes, and
+            # capturing self would pin this runtime's params + executables
 
             def apply_fn(p, x):  # noqa: F811 - deliberate wrap
-                return inner_apply(dequantize(p, self.dtype), x)
+                return inner_apply(dequantize(p, compute_dtype), x)
 
             # expose the wrapped apply: as_pure_fn consumers (graph fusion)
             # must pair self.params (quantized) with an apply that dequantizes

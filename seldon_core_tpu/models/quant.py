@@ -47,6 +47,8 @@ def quantize_params(params: Any) -> Any:
     everything else unchanged)."""
 
     def quant(a):
+        if is_quantized_leaf(a):
+            return a  # idempotent: already-quantized leaves pass through
         a = np.asarray(a)
         if not _eligible(a):
             return a
@@ -55,7 +57,7 @@ def quantize_params(params: Any) -> Any:
         q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
         return {_QKEY: q, "scale": scale.astype(np.float32)}
 
-    return jax.tree.map(quant, params)
+    return jax.tree.map(quant, params, is_leaf=is_quantized_leaf)
 
 
 def is_quantized_leaf(x: Any) -> bool:
@@ -75,6 +77,18 @@ def dequantize(params: Any, dtype: Any = jnp.bfloat16) -> Any:
         return x
 
     return jax.tree.map(dequant, params, is_leaf=is_quantized_leaf)
+
+
+def quantized_nbytes(leaf: Any, nonquant_factor: float = 1.0) -> int:
+    """Residency of one param leaf under this scheme, for pre-build HBM
+    admission estimates (operator/reconciler.py): eligible kernels store
+    int8 payload + per-channel f32 scales; non-eligible leaves follow the
+    predictor's compute dtype (``nonquant_factor``, e.g. 0.5 for bf16).
+    Lives HERE so the estimator can never drift from the actual scheme."""
+    a = np.asarray(leaf)
+    if _eligible(a):
+        return int(a.size + a.shape[-1] * 4)
+    return int(a.nbytes * nonquant_factor)
 
 
 def quantized_pspecs(pspecs: Any, params: Any) -> Any:

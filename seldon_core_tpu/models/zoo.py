@@ -259,21 +259,23 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
         uri = getattr(container, "model_uri", "") or None
     if uri is None:
         raise ValueError(f"JAX_MODEL unit '{spec.name}' needs a model_uri parameter")
-    runtime = build_runtime_from_uri(uri, context.get("tpu"), context.get("mesh"))
     from seldon_core_tpu.graph.spec import bool_param
 
-    if bool_param(params.get("finetune", False)):
+    finetune = bool_param(params.get("finetune", False))
+    # invalid config fails BEFORE any params are built or device_put —
+    # admission-protected HBM must not be touched for a doomed deployment
+    if finetune and getattr(context.get("tpu"), "weight_quant", "") == "int8":
+        raise ValueError(
+            f"unit '{spec.name}': finetune=true cannot combine with "
+            "tpu.weight_quant='int8' — gradients over int8 weight payloads "
+            "are undefined and updates would corrupt the frozen per-channel "
+            "scales; serve the finetuning replica unquantized"
+        )
+    runtime = build_runtime_from_uri(uri, context.get("tpu"), context.get("mesh"))
+
+    if finetune:
         from seldon_core_tpu.graph.spec import TYPE_METHODS, PredictiveUnitMethod
         from seldon_core_tpu.models.online import OnlineFinetuneModelUnit
-
-        if getattr(runtime, "weight_quant", "") == "int8":
-            raise ValueError(
-                f"unit '{spec.name}': finetune=true cannot combine with "
-                "tpu.weight_quant='int8' — gradients over int8 weight "
-                "payloads are undefined and updates would corrupt the "
-                "frozen per-channel scales; serve the finetuning replica "
-                "unquantized"
-            )
 
         effective = tuple(spec.methods) or TYPE_METHODS.get(spec.type, ())
         if PredictiveUnitMethod.SEND_FEEDBACK not in effective:

@@ -174,16 +174,18 @@ def estimate_deployment_bytes(dep: SeldonDeployment) -> int:
 
             quantized = getattr(pred.tpu, "weight_quant", "") == "int8"
             if quantized:
-                from seldon_core_tpu.models.quant import _eligible
+                # the scheme's own residency formula — admission must see
+                # the real int8 footprint or a quantized deployment that
+                # fits the budget gets rejected before build
+                from seldon_core_tpu.models.quant import quantized_nbytes
 
-            def leaf_bytes(leaf) -> float:
-                a = np.asarray(leaf)
-                if quantized and _eligible(a):
-                    # int8 payload (1 byte/value) + per-channel f32 scales —
-                    # admission must see the real residency or a quantized
-                    # deployment that fits gets rejected before build
-                    return a.size + a.shape[-1] * 4
-                return a.nbytes * dtype_factor
+                def leaf_bytes(leaf) -> float:
+                    return quantized_nbytes(leaf, nonquant_factor=dtype_factor)
+
+            else:
+
+                def leaf_bytes(leaf) -> float:
+                    return np.asarray(leaf).nbytes * dtype_factor
 
             total += int(sum(leaf_bytes(leaf) for leaf in _tree_leaves(ms.params)))
     return total

@@ -233,3 +233,36 @@ def test_prequantized_params_keep_f32_scales_in_plain_runtime():
     assert rt.params["w"]["scale"].dtype == jnp.float32  # not downcast
     y = rt.predict(np.ones((1, 16), np.float32))
     assert np.isfinite(y).all()
+
+
+def test_quantize_params_is_idempotent():
+    from seldon_core_tpu.models.quant import is_quantized_leaf, quantize_params
+
+    w = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+    once = quantize_params({"w": w})
+    twice = quantize_params(once)
+    assert is_quantized_leaf(twice["w"])
+    np.testing.assert_array_equal(
+        twice["w"]["__int8_weight__"], once["w"]["__int8_weight__"]
+    )
+
+
+def test_quantized_nbytes_matches_actual_residency():
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.base import ModelRuntime
+    from seldon_core_tpu.models.quant import quantized_nbytes
+    from seldon_core_tpu.models.zoo import get_model
+
+    ms = get_model("iris_mlp")
+    rt = ModelRuntime(
+        ms.apply_fn, ms.params, buckets=[4], dtype=jnp.float32, weight_quant="int8"
+    )
+    actual = sum(a.nbytes for a in jax.tree.leaves(rt.params))
+
+    estimated = sum(
+        quantized_nbytes(leaf, nonquant_factor=1.0)
+        for leaf in jax.tree.leaves(ms.params)
+    )
+    assert estimated == actual
