@@ -356,9 +356,10 @@ def make_python_class_unit(spec: PredictiveUnit, context: dict):
         raise APIException(
             ErrorCode.ENGINE_MICROSERVICE_ERROR,
             f"PYTHON_CLASS unit '{spec.name}' refused: this platform was not "
-            "started with allow_python_class (set "
-            "SELDON_TPU_ALLOW_PYTHON_CLASS=1 or Reconciler("
-            "allow_python_class=True) to let CRs load local code in-process)",
+            "started with allow_python_class (start with "
+            "--allow-python-class, set SELDON_TPU_ALLOW_PYTHON_CLASS=1, or "
+            "DeploymentManager(allow_python_class=True) to let CRs load "
+            "local code in-process)",
         )
     params = parameters_dict(spec.parameters)
     try:

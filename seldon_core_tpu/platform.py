@@ -50,6 +50,7 @@ class Platform:
         metrics_enabled: bool = True,
         state_store_url: str = "",
         hbm_budget_bytes: int | None = None,
+        allow_python_class: bool | None = None,
     ):
         self.metrics = get_metrics(metrics_enabled)
         self.oauth = OAuthProvider(token_store=make_token_store(token_store_url))
@@ -68,6 +69,7 @@ class Platform:
             metrics=self.metrics,
             state_store_url=state_store_url,
             hbm_budget_bytes=hbm_budget_bytes,
+            allow_python_class=allow_python_class,
         )
 
     def build_app(self) -> web.Application:
@@ -121,6 +123,8 @@ async def _amain(args) -> None:
         hbm_budget_bytes=int(args.hbm_budget_gb * (1 << 30))
         if args.hbm_budget_gb
         else None,
+        # None -> DeploymentManager falls back to SELDON_TPU_ALLOW_PYTHON_CLASS
+        allow_python_class=True if args.allow_python_class else None,
     )
     for path in args.apply or []:
         import json as _json
@@ -176,6 +180,13 @@ def main() -> None:
         help="reject deployments whose params would exceed this HBM budget (0 = unlimited)",
     )
     parser.add_argument("--no-grpc", action="store_true")
+    parser.add_argument(
+        "--allow-python-class",
+        action="store_true",
+        help="let CRs mount local user classes in-process (PYTHON_CLASS "
+        "implementation) — CR authors gain code execution in this process, "
+        "so only enable when every CR source is trusted",
+    )
     args = parser.parse_args()
     if args.no_grpc:
         args.grpc_port = None

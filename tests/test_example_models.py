@@ -249,3 +249,17 @@ def test_example_dirs_have_contracts():
     assert len(dirs) >= 5
     for d in dirs:
         assert os.path.exists(os.path.join(d, "contract.json")), d
+
+
+def test_platform_allow_python_class_flag(monkeypatch):
+    """The platform CLI flag reaches the reconciler's gate."""
+    from seldon_core_tpu.platform import Platform
+
+    # hermetic against the ambient env var the gate falls back to
+    monkeypatch.delenv("SELDON_TPU_ALLOW_PYTHON_CLASS", raising=False)
+    assert Platform(metrics_enabled=False).manager.allow_python_class is False
+    assert (
+        Platform(metrics_enabled=False, allow_python_class=True)
+        .manager.allow_python_class
+        is True
+    )
