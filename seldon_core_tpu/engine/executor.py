@@ -202,13 +202,22 @@ class GraphExecutor:
             groups: dict[int, list[int]] = {}
             for i, b in enumerate(branches):
                 groups.setdefault(b, []).append(i)
-            results: list[SeldonMessage | None] = [None] * len(msgs)
-            for b, idxs in groups.items():
+
+            # branch groups are disjoint request sets: walk them CONCURRENTLY
+            # (reference @Async child fan-out semantics) — sequential awaits
+            # would stack an A/B split's two branch latencies
+            async def _run_group(b: int, idxs: list[int]):
                 sub = [msgs[i] for i in idxs]
                 if b == ROUTE_ALL:
                     outs = await self._fanout_many(node, sub, spans)
                 else:
                     outs = await self._get_output_many(node.children[b], sub, spans)
+                return idxs, outs
+
+            results: list[SeldonMessage | None] = [None] * len(msgs)
+            for idxs, outs in await asyncio.gather(
+                *(_run_group(b, idxs) for b, idxs in groups.items())
+            ):
                 for i, o in zip(idxs, outs):
                     results[i] = o
             out_msgs = results  # type: ignore[assignment]
