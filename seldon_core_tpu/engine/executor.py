@@ -69,6 +69,19 @@ def _has_method(node: Node, method: PredictiveUnitMethod) -> bool:
     return True
 
 
+async def _gather_settled(*aws):
+    """gather that lets every sibling SETTLE before failing: with plain
+    gather a raising branch returns control while its siblings keep running
+    detached, so side-effectful units (feedback state, user classes,
+    metrics) could still execute for a request whose response is already an
+    error. All-settle-then-reraise keeps a failed walk atomic."""
+    results = await asyncio.gather(*aws, return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return results
+
+
 class GraphExecutor:
     """Executes one predictor graph. One instance per predictor per process —
     the reference runs one engine pod per predictor; we run one executor
@@ -215,7 +228,7 @@ class GraphExecutor:
                 return idxs, outs
 
             results: list[SeldonMessage | None] = [None] * len(msgs)
-            for idxs, outs in await asyncio.gather(
+            for idxs, outs in await _gather_settled(
                 *(_run_group(b, idxs) for b, idxs in groups.items())
             ):
                 for i, o in zip(idxs, outs):
@@ -241,7 +254,7 @@ class GraphExecutor:
             child_outs = [await self._get_output_many(targets[0], msgs, spans)]
         else:
             child_outs = list(
-                await asyncio.gather(
+                await _gather_settled(
                     *(self._get_output_many(c, msgs, spans) for c in targets)
                 )
             )
@@ -319,7 +332,7 @@ class GraphExecutor:
             child_outputs = [await self._get_output(targets[0], msg, spans)]
         else:
             child_outputs = list(
-                await asyncio.gather(
+                await _gather_settled(
                     *(self._get_output(c, msg, spans) for c in targets)
                 )
             )
@@ -366,7 +379,7 @@ class GraphExecutor:
         if not node.children:
             return
         if branch == ROUTE_ALL:
-            await asyncio.gather(*(self._send_feedback(c, feedback) for c in node.children))
+            await _gather_settled(*(self._send_feedback(c, feedback) for c in node.children))
         else:
             if not (0 <= branch < len(node.children)):
                 raise APIException(

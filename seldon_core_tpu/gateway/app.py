@@ -27,6 +27,7 @@ from seldon_core_tpu.core.codec_json import (
     message_from_dict,
     message_to_dict,
 )
+from seldon_core_tpu.core.codec_npy import is_npy
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, SeldonMessage
 from seldon_core_tpu.gateway.audit import AuditSink, NullAuditSink
@@ -231,15 +232,29 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         try:
             principal = gw._principal(request)
             dep = gw._deployment(principal)
-            kind, raw = await classify_binary_body(request)
+            # the deployment's npy opt-out governs wire-level sniffing too;
+            # predictors of one deployment share wire semantics, so the
+            # first predictor's toggle speaks for the deployment
+            sniff = (
+                dep.predictors[0].tpu.decode_npy_bindata if dep.predictors else True
+            )
+            kind, raw = await classify_binary_body(request, sniff_npy=sniff)
             npy = kind == "npy"
-            if kind != "json":
-                # npy: binary tensor fast path, same contract as the engine
-                # REST surface (raw npy in, raw npy + Seldon-Meta out).
-                # bin: deliberate octet-stream, opaque binData passthrough.
-                # The in-process backend hands either to the service
-                # ingress; the remote backend forwards them as binData in
-                # the JSON envelope (base64) — correct either way.
+            if kind == "npy":
+                # binary tensor fast path, same contract as the engine REST
+                # surface (raw npy in, raw npy + Seldon-Meta out). The
+                # gateway decodes HERE — where the wire declaration lives —
+                # so the tensor arm reaches any backend (in-process or a
+                # remote engine hop) even when the deployment opted out of
+                # binData sniffing; the response is re-encoded below.
+                from seldon_core_tpu.core.codec_npy import array_from_npy
+
+                msg = SeldonMessage.from_array(array_from_npy(raw))
+            elif kind == "bin":
+                # deliberate octet-stream: opaque binData passthrough. The
+                # in-process backend hands it to the service ingress; the
+                # remote backend forwards it as binData in the JSON
+                # envelope (base64) — correct either way.
                 msg = SeldonMessage(bin_data=raw)
             else:
                 msg = message_from_dict(await _payload_dict(request))
@@ -249,8 +264,15 @@ def build_gateway_app(gw: Gateway) -> web.Application:
                 gw.metrics.ingress_request(
                     dep.name, "predict", _time.perf_counter() - start
                 )
-            if npy and out.bin_data is not None:
-                return npy_response(out)
+            if npy:
+                # mirror the request kind (tensor out -> npy binData); the
+                # is_npy guard keeps opaque bytes-out responses in the JSON
+                # envelope instead of a falsely-labeled application/x-npy
+                from seldon_core_tpu.serving.service import mirror_npy_kind
+
+                out = mirror_npy_kind(out)
+                if is_npy(out.bin_data):
+                    return npy_response(out)
             return web.json_response(message_to_dict(out))
         except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
             return wire_failure(

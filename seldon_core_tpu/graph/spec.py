@@ -154,6 +154,13 @@ class TpuSpec(_Spec):
     # donation only pays when output aliases input shape (e.g. transformers);
     # classifier heads change shape, so default off
     donate_input: bool = False
+    # True: binData that parses as npy decodes to the tensor arm at ingress
+    # (the binary tensor fast path), including base64 binData inside the
+    # JSON envelope. False: binData is NEVER sniffed — opaque passthrough
+    # everywhere (the reference's unconditional oneof semantics), for graphs
+    # whose PYTHON_CLASS units speak a bytes contract that could collide
+    # with the npy magic.
+    decode_npy_bindata: bool = True
 
 
 class ContainerSpec(_Spec):

@@ -84,5 +84,15 @@ def validate_deployment(dep: SeldonDeployment) -> None:
         if pred.tpu.dtype not in ("float32", "bfloat16", "float16"):
             problems.append(f"predictor '{pred.name}' dtype '{pred.tpu.dtype}' unsupported")
 
+    # wire semantics are DEPLOYMENT-level: the gateway classifies a body
+    # before it knows which predictor will serve it, so predictors must
+    # agree on whether binData is sniffed for npy
+    toggles = {p.tpu.decode_npy_bindata for p in dep.spec.predictors}
+    if len(toggles) > 1:
+        problems.append(
+            "all predictors must agree on tpu.decode_npy_bindata "
+            "(wire-level sniffing is per-deployment, not per-predictor)"
+        )
+
     if problems:
         raise ValidationError(problems)

@@ -76,6 +76,13 @@ class ModelRuntime:
         self.int_inputs = int_inputs
         self.class_names = tuple(class_names)
         self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
+        if mesh is not None and data_axis in mesh.axis_names:
+            # batch shards over the data axis, so every compiled bucket must
+            # be divisible by its size — a bucket-1 program on a data=8 mesh
+            # is not shardable. Round buckets up to the axis multiple (padding
+            # covers the difference, exactly as for non-power-of-two batches).
+            d = int(mesh.shape[data_axis])
+            self.buckets = tuple(sorted({((b + d - 1) // d) * d for b in self.buckets}))
         self._lock = threading.Lock()
 
         if weight_quant == "int8":

@@ -253,19 +253,33 @@ class DeploymentManager:
     # ------------------------------------------------------------ factories
     def _default_service_factory(self, dep: SeldonDeployment, predictor):
         from seldon_core_tpu.engine import build_executor
+        from seldon_core_tpu.parallel.mesh import mesh_from_spec
         from seldon_core_tpu.serving.batcher import make_batcher
         from seldon_core_tpu.serving.service import PredictionService
 
         dep_name = dep.spec.name or dep.metadata.name
         metrics = self.metrics
         unit_call_hook = None
+        feedback_hook = None
         if metrics is not None:
             def unit_call_hook(unit_name, method, duration_s):  # noqa: E306
                 metrics.unit_call(dep_name, predictor.name, unit_name, method, duration_s)
 
+            def feedback_hook(unit_name, reward):  # noqa: E306
+                metrics.feedback(dep_name, predictor.name, unit_name, reward)
+
+        # the CR's tpu.mesh governs sharding on EVERY path into the platform
+        # (dir watcher, control API, k8s watcher, CLI), same as the standalone
+        # PredictorServer — defaulting wrote mesh {"data": n_devices} into the
+        # spec, so the executor must honor it or the platform serves on one
+        # device while recording an n-device sharding
         executor = build_executor(
             predictor,
-            context={"allow_python_class": self.allow_python_class},
+            context={
+                "allow_python_class": self.allow_python_class,
+                "mesh": mesh_from_spec(predictor.tpu.mesh),
+            },
+            feedback_metrics_hook=feedback_hook,
             unit_call_hook=unit_call_hook,
         )
         batcher = make_batcher(
@@ -281,6 +295,7 @@ class DeploymentManager:
             predictor_name=predictor.name,
             batcher=batcher,
             metrics=self.metrics,
+            decode_npy=predictor.tpu.decode_npy_bindata,
         )
 
     def _make_persister(self, name: str, services: dict):

@@ -68,12 +68,18 @@ def wire_failure(
 NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
 
 
-async def classify_binary_body(request: web.Request) -> tuple[str, bytes | None]:
+async def classify_binary_body(
+    request: web.Request, sniff_npy: bool = True
+) -> tuple[str, bytes | None]:
     """Route a predictions body to its wire handler: ``("npy", raw)``,
     ``("bin", raw)`` or ``("json", None)``.
 
-    - ``application/x-npy`` commits to the npy tensor path by declaration;
-    - ``application/octet-stream`` with the npy magic is npy too;
+    - ``application/x-npy`` commits to the npy tensor path by declaration
+      (an explicit client opt-in, honored regardless of ``sniff_npy``);
+    - ``application/octet-stream`` with the npy magic is npy too — unless
+      ``sniff_npy`` is False (tpu.decode_npy_bindata opt-out: a deployment
+      whose bytes contract can collide with the npy magic keeps every
+      octet-stream opaque);
     - ``application/octet-stream`` WITHOUT the magic splits on whether the
       client actually sent the header: a deliberate octet-stream is opaque
       binData (reference oneof passthrough semantics), but aiohttp reports
@@ -88,7 +94,7 @@ async def classify_binary_body(request: web.Request) -> tuple[str, bytes | None]
     if ctype not in NPY_CONTENT_TYPES:
         return ("json", None)
     raw = await request.read()
-    if ctype == "application/x-npy" or is_npy(raw):
+    if ctype == "application/x-npy" or (sniff_npy and is_npy(raw)):
         return ("npy", raw)
     if "Content-Type" in request.headers:
         return ("bin", raw)

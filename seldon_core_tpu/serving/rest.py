@@ -25,6 +25,7 @@ from seldon_core_tpu.core.codec_json import (
     message_to_dict,
     message_to_json_fast,
 )
+from seldon_core_tpu.core.codec_npy import is_npy
 from seldon_core_tpu.core.errors import ErrorCode
 from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.serving.service import PredictionService
@@ -51,15 +52,22 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
     async def predictions(request: web.Request) -> web.Response:
         try:
             ctype = request.content_type or ""
-            kind, raw = await classify_binary_body(request)
+            kind, raw = await classify_binary_body(
+                request, sniff_npy=service.decode_npy
+            )
             if kind != "json":
                 # "npy": binary tensor fast path — the raw body IS the npy
                 # tensor, no JSON envelope, no base64 (codec_npy rationale);
                 # the service mirrors the kind, so out.bin_data is npy too.
                 # "bin": deliberate octet-stream — opaque binData flowing
                 # through the graph untouched (reference oneof semantics).
-                out = await service.predict(SeldonMessage(bin_data=raw))
-                if kind == "npy" and out.bin_data is not None:
+                out = await service.predict(
+                    SeldonMessage(bin_data=raw), wire_npy=kind == "npy"
+                )
+                # is_npy guard: a bytes-out unit can answer an npy request
+                # with opaque bytes — serving those as application/x-npy
+                # would lie about the body; fall back to the JSON envelope
+                if kind == "npy" and is_npy(out.bin_data):
                     return npy_response(out)
                 # opaque binData (and any tensor produced from bytes) keeps
                 # the JSON envelope — base64 binData, the pre-npy contract

@@ -87,6 +87,7 @@ class PredictorServer:
             predictor_name=predictor.name,
             batcher=self.batcher,
             metrics=self.metrics,
+            decode_npy=predictor.tpu.decode_npy_bindata,
         )
         self.state = {"paused": False}
         self.app = build_app(self.service, self.state, metrics=self.metrics)

@@ -16,6 +16,29 @@ from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu.serving.batcher import MicroBatcher
 
 
+def mirror_npy_kind(out: SeldonMessage) -> SeldonMessage:
+    """Re-encode a tensor response as npy binData (the response mirrors an
+    npy request's kind). Class names ride a tag so the binary response does
+    not silently drop them — but only when small: a 1000-class model's
+    names would dwarf the payload metadata (and overflow HTTP header limits
+    on the raw path). Non-tensor responses pass through unchanged."""
+    if out.data is None:
+        return out
+    tags = dict(out.meta.tags)
+    if out.names and len(out.names) <= 64:
+        tags["names"] = list(out.names)
+    return SeldonMessage(
+        bin_data=npy_from_array(out.array),
+        meta=Meta(
+            puid=out.meta.puid,
+            tags=tags,
+            routing=dict(out.meta.routing),
+            request_path=dict(out.meta.request_path),
+        ),
+        status=out.status,
+    )
+
+
 class PredictionService:
     def __init__(
         self,
@@ -25,19 +48,26 @@ class PredictionService:
         predictor_name: str = "",
         batcher: MicroBatcher | None = None,
         metrics: NullMetrics | None = None,
+        decode_npy: bool = True,
     ):
         self.executor = executor
         self.deployment_name = deployment_name
         self.predictor_name = predictor_name
         self.batcher = batcher
         self.metrics = metrics or NullMetrics()
+        # per-deployment toggle (tpu.decode_npy_bindata): False keeps every
+        # binData opaque — reference oneof passthrough for bytes-contract
+        # graphs whose payloads could collide with the npy magic
+        self.decode_npy = decode_npy
 
-    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+    async def predict(self, msg: SeldonMessage, *, wire_npy: bool = False) -> SeldonMessage:
         start = time.perf_counter()
         # binary tensor fast path: npy binData decodes to the tensor arm
         # before the batcher; the response mirrors the request's kind.
         # Non-npy binData stays opaque passthrough (reference semantics).
-        npy_requested = is_npy(msg.bin_data)
+        # wire_npy: the wire layer saw an EXPLICIT application/x-npy
+        # declaration — honored even when sniffing (decode_npy) is off.
+        npy_requested = wire_npy or (self.decode_npy and is_npy(msg.bin_data))
         if npy_requested:
             msg = SeldonMessage.from_array(
                 array_from_npy(msg.bin_data), meta=msg.meta
@@ -65,25 +95,8 @@ class PredictionService:
                     request_path=dict(out.meta.request_path),
                 )
             )
-        if npy_requested and out.data is not None:
-            # mirror the request kind; class names ride a tag so the binary
-            # response does not silently drop them
-            tags = dict(out.meta.tags)
-            # names ride a tag so the binary response keeps them — but only
-            # when small: a 1000-class model's names would dwarf the payload
-            # metadata (and overflow HTTP header limits on the raw path)
-            if out.names and len(out.names) <= 64:
-                tags["names"] = list(out.names)
-            out = SeldonMessage(
-                bin_data=npy_from_array(out.array),
-                meta=Meta(
-                    puid=out.meta.puid,
-                    tags=tags,
-                    routing=dict(out.meta.routing),
-                    request_path=dict(out.meta.request_path),
-                ),
-                status=out.status,
-            )
+        if npy_requested:
+            out = mirror_npy_kind(out)
         self.metrics.ingress_request(
             self.deployment_name, "predict", time.perf_counter() - start
         )

@@ -263,3 +263,121 @@ def test_platform_allow_python_class_flag(monkeypatch):
         .manager.allow_python_class
         is True
     )
+
+
+def test_load_user_object_isolates_same_named_siblings(tmp_path):
+    """ADVICE r2: sibling modules the entry file imports must not leak
+    across model dirs — two CRs whose dirs both ship utils.py get their OWN
+    utils, and model_dir leaves sys.path after the load."""
+    import sys
+
+    from seldon_core_tpu.serving.microservice import load_user_object
+
+    for tag in ("a", "b"):
+        d = tmp_path / tag
+        d.mkdir()
+        (d / "helper_mod.py").write_text(f"TAG = '{tag}'\n")
+        (d / "Model.py").write_text(
+            "import helper_mod\n"
+            "class Model:\n"
+            "    def predict(self, X, names):\n"
+            "        return helper_mod.TAG\n"
+        )
+    ua = load_user_object("Model", str(tmp_path / "a"))
+    ub = load_user_object("Model", str(tmp_path / "b"))
+    assert ua.predict(None, []) == "a"
+    assert ub.predict(None, []) == "b"  # not "a": sibling is per-dir
+    assert "helper_mod" not in sys.modules  # bare name re-keyed
+    assert str(tmp_path / "a") not in sys.path
+    assert str(tmp_path / "b") not in sys.path
+
+
+def test_load_user_object_isolates_package_siblings_and_package_entry(tmp_path):
+    """Code-review r3: sibling PACKAGES (pkg/__init__.py) and package-form
+    entry modules (Model/__init__.py) get the same per-dir isolation as
+    flat sibling files."""
+    import sys
+
+    from seldon_core_tpu.serving.microservice import load_user_object
+
+    # sibling package case
+    for tag in ("a", "b"):
+        d = tmp_path / tag
+        (d / "pkg").mkdir(parents=True)
+        (d / "pkg" / "__init__.py").write_text(f"TAG = '{tag}'\n")
+        (d / "Model.py").write_text(
+            "import pkg\n"
+            "class Model:\n"
+            "    def predict(self, X, names):\n"
+            "        return pkg.TAG\n"
+        )
+    ua = load_user_object("Model", str(tmp_path / "a"))
+    ub = load_user_object("Model", str(tmp_path / "b"))
+    assert ua.predict(None, []) == "a"
+    assert ub.predict(None, []) == "b"
+    assert "pkg" not in sys.modules
+
+    # package-form entry module case
+    for tag in ("pa", "pb"):
+        d = tmp_path / tag
+        (d / "PkgModel").mkdir(parents=True)
+        (d / "PkgModel" / "__init__.py").write_text(
+            f"class PkgModel:\n    def predict(self, X, names):\n        return '{tag}'\n"
+        )
+    upa = load_user_object("PkgModel", str(tmp_path / "pa"))
+    upb = load_user_object("PkgModel", str(tmp_path / "pb"))
+    assert upa.predict(None, []) == "pa"
+    assert upb.predict(None, []) == "pb"
+    assert "PkgModel" not in sys.modules
+    for tag in ("a", "b", "pa", "pb"):
+        assert str(tmp_path / tag) not in sys.path
+
+
+def test_user_state_with_sibling_class_survives_pickle(tmp_path):
+    """Code-review r3: persistence pickles the user object's __dict__; a
+    sibling-class instance inside it must pickle AND unpickle — including
+    in a fresh process (simulated by dropping the cached module) where
+    _ModelDirFinder re-resolves the per-dir key from the registry."""
+    import pickle
+    import sys
+
+    from seldon_core_tpu.serving.microservice import load_user_object
+
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "helper_mod.py").write_text(
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+    )
+    (d / "Model.py").write_text(
+        "import helper_mod\n"
+        "class Model:\n"
+        "    def __init__(self):\n"
+        "        self.c = helper_mod.Counter()\n"
+        "    def predict(self, X, names):\n"
+        "        self.c.n += 1\n"
+        "        return self.c.n\n"
+    )
+    user = load_user_object("Model", str(d))
+    user.predict(None, [])
+    blob = pickle.dumps(user.__dict__)
+    state = pickle.loads(blob)
+    assert state["c"].n == 1
+
+    # fresh-process simulation: drop every cached module for this dir; the
+    # meta-path finder must re-import the sibling from the registry
+    for k in [k for k in sys.modules if k.startswith("_seldon_user_")]:
+        del sys.modules[k]
+    state2 = pickle.loads(blob)
+    assert state2["c"].n == 1
+
+    # no double-prefixed keys (nested contexts re-keying twice)
+    user2 = load_user_object("Model", str(d))
+    assert user2.predict(None, []) == 1
+    double = [
+        k
+        for k in sys.modules
+        if k.startswith("_seldon_user_") and k.count("_seldon_user_") > 1
+    ]
+    assert double == []

@@ -387,3 +387,53 @@ async def test_outlier_adapter_rejects_non_tensor():
     )
     with pytest.raises(APIException):
         await ex.execute(SeldonMessage(str_data="not a tensor"))
+
+
+async def test_failing_branch_waits_for_siblings_to_settle():
+    """ADVICE r2: when one combiner branch raises, sibling branches must
+    SETTLE before the error propagates — no detached side-effectful unit
+    still executing for a request whose response is already an error."""
+    import asyncio as _asyncio
+
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred_dict = {
+        "name": "c",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "boom", "type": "MODEL"},
+            {"name": "slow", "type": "MODEL"},
+        ],
+    }
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    pred = PredictorSpec.model_validate(
+        {"name": "p", "graph": pred_dict, "tpu": {"fuse_graph": False}}
+    )
+    state = {"slow_done": False}
+
+    class Boom:
+        def predict(self, X, names):
+            raise RuntimeError("branch failure")
+
+    class Slow:
+        def predict(self, X, names):
+            return X
+
+    async def slow_transform(msg):
+        await _asyncio.sleep(0.15)
+        state["slow_done"] = True
+        return msg
+
+    boom_unit = PythonClassUnit(pred.graph.children[0], Boom())
+    slow_unit = PythonClassUnit(pred.graph.children[1], Slow())
+    slow_unit.transform_input = slow_transform
+    ex = build_executor(
+        pred, context={"units": {"boom": boom_unit, "slow": slow_unit}}
+    )
+    req = SeldonMessage.from_array(np.ones((1, 4), np.float32))
+    with pytest.raises(Exception):
+        await ex.execute(req)
+    # the slow sibling finished BEFORE the error surfaced, not detached
+    assert state["slow_done"] is True

@@ -139,3 +139,29 @@ def test_typed_parameters():
     assert Parameter(name="a", value="0.5", type=ParameterType.FLOAT).typed_value() == 0.5
     assert Parameter(name="a", value="true", type=ParameterType.BOOL).typed_value() is True
     assert Parameter(name="a", value="x", type=ParameterType.STRING).typed_value() == "x"
+
+
+def test_validation_decode_npy_toggle_must_agree_across_predictors():
+    """Wire-level sniffing is per-deployment: the gateway classifies a body
+    before knowing which predictor serves it, so divergent
+    tpu.decode_npy_bindata toggles are rejected."""
+    cr = {
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {
+                    "name": "a",
+                    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    "tpu": {"decode_npy_bindata": True},
+                },
+                {
+                    "name": "b",
+                    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    "tpu": {"decode_npy_bindata": False},
+                },
+            ],
+        }
+    }
+    with pytest.raises(ValidationError) as ei:
+        validate_deployment(SeldonDeployment.from_dict(cr))
+    assert "decode_npy_bindata" in str(ei.value)

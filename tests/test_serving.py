@@ -859,3 +859,132 @@ async def test_feedback_payload_matches_predict_payload():
         )
     )
     assert seen["x"] == b"raw-bytes"
+
+
+async def test_decode_npy_bindata_toggle_keeps_payload_opaque():
+    """tpu.decode_npy_bindata=False: binData that happens to parse as npy is
+    NOT sniffed into the tensor arm — reference oneof passthrough for
+    bytes-contract graphs (ADVICE r2)."""
+    from seldon_core_tpu.core.codec_npy import npy_from_array
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BytesEcho:
+        def predict(self, X, names):
+            assert isinstance(X, bytes)  # NOT decoded to an array
+            return X
+
+    unit = PythonClassUnit(pred.graph, BytesEcho())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    service = PredictionService(ex, deployment_name="d", decode_npy=False)
+    payload = npy_from_array(np.ones((1, 4), np.float32))
+    out = await service.predict(SeldonMessage(bin_data=payload))
+    assert out.bin_data == payload and out.data is None
+
+
+async def test_npy_request_with_bytes_out_unit_falls_back_to_json_envelope():
+    """ADVICE r2: an npy request whose graph output is opaque non-npy bytes
+    must NOT come back labeled application/x-npy — it keeps the JSON
+    envelope (base64 binData)."""
+    import base64
+
+    from seldon_core_tpu.core.codec_npy import npy_from_array
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BytesOut:
+        def predict(self, X, names):
+            return b"\x00\x01opaque-not-npy"
+
+    unit = PythonClassUnit(pred.graph, BytesOut())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    client = await _client(PredictionService(ex, deployment_name="d"))
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=npy_from_array(np.ones((1, 4), np.float32)),
+            headers={"Content-Type": "application/x-npy"},
+        )
+        assert resp.status == 200
+        assert resp.content_type == "application/json"
+        body = await resp.json()
+        assert base64.b64decode(body["binData"]) == b"\x00\x01opaque-not-npy"
+    finally:
+        await client.close()
+
+
+async def test_decode_npy_off_keeps_octet_stream_with_magic_opaque():
+    """Code-review r3: with tpu.decode_npy_bindata=False the WIRE layer
+    must not sniff either — an octet-stream body that happens to carry the
+    npy magic stays opaque binData and the response keeps the JSON
+    envelope (declared application/x-npy remains an explicit opt-in)."""
+    import base64
+
+    from seldon_core_tpu.core.codec_npy import npy_from_array
+    from seldon_core_tpu.engine.units import PythonClassUnit
+
+    pred = _predictor(
+        {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+    )
+
+    class BytesEcho:
+        def predict(self, X, names):
+            assert isinstance(X, bytes)
+            return X
+
+    unit = PythonClassUnit(pred.graph, BytesEcho())
+    ex = build_executor(pred, context={"units": {"m": unit}})
+    client = await _client(
+        PredictionService(ex, deployment_name="d", decode_npy=False)
+    )
+    try:
+        payload = npy_from_array(np.ones((1, 4), np.float32))
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        assert resp.status == 200
+        assert resp.content_type == "application/json"
+        body = await resp.json()
+        assert base64.b64decode(body["binData"]) == payload
+    finally:
+        await client.close()
+
+
+async def test_declared_x_npy_honored_even_with_decode_off():
+    """Code-review r3: Content-Type: application/x-npy is an EXPLICIT client
+    declaration — the tensor decodes (and the response mirrors npy) even
+    when the deployment opted out of binData sniffing."""
+    from seldon_core_tpu.core.codec_npy import array_from_npy, npy_from_array
+
+    pred = _predictor(
+        {
+            "name": "m",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [{"name": "model", "value": "iris_mlp", "type": "STRING"}],
+        }
+    )
+    ex = build_executor(pred)
+    client = await _client(
+        PredictionService(ex, deployment_name="d", decode_npy=False)
+    )
+    try:
+        resp = await client.post(
+            "/api/v0.1/predictions",
+            data=npy_from_array(np.ones((1, 4), np.float32)),
+            headers={"Content-Type": "application/x-npy"},
+        )
+        assert resp.status == 200
+        assert resp.content_type == "application/x-npy"
+        out = array_from_npy(await resp.read())
+        assert out.shape == (1, 3)
+    finally:
+        await client.close()

@@ -245,7 +245,9 @@ async def test_microservice_serves_user_class(tmp_path):
         assert body["data"]["ndarray"] == [[2.5, 5.0]]
     finally:
         await runner.cleanup()
-    sys.path.remove(str(model_dir))
+    # model_dir leaves sys.path automatically after the load (sibling
+    # isolation, ADVICE r2)
+    assert str(model_dir) not in sys.path
 
 
 async def test_microservice_grpc_only_has_no_rest(tmp_path):
@@ -329,7 +331,7 @@ async def test_microservice_outlier_detector_service_type(tmp_path):
         assert body["data"]["ndarray"] == [[1.0, -7.5, 2.0]]  # passthrough
     finally:
         await runner.cleanup()
-    _sys.path.remove(str(model_dir))
+    assert str(model_dir) not in _sys.path
 
 
 def test_microservice_cli_accepts_outlier_detector():
