@@ -209,8 +209,9 @@ def _encode_meta(meta: Meta) -> dict[str, Any]:
     return out
 
 
-# public alias: binary-response paths ship meta out-of-band (HTTP header)
+# public aliases: binary-response paths ship meta out-of-band (HTTP header)
 meta_to_dict = _encode_meta
+meta_from_dict = _decode_meta
 
 
 def message_to_dict(msg: SeldonMessage) -> dict[str, Any]:

@@ -36,7 +36,12 @@ from seldon_core_tpu.gateway.store import DeploymentStore
 
 
 class Backend:
-    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
+    # wire_npy: the gateway saw an EXPLICIT application/x-npy declaration —
+    # backends must honor it (decode to the tensor arm / forward the raw
+    # binary) even for deployments that opted out of binData sniffing
+    async def predict(
+        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+    ) -> SeldonMessage:
         raise NotImplementedError
 
     async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
@@ -62,8 +67,10 @@ class InProcessBackend(Backend):
             raise APIException(ErrorCode.APIFE_NO_RUNNING_DEPLOYMENT, deployment.name)
         return svc
 
-    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
-        return await self._service(deployment).predict(msg)
+    async def predict(
+        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+    ) -> SeldonMessage:
+        return await self._service(deployment).predict(msg, wire_npy=wire_npy)
 
     async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
         return await self._service(deployment).send_feedback(fb)
@@ -90,24 +97,44 @@ class RemoteBackend(Backend):
             )
         return self._session
 
-    async def _post(self, deployment, path: str, payload: dict) -> dict:
+    async def _roundtrip(
+        self,
+        deployment,
+        path: str,
+        *,
+        json_payload: dict | None = None,
+        data: bytes | None = None,
+        headers: dict | None = None,
+    ) -> tuple[bytes, str, dict]:
+        """POST with one retry; returns (body, content_type, headers).
+        5xx retries; 4xx re-raises the engine's status-JSON error code when
+        the body has that shape (errors.py), else wraps in APIFE_*."""
         session = await self._get_session()
         url = self._resolve(deployment) + path
         last_exc: Exception | None = None
         for _ in range(2):  # original + 1 retry
             try:
-                async with session.post(url, json=payload) as resp:
-                    body = await resp.text()
+                kwargs = (
+                    {"data": data, "headers": headers}
+                    if data is not None
+                    else {"json": json_payload}
+                )
+                async with session.post(url, **kwargs) as resp:
+                    body = await resp.read()
                     if resp.status >= 500:
                         last_exc = APIException(
-                            ErrorCode.APIFE_MICROSERVICE_ERROR, body[:200]
+                            ErrorCode.APIFE_MICROSERVICE_ERROR,
+                            body[:200].decode(errors="replace"),
                         )
                         continue
-                    parsed = json.loads(body)
                     if resp.status >= 400:
                         # engine status-JSON error body (errors.py shape):
-                        # re-raise with the engine's code, don't parse it as
-                        # a SeldonMessage
+                        # re-raise with the engine's code, don't parse it
+                        # as a SeldonMessage
+                        try:
+                            parsed = json.loads(body)
+                        except (ValueError, UnicodeDecodeError):
+                            parsed = None
                         if isinstance(parsed, dict) and parsed.get("status") == "FAILURE":
                             code = parsed.get("code")
                             err = next(
@@ -115,8 +142,11 @@ class RemoteBackend(Backend):
                                 ErrorCode.APIFE_MICROSERVICE_ERROR,
                             )
                             raise APIException(err, str(parsed.get("info", "")))
-                        raise APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, body[:200])
-                    return parsed
+                        raise APIException(
+                            ErrorCode.APIFE_MICROSERVICE_ERROR,
+                            body[:200].decode(errors="replace"),
+                        )
+                    return body, resp.content_type or "", dict(resp.headers)
             except APIException:
                 raise  # engine-reported errors are not retryable
             except Exception as e:  # noqa: BLE001
@@ -125,7 +155,30 @@ class RemoteBackend(Backend):
             raise last_exc
         raise APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(last_exc))
 
-    async def predict(self, deployment, msg: SeldonMessage) -> SeldonMessage:
+    async def _post(self, deployment, path: str, payload: dict) -> dict:
+        body, _, _ = await self._roundtrip(deployment, path, json_payload=payload)
+        return json.loads(body)
+
+    async def predict(
+        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+    ) -> SeldonMessage:
+        if wire_npy and msg.bin_data is not None:
+            # keep the BINARY fast path across the network hop: raw npy with
+            # the x-npy declaration (compact, no base64/JSON inflation; the
+            # engine decodes by declaration even when sniffing is opted out)
+            body, ctype, headers = await self._roundtrip(
+                deployment,
+                "/api/v0.1/predictions",
+                data=msg.bin_data,
+                headers={"Content-Type": "application/x-npy"},
+            )
+            if ctype == "application/x-npy":
+                from seldon_core_tpu.core.codec_json import meta_from_dict
+
+                meta = meta_from_dict(json.loads(headers.get("Seldon-Meta", "{}")))
+                return SeldonMessage(bin_data=body, meta=meta)
+            # bytes-out graph: the engine fell back to the JSON envelope
+            return message_from_dict(json.loads(body))
         out = await self._post(deployment, "/api/v0.1/predictions", message_to_dict(msg))
         return message_from_dict(out)
 
@@ -240,34 +293,29 @@ def build_gateway_app(gw: Gateway) -> web.Application:
             )
             kind, raw = await classify_binary_body(request, sniff_npy=sniff)
             npy = kind == "npy"
-            if kind == "npy":
-                # binary tensor fast path, same contract as the engine REST
-                # surface (raw npy in, raw npy + Seldon-Meta out). The
-                # gateway decodes HERE — where the wire declaration lives —
-                # so the tensor arm reaches any backend (in-process or a
-                # remote engine hop) even when the deployment opted out of
-                # binData sniffing; the response is re-encoded below.
-                from seldon_core_tpu.core.codec_npy import array_from_npy
-
-                msg = SeldonMessage.from_array(array_from_npy(raw))
-            elif kind == "bin":
-                # deliberate octet-stream: opaque binData passthrough. The
-                # in-process backend hands it to the service ingress; the
-                # remote backend forwards it as binData in the JSON
-                # envelope (base64) — correct either way.
+            if kind != "json":
+                # npy: binary tensor fast path, same contract as the engine
+                # REST surface (raw npy in, raw npy + Seldon-Meta out) —
+                # wire_npy carries the explicit declaration to the backend,
+                # which keeps the hop BINARY (in-process: service decode;
+                # remote: raw x-npy forward), even for deployments that
+                # opted out of binData sniffing.
+                # bin: deliberate octet-stream, opaque binData passthrough
+                # (remote forwards it as base64 binData in the envelope).
                 msg = SeldonMessage(bin_data=raw)
             else:
                 msg = message_from_dict(await _payload_dict(request))
-            out = await gw.backend.predict(dep, msg)
+            out = await gw.backend.predict(dep, msg, wire_npy=npy)
             gw.audit.send(principal, msg, out)  # RestClientController.java:164
             if gw.metrics is not None:
                 gw.metrics.ingress_request(
                     dep.name, "predict", _time.perf_counter() - start
                 )
             if npy:
-                # mirror the request kind (tensor out -> npy binData); the
-                # is_npy guard keeps opaque bytes-out responses in the JSON
-                # envelope instead of a falsely-labeled application/x-npy
+                # backends answer wire_npy requests with npy binData (or a
+                # tensor, mirrored here as a safety net for older engines);
+                # the is_npy guard keeps opaque bytes-out responses in the
+                # JSON envelope instead of a falsely-labeled x-npy body
                 from seldon_core_tpu.serving.service import mirror_npy_kind
 
                 out = mirror_npy_kind(out)

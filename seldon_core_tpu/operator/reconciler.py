@@ -75,8 +75,8 @@ class RunningDeployment:
                 return self.services[name]
         return self.services[self._weights[-1][0]]
 
-    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._pick().predict(msg)
+    async def predict(self, msg: SeldonMessage, wire_npy: bool = False) -> SeldonMessage:
+        return await self._pick().predict(msg, wire_npy=wire_npy)
 
     async def send_feedback(self, fb: Feedback) -> SeldonMessage:
         # feedback follows the routing recorded in the response meta, which

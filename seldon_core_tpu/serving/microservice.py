@@ -121,15 +121,31 @@ def _dir_key_for(abs_dir: str) -> str:
 def _rekey_module(mod_name: str, module, dir_key: str) -> None:
     """Move a dir-local module from its bare sys.modules name to the
     per-dir key, updating the module's own identity (__name__/__spec__)
-    and the __module__ of its top-level defs so pickle emits the
-    importable per-dir name instead of the popped bare one."""
+    and the __module__ of its defs — including classes NESTED inside other
+    classes (pickle references them by module + qualname too) — so pickle
+    emits the importable per-dir name instead of the popped bare one."""
+    import inspect
+
     new_name = f"{_USER_PREFIX}{dir_key}_{mod_name}"
+
+    def _rewrite(obj, seen: set) -> None:
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if getattr(obj, "__module__", None) != mod_name:
+            return  # foreign object — nothing of ours can be nested in it
+        try:
+            obj.__module__ = new_name
+        except (AttributeError, TypeError):
+            return
+        if inspect.isclass(obj):
+            for member in list(vars(obj).values()):
+                if inspect.isclass(member) or inspect.isfunction(member):
+                    _rewrite(member, seen)
+
+    seen: set = set()
     for obj in list(vars(module).values()):
-        if getattr(obj, "__module__", None) == mod_name:
-            try:
-                obj.__module__ = new_name
-            except (AttributeError, TypeError):
-                pass
+        _rewrite(obj, seen)
     try:
         module.__name__ = new_name
         if getattr(module, "__spec__", None) is not None:

@@ -381,3 +381,32 @@ def test_user_state_with_sibling_class_survives_pickle(tmp_path):
         if k.startswith("_seldon_user_") and k.count("_seldon_user_") > 1
     ]
     assert double == []
+
+
+def test_nested_sibling_class_survives_pickle(tmp_path):
+    """Code-review r3: classes nested INSIDE sibling-module classes pickle
+    too (pickle references them by module + qualname; the re-key rewrites
+    __module__ recursively)."""
+    import pickle
+
+    from seldon_core_tpu.serving.microservice import load_user_object
+
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "helper_mod.py").write_text(
+        "class Outer:\n"
+        "    class Inner:\n"
+        "        def __init__(self):\n"
+        "            self.v = 7\n"
+    )
+    (d / "Model.py").write_text(
+        "import helper_mod\n"
+        "class Model:\n"
+        "    def __init__(self):\n"
+        "        self.x = helper_mod.Outer.Inner()\n"
+        "    def predict(self, X, names):\n"
+        "        return self.x.v\n"
+    )
+    user = load_user_object("Model", str(d))
+    state = pickle.loads(pickle.dumps(user.__dict__))
+    assert state["x"].v == 7

@@ -219,3 +219,41 @@ async def test_gateway_npy_binary_path_with_oauth():
         assert meta["puid"]
     finally:
         await client.close()
+
+
+async def test_remote_backend_json_and_binary_npy_hop():
+    """RemoteBackend (the apife->engine network hop): JSON envelope predicts
+    round-trip, and a wire_npy predict forwards the RAW x-npy body (binary
+    fast path preserved across the hop — code-review r3) with meta coming
+    back via the Seldon-Meta header."""
+    import numpy as np
+
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.core.codec_npy import array_from_npy, is_npy, npy_from_array
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.gateway.app import RemoteBackend
+    from seldon_core_tpu.serving.rest import build_app
+
+    engine_app = build_app(_service())
+    server = TestServer(engine_app)
+    await server.start_server()
+    try:
+        backend = RemoteBackend(
+            resolve=lambda d: f"http://{server.host}:{server.port}"
+        )
+        dep = _deployment()
+        out = await backend.predict(
+            dep, message_from_dict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}})
+        )
+        assert out.array.shape[0] == 1
+
+        raw = npy_from_array(np.ones((2, 4), np.float32))
+        out2 = await backend.predict(
+            dep, SeldonMessage(bin_data=raw), wire_npy=True
+        )
+        assert is_npy(out2.bin_data)  # binary end-to-end, no JSON inflation
+        assert array_from_npy(out2.bin_data).shape[0] == 2
+        assert out2.meta.puid  # meta recovered from the Seldon-Meta header
+        await backend.close()
+    finally:
+        await server.close()
