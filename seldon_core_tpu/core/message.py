@@ -11,7 +11,6 @@ graph — and onto the TPU — with zero copies. Codecs (JSON / proto) live in
 
 from __future__ import annotations
 
-import dataclasses
 import enum
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
@@ -90,7 +89,19 @@ class Meta:
     def merged_with(self, other: "Meta") -> "Meta":
         """Merge rule from reference PredictiveUnitBean.mergeMeta:252-264:
         tags are union-merged (child wins on conflict), puid preserved from the
-        request, routing entries accumulate."""
+        request, routing entries accumulate.
+
+        The no-op short-circuits matter: a graph walk merges meta at every
+        node boundary and most merges carry nothing new — at serving rates
+        the dict spreads below are real CPU."""
+        if other is self:
+            return self
+        if not (other.tags or other.routing or other.request_path) and (
+            self.puid or not other.puid
+        ):
+            return self
+        if not (self.tags or self.routing or self.request_path) and not self.puid:
+            return other
         return Meta(
             puid=self.puid or other.puid,
             tags={**self.tags, **other.tags},
@@ -148,34 +159,54 @@ class SeldonMessage:
     def names(self) -> tuple[str, ...]:
         return self.data.names if self.data is not None else ()
 
+    # The with_* updates below construct via object.__new__ instead of
+    # dataclasses.replace: replace() re-introspects fields and re-runs
+    # __post_init__ on every call (~4 us), and these run several times per
+    # request on the serving hot path. Each method sets EVERY field and
+    # keeps the oneof invariant by construction (exactly one arm non-None).
+    def _copy(self, data, bin_data, str_data, json_data, meta, status) -> "SeldonMessage":
+        new = object.__new__(SeldonMessage)
+        object.__setattr__(new, "data", data)
+        object.__setattr__(new, "bin_data", bin_data)
+        object.__setattr__(new, "str_data", str_data)
+        object.__setattr__(new, "json_data", json_data)
+        object.__setattr__(new, "meta", meta)
+        object.__setattr__(new, "status", status)
+        return new
+
     def with_array(self, array: Array, names: Sequence[str] | None = None) -> "SeldonMessage":
         """Functional update of the payload, preserving meta/kind. Setting
         the tensor arm REPLACES the payload: the other oneof arms clear (a
         unit that produces a tensor from a binData/strData request must not
         leave the stale bytes beside it)."""
         base = self.data if self.data is not None else DefaultData()
-        return dataclasses.replace(
-            self,
-            data=base.with_array(array, names),
-            bin_data=None,
-            str_data=None,
-            json_data=None,
+        return self._copy(
+            base.with_array(array, names), None, None, None, self.meta, self.status
         )
 
     def with_bin_data(self, raw: bytes) -> "SeldonMessage":
         """Replace the payload with bytes (clears the other oneof arms)."""
-        return dataclasses.replace(
-            self, data=None, bin_data=bytes(raw), str_data=None, json_data=None
-        )
+        return self._copy(None, bytes(raw), None, None, self.meta, self.status)
 
     def with_str_data(self, text: str) -> "SeldonMessage":
         """Replace the payload with a string (clears the other oneof arms)."""
-        return dataclasses.replace(
-            self, data=None, bin_data=None, str_data=text, json_data=None
-        )
+        return self._copy(None, None, text, None, self.meta, self.status)
 
     def with_meta(self, meta: Meta) -> "SeldonMessage":
-        return dataclasses.replace(self, meta=meta)
+        if meta is self.meta:
+            return self
+        return self._copy(
+            self.data, self.bin_data, self.str_data, self.json_data, meta, self.status
+        )
+
+    def with_array_meta(
+        self, array: Array, meta: Meta, names: Sequence[str] | None = None
+    ) -> "SeldonMessage":
+        """Payload + meta update in ONE copy (batch scatter paths build a
+        per-request message from a merged result; two chained with_* calls
+        would construct an intermediate that is immediately discarded)."""
+        base = self.data if self.data is not None else DefaultData()
+        return self._copy(base.with_array(array, names), None, None, None, meta, self.status)
 
     def is_failure(self) -> bool:
         return self.status is not None and self.status.status == StatusFlag.FAILURE

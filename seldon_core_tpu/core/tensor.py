@@ -57,9 +57,16 @@ def pad_batch(array: np.ndarray, target_batch: int, axis: int = 0) -> tuple[np.n
         raise ValueError(f"batch {n} exceeds bucket {target_batch}")
     if n == target_batch:
         return array, n
-    pad_width = [(0, 0)] * array.ndim
-    pad_width[axis] = (0, target_batch - n)
-    return np.pad(array, pad_width), n
+    # zeros + slice-assign instead of np.pad: same result, ~20x less Python
+    # overhead (np.pad's generic machinery costs ~20 us per call — real
+    # money at thousands of requests/sec on the serving path)
+    shape = list(array.shape)
+    shape[axis] = target_batch
+    out = np.zeros(shape, dtype=array.dtype)
+    sl = [slice(None)] * array.ndim
+    sl[axis] = slice(0, n)
+    out[tuple(sl)] = array
+    return out, n
 
 
 def bucket_for(n: int, buckets: Sequence[int]) -> int | None:

@@ -156,21 +156,24 @@ class GraphExecutor:
         rows = [int(np.atleast_2d(np.asarray(m.array)).shape[0]) for m in msgs]
         out_arr = None if out.array is None else np.asarray(out.array)
         splittable = out_arr is not None and out_arr.shape[0] == sum(rows)
+        om = out.meta
         result = []
         offset = 0
         for m, r in zip(msgs, rows):
-            meta = m.meta.merged_with(out.meta)
-            # the merged call's meta derives from batch-mate 0 (_merge_rows),
-            # so on conflict the request's OWN routing (and puid) must win —
-            # feedback replays down meta.routing and must follow the branch
-            # THIS request actually took
-            meta = dataclasses.replace(
-                meta,
-                puid=m.meta.puid or out.meta.puid,
-                routing={**out.meta.routing, **m.meta.routing},
+            mm = m.meta
+            # merge rule per request: the merged call's meta derives from
+            # batch-mate 0 (_merge_rows), so on conflict the request's OWN
+            # puid and routing must win — feedback replays down meta.routing
+            # and must follow the branch THIS request actually took; tags /
+            # requestPath follow the normal child-wins merge (mergeMeta)
+            meta = Meta(
+                puid=mm.puid or om.puid,
+                tags={**mm.tags, **om.tags},
+                routing={**om.routing, **mm.routing},
+                request_path={**mm.request_path, **om.request_path},
             )
             if splittable:
-                result.append(out.with_array(out_arr[offset : offset + r]).with_meta(meta))
+                result.append(out.with_array_meta(out_arr[offset : offset + r], meta))
                 offset += r
             else:  # graph changed the batch dim (global aggregate): share it
                 result.append(out.with_meta(meta))

@@ -22,12 +22,7 @@ import os
 
 from aiohttp import web
 
-from seldon_core_tpu.core.codec_json import (
-    feedback_from_dict,
-    message_from_dict,
-    message_to_dict,
-)
-from seldon_core_tpu.core.codec_npy import is_npy
+from seldon_core_tpu.core.codec_json import message_from_dict, message_to_dict
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, SeldonMessage
 from seldon_core_tpu.gateway.audit import AuditSink, NullAuditSink
@@ -217,14 +212,16 @@ class Gateway:
             self.oauth.add_client(test_key, os.environ.get("TEST_CLIENT_SECRET", "secret"))
 
     # ----- auth helpers
-    def _principal(self, request: web.Request) -> str:
-        auth = request.headers.get("Authorization", "")
+    def principal_from_auth(self, auth: str) -> str:
         if auth.lower().startswith("bearer "):
             token = auth[7:].strip()
             principal = self.oauth.principal(token)
             if principal:
                 return principal
         raise APIException(ErrorCode.APIFE_GRPC_NO_PRINCIPAL_FOUND, "invalid or missing token")
+
+    def _principal(self, request: web.Request) -> str:
+        return self.principal_from_auth(request.headers.get("Authorization", ""))
 
     def _deployment(self, principal: str):
         dep = self.store.by_principal(principal)
@@ -236,126 +233,36 @@ class Gateway:
         return dep
 
 
-from seldon_core_tpu.serving.http_util import (
-    classify_binary_body,
-    npy_response,
-    payload_dict,
-    wire_failure,
-)
+from seldon_core_tpu.serving.http_util import from_wire_response, to_wire_request
 
 _log = logging.getLogger(__name__)
 
 
-async def _payload_dict(request: web.Request) -> dict:
-    return await payload_dict(request, ErrorCode.APIFE_INVALID_JSON)
 
 
 def build_gateway_app(gw: Gateway) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app["gateway"] = gw
 
+    # handlers delegate to the transport-neutral wire core (serving/wire.py)
+    # shared with the fast ingress, so the two transports cannot drift
     async def token(request: web.Request) -> web.Response:
-        # client_credentials via Basic auth header or form fields
-        import base64
+        from seldon_core_tpu.serving import wire
 
-        client_id = client_secret = ""
-        auth = request.headers.get("Authorization", "")
-        if auth.lower().startswith("basic "):
-            try:
-                decoded = base64.b64decode(auth[6:]).decode()
-                client_id, _, client_secret = decoded.partition(":")
-            except Exception:  # noqa: BLE001
-                pass
-        if not client_id:
-            form = await request.post()
-            client_id = form.get("client_id", "")
-            client_secret = form.get("client_secret", "")
-        try:
-            return web.json_response(gw.oauth.issue_token(client_id, client_secret))
-        except PermissionError:
-            return web.json_response(
-                {"error": "invalid_client", "error_description": "Bad client credentials"},
-                status=401,
-            )
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.gateway_token(gw, req))
 
     async def predictions(request: web.Request) -> web.Response:
-        import time as _time
+        from seldon_core_tpu.serving import wire
 
-        start = _time.perf_counter()
-        try:
-            principal = gw._principal(request)
-            dep = gw._deployment(principal)
-            # the deployment's npy opt-out governs wire-level sniffing too;
-            # predictors of one deployment share wire semantics, so the
-            # first predictor's toggle speaks for the deployment
-            sniff = (
-                dep.predictors[0].tpu.decode_npy_bindata if dep.predictors else True
-            )
-            kind, raw = await classify_binary_body(request, sniff_npy=sniff)
-            npy = kind == "npy"
-            if kind != "json":
-                # npy: binary tensor fast path, same contract as the engine
-                # REST surface (raw npy in, raw npy + Seldon-Meta out) —
-                # wire_npy carries the explicit declaration to the backend,
-                # which keeps the hop BINARY (in-process: service decode;
-                # remote: raw x-npy forward), even for deployments that
-                # opted out of binData sniffing.
-                # bin: deliberate octet-stream, opaque binData passthrough
-                # (remote forwards it as base64 binData in the envelope).
-                msg = SeldonMessage(bin_data=raw)
-            else:
-                msg = message_from_dict(await _payload_dict(request))
-            out = await gw.backend.predict(dep, msg, wire_npy=npy)
-            gw.audit.send(principal, msg, out)  # RestClientController.java:164
-            if gw.metrics is not None:
-                gw.metrics.ingress_request(
-                    dep.name, "predict", _time.perf_counter() - start
-                )
-            if npy:
-                # backends answer wire_npy requests with npy binData (or a
-                # tensor, mirrored here as a safety net for older engines);
-                # the is_npy guard keeps opaque bytes-out responses in the
-                # JSON envelope instead of a falsely-labeled x-npy body
-                from seldon_core_tpu.serving.service import mirror_npy_kind
-
-                out = mirror_npy_kind(out)
-                if is_npy(out.bin_data):
-                    return npy_response(out)
-            return web.json_response(message_to_dict(out))
-        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
-            return wire_failure(
-                e,
-                fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
-                op="gateway predict",
-                log=_log,
-                metrics_error=lambda c: gw.metrics is not None
-                and gw.metrics.ingress_error("", "predict", c),
-            )
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.gateway_predictions(gw, req))
 
     async def feedback(request: web.Request) -> web.Response:
-        import time as _time
+        from seldon_core_tpu.serving import wire
 
-        start = _time.perf_counter()
-        try:
-            principal = gw._principal(request)
-            dep = gw._deployment(principal)
-            fb = feedback_from_dict(await _payload_dict(request))
-            out = await gw.backend.feedback(dep, fb)
-            if gw.metrics is not None:
-                gw.metrics.ingress_request(
-                    dep.name, "feedback", _time.perf_counter() - start
-                )
-                gw.metrics.feedback(dep.name, "", "", fb.reward)
-            return web.json_response(message_to_dict(out))
-        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
-            return wire_failure(
-                e,
-                fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
-                op="gateway feedback",
-                log=_log,
-                metrics_error=lambda c: gw.metrics is not None
-                and gw.metrics.ingress_error("", "feedback", c),
-            )
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.gateway_feedback(gw, req))
 
     async def ready(request: web.Request) -> web.Response:
         return web.Response(text="ready")

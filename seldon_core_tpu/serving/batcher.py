@@ -205,15 +205,18 @@ class MicroBatcher:
     def _resolve(self, item: _Pending, out: SeldonMessage, own_slice) -> None:
         if item.future.done():
             return
-        resp = out if own_slice is None else out.with_array(own_slice)
         # restore the caller's own puid (batch-mates share tags/routing)
+        m = out.meta
         merged_meta = Meta(
             puid=item.msg.meta.puid,
-            tags=dict(resp.meta.tags),
-            routing=dict(resp.meta.routing),
-            request_path=dict(resp.meta.request_path),
+            tags=dict(m.tags),
+            routing=dict(m.routing),
+            request_path=dict(m.request_path),
         )
-        item.future.set_result(resp.with_meta(merged_meta))
+        if own_slice is None:
+            item.future.set_result(out.with_meta(merged_meta))
+        else:
+            item.future.set_result(out.with_array_meta(own_slice, merged_meta))
 
     async def close(self) -> None:
         """Drain: flush queued requests, then await every in-flight batch so

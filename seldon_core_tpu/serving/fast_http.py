@@ -1,0 +1,273 @@
+"""Fast data-plane HTTP/1.1 ingress: a purpose-built asyncio.Protocol server.
+
+Why this exists: the serving hot path (predict request -> response) spends
+more CPU in a general-purpose web framework's per-request machinery than in
+the entire graph walk + XLA dispatch. This server implements exactly what
+the data plane needs — POST with Content-Length bodies, keep-alive, a small
+exact-path route table — over the SAME transport-neutral handlers
+(serving/wire.py) the aiohttp apps use, so semantics cannot drift. Measured
+on the bench stack-ceiling config it roughly halves per-request server
+overhead vs the aiohttp app.
+
+Not a general web server, by design:
+- no chunked request bodies (411 if no Content-Length; serving clients and
+  the reference's engines always send it),
+- no TLS (terminate at the LB, as the reference's ingress does),
+- no streaming responses, no websockets.
+The full aiohttp apps remain for everything else (admin, tests, tooling);
+`PredictorServer`/platform keep them unless fast ingress is requested.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Mapping
+
+from seldon_core_tpu.serving.wire import WireRequest, WireResponse
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[WireRequest], Awaitable[WireResponse]]
+
+_MAX_BODY = 64 * 1024 * 1024  # matches the aiohttp apps' client_max_size
+_MAX_HEADER = 64 * 1024
+
+_STATUS_LINES = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    401: b"HTTP/1.1 401 Unauthorized\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    411: b"HTTP/1.1 411 Length Required\r\n",
+    413: b"HTTP/1.1 413 Payload Too Large\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+}
+
+
+def _status_line(code: int) -> bytes:
+    return _STATUS_LINES.get(code) or f"HTTP/1.1 {code} Status\r\n".encode()
+
+
+class HttpProtocol(asyncio.Protocol):
+    """One connection. Requests are processed strictly in order (no
+    pipelining concurrency): parse -> schedule handler task -> write
+    response -> parse next. Incoming bytes buffer while a handler runs."""
+
+    def __init__(self, routes: Mapping[tuple[str, str], Handler]):
+        self._routes = routes
+        self._transport: asyncio.Transport | None = None
+        self._buf = bytearray()
+        self._busy = False
+        self._closing = False
+
+    # ------------------------------------------------------------- plumbing
+    def connection_made(self, transport: asyncio.BaseTransport) -> None:
+        self._transport = transport  # type: ignore[assignment]
+
+    def connection_lost(self, exc: Exception | None) -> None:
+        self._closing = True
+        self._transport = None
+
+    def data_received(self, data: bytes) -> None:
+        self._buf += data
+        if not self._busy:
+            self._try_dispatch()
+
+    # -------------------------------------------------------------- parsing
+    def _try_dispatch(self) -> None:
+        """Parse one complete request from the buffer and run its handler."""
+        buf = self._buf
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            if len(buf) > _MAX_HEADER:
+                self._respond_simple(400, b"header too large")
+                self._close()
+            return
+        head = bytes(buf[:head_end])
+        lines = head.split(b"\r\n")
+        try:
+            method, path, _ = lines[0].decode("latin-1").split(" ", 2)
+        except ValueError:
+            self._respond_simple(400, b"bad request line")
+            self._close()
+            return
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            k, sep, v = line.decode("latin-1").partition(":")
+            if sep:
+                headers[k.strip().lower()] = v.strip()
+        if "content-length" in headers:
+            try:
+                clen = int(headers["content-length"])
+            except ValueError:
+                self._respond_simple(400, b"bad content-length")
+                self._close()
+                return
+        elif method in ("GET", "HEAD", "DELETE"):
+            clen = 0
+        else:
+            # POST/PUT without Content-Length (incl. chunked): out of this
+            # server's contract — guessing clen=0 would misparse the body
+            # bytes as the next request line
+            self._respond_simple(411, b"Content-Length required")
+            self._close()
+            return
+        if clen > _MAX_BODY:
+            self._respond_simple(413, b"body too large")
+            self._close()
+            return
+        body_start = head_end + 4
+        if len(buf) - body_start < clen:
+            return  # body incomplete; wait for more data
+        body = bytes(buf[body_start : body_start + clen])
+        del buf[: body_start + clen]
+
+        path = path.split("?", 1)[0]
+        handler = self._routes.get((method, path))
+        keep_alive = headers.get("connection", "").lower() != "close"
+        req = WireRequest(
+            method=method,
+            path=path,
+            headers=headers,
+            body=body,
+            declared_ctype="content-type" in headers,
+        )
+        self._busy = True
+        task = asyncio.ensure_future(self._run(handler, req, keep_alive))
+        task.add_done_callback(self._on_handler_done)
+
+    # ------------------------------------------------------------- handling
+    async def _run(self, handler: Handler | None, req: WireRequest, keep_alive: bool) -> None:
+        if handler is None:
+            self._respond_simple(404, b"not found", keep_alive)
+            return
+        try:
+            resp = await handler(req)
+        except Exception:  # noqa: BLE001 - handler contract is no-raise; belt+braces
+            log.exception("fast-ingress handler failed for %s", req.path)
+            resp = WireResponse(status=500, body=b'{"status":"FAILURE"}')
+        self._write_response(resp, keep_alive)
+
+    def _on_handler_done(self, task: asyncio.Task) -> None:
+        if exc := task.exception():
+            log.error("fast-ingress task error: %s", exc)
+        self._busy = False
+        if self._transport is not None and not self._closing and self._buf:
+            self._try_dispatch()
+
+    # -------------------------------------------------------------- writing
+    def _write_response(self, resp: WireResponse, keep_alive: bool = True) -> None:
+        t = self._transport
+        if t is None:
+            return
+        extra = b""
+        for k, v in resp.headers.items():
+            extra += f"{k}: {v}\r\n".encode()
+        t.write(
+            _status_line(resp.status)
+            + b"Content-Type: " + resp.content_type.encode() + b"\r\n"
+            + b"Content-Length: " + str(len(resp.body)).encode() + b"\r\n"
+            + extra
+            + (b"Connection: keep-alive\r\n\r\n" if keep_alive else b"Connection: close\r\n\r\n")
+            + resp.body
+        )
+        if not keep_alive:
+            self._close()
+
+    def _respond_simple(self, status: int, text: bytes, keep_alive: bool = False) -> None:
+        self._write_response(
+            WireResponse(status=status, body=text, content_type="text/plain"),
+            keep_alive,
+        )
+
+    def _close(self) -> None:
+        self._closing = True
+        if self._transport is not None:
+            self._transport.close()
+
+
+async def start_fast_server(
+    routes: Mapping[tuple[str, str], Handler], host: str, port: int
+) -> asyncio.AbstractServer:
+    loop = asyncio.get_running_loop()
+    return await loop.create_server(lambda: HttpProtocol(routes), host, port)
+
+
+# ----------------------------------------------------------- route builders
+def engine_routes(service, state: dict, metrics=None) -> dict:
+    """The engine data-plane route table (fast twin of serving/rest.py)."""
+    from seldon_core_tpu.serving import wire
+
+    async def predictions(req: WireRequest) -> WireResponse:
+        return await wire.engine_predictions(service, req)
+
+    async def feedback(req: WireRequest) -> WireResponse:
+        return await wire.engine_feedback(service, req)
+
+    async def ready(req: WireRequest) -> WireResponse:
+        if state["paused"] or not service.executor.ready():
+            return WireResponse.text("paused" if state["paused"] else "loading", 503)
+        return WireResponse.text("ready")
+
+    async def ping(req: WireRequest) -> WireResponse:
+        return WireResponse.text("pong")
+
+    async def pause(req: WireRequest) -> WireResponse:
+        state["paused"] = True
+        return WireResponse.text("paused")
+
+    async def unpause(req: WireRequest) -> WireResponse:
+        state["paused"] = False
+        return WireResponse.text("unpaused")
+
+    async def prometheus(req: WireRequest) -> WireResponse:
+        m = metrics or getattr(service, "metrics", None)
+        return WireResponse.text((m.export() if m is not None else b"").decode())
+
+    routes: dict = {
+        ("POST", "/api/v0.1/predictions"): predictions,
+        ("POST", "/api/v0.1/feedback"): feedback,
+        ("GET", "/ready"): ready,
+        ("GET", "/ping"): ping,
+        ("GET", "/metrics"): prometheus,
+        ("GET", "/prometheus"): prometheus,
+    }
+    for method in ("GET", "POST"):
+        routes[(method, "/pause")] = pause
+        routes[(method, "/unpause")] = unpause
+    return routes
+
+
+def gateway_routes(gw) -> dict:
+    """The gateway data-plane route table (fast twin of gateway/app.py)."""
+    from seldon_core_tpu.serving import wire
+
+    async def predictions(req: WireRequest) -> WireResponse:
+        return await wire.gateway_predictions(gw, req)
+
+    async def feedback(req: WireRequest) -> WireResponse:
+        return await wire.gateway_feedback(gw, req)
+
+    async def token(req: WireRequest) -> WireResponse:
+        return await wire.gateway_token(gw, req)
+
+    async def ready(req: WireRequest) -> WireResponse:
+        return WireResponse.text("ready")
+
+    async def ping(req: WireRequest) -> WireResponse:
+        return WireResponse.text("pong")
+
+    async def prometheus(req: WireRequest) -> WireResponse:
+        m = gw.metrics
+        return WireResponse.text((m.export() if m is not None else b"").decode())
+
+    return {
+        ("POST", "/api/v0.1/predictions"): predictions,
+        ("POST", "/api/v0.1/feedback"): feedback,
+        ("POST", "/oauth/token"): token,
+        ("GET", "/ready"): ready,
+        ("GET", "/ping"): ping,
+        ("GET", "/metrics"): prometheus,
+        ("GET", "/prometheus"): prometheus,
+    }

@@ -101,6 +101,30 @@ async def classify_binary_body(
     return ("json", None)
 
 
+async def to_wire_request(request: web.Request):
+    """aiohttp request -> transport-neutral WireRequest (serving/wire.py).
+    aiohttp reports octet-stream for header-less requests, so declared_ctype
+    comes from the raw header presence."""
+    from seldon_core_tpu.serving.wire import WireRequest
+
+    return WireRequest(
+        method=request.method,
+        path=request.path,
+        headers={k.lower(): v for k, v in request.headers.items()},
+        body=await request.read(),
+        declared_ctype="Content-Type" in request.headers,
+    )
+
+
+def from_wire_response(resp) -> web.Response:
+    return web.Response(
+        status=resp.status,
+        body=resp.body,
+        content_type=resp.content_type,
+        headers=resp.headers,
+    )
+
+
 def npy_response(out) -> web.Response:
     """Raw npy body + meta in the ``Seldon-Meta`` header.
 

@@ -18,29 +18,10 @@ import logging
 
 from aiohttp import web
 
-from seldon_core_tpu.core.codec_json import (
-    feedback_from_dict,
-    message_from_dict,
-    message_from_json_fast,
-    message_to_dict,
-    message_to_json_fast,
-)
-from seldon_core_tpu.core.codec_npy import is_npy
-from seldon_core_tpu.core.errors import ErrorCode
-from seldon_core_tpu.core.message import SeldonMessage
 from seldon_core_tpu.serving.service import PredictionService
-from seldon_core_tpu.serving.http_util import (
-    classify_binary_body,
-    npy_response,
-    payload_dict,
-    wire_failure,
-)
+from seldon_core_tpu.serving.http_util import from_wire_response, to_wire_request
 
 log = logging.getLogger(__name__)
-
-
-async def _payload_dict(request: web.Request) -> dict:
-    return await payload_dict(request, ErrorCode.ENGINE_INVALID_JSON)
 
 
 def build_app(service: PredictionService, state: dict | None = None, metrics=None) -> web.Application:
@@ -49,67 +30,21 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
     app["state"] = state
     app["service"] = service
 
+    # handlers delegate to the transport-neutral wire core (serving/wire.py)
+    # shared with the fast ingress, so the two transports cannot drift;
+    # aiohttp control-flow exceptions (413 from client_max_size etc.) raise
+    # during body read and keep aiohttp's own handling
     async def predictions(request: web.Request) -> web.Response:
-        try:
-            ctype = request.content_type or ""
-            kind, raw = await classify_binary_body(
-                request, sniff_npy=service.decode_npy
-            )
-            if kind != "json":
-                # "npy": binary tensor fast path — the raw body IS the npy
-                # tensor, no JSON envelope, no base64 (codec_npy rationale);
-                # the service mirrors the kind, so out.bin_data is npy too.
-                # "bin": deliberate octet-stream — opaque binData flowing
-                # through the graph untouched (reference oneof semantics).
-                out = await service.predict(
-                    SeldonMessage(bin_data=raw), wire_npy=kind == "npy"
-                )
-                # is_npy guard: a bytes-out unit can answer an npy request
-                # with opaque bytes — serving those as application/x-npy
-                # would lie about the body; fall back to the JSON envelope
-                if kind == "npy" and is_npy(out.bin_data):
-                    return npy_response(out)
-                # opaque binData (and any tensor produced from bytes) keeps
-                # the JSON envelope — base64 binData, the pre-npy contract
-                return web.Response(
-                    body=message_to_json_fast(out), content_type="application/json"
-                )
-            if ctype.startswith("application/json"):
-                # hot path: ndarray matrix parses/serializes in C
-                # (native/fastcodec); envelope in Python json
-                msg = message_from_json_fast(await request.read())
-            else:
-                msg = message_from_dict(await _payload_dict(request))
-            out = await service.predict(msg)
-            return web.Response(
-                body=message_to_json_fast(out), content_type="application/json"
-            )
-        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
-            return wire_failure(
-                e,
-                fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
-                op="predict",
-                log=log,
-                metrics_error=lambda c: service.metrics.ingress_error(
-                    service.deployment_name, "predict", c
-                ),
-            )
+        from seldon_core_tpu.serving import wire
+
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.engine_predictions(service, req))
 
     async def feedback(request: web.Request) -> web.Response:
-        try:
-            fb = feedback_from_dict(await _payload_dict(request))
-            out = await service.send_feedback(fb)
-            return web.json_response(message_to_dict(out))
-        except Exception as e:  # noqa: BLE001 - wire boundary (wire_failure)
-            return wire_failure(
-                e,
-                fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
-                op="feedback",
-                log=log,
-                metrics_error=lambda c: service.metrics.ingress_error(
-                    service.deployment_name, "feedback", c
-                ),
-            )
+        from seldon_core_tpu.serving import wire
+
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.engine_feedback(service, req))
 
     async def ready(request: web.Request) -> web.Response:
         if state["paused"] or not service.executor.ready():

@@ -92,14 +92,36 @@ class PredictorServer:
         self.state = {"paused": False}
         self.app = build_app(self.service, self.state, metrics=self.metrics)
         self._runner: web.AppRunner | None = None
+        self._fast_server = None
         self._grpc_server = None
 
     # ------------------------------------------------------------ lifecycle
-    async def start(self, host: str = "0.0.0.0", port: int = 8000, grpc_port: int | None = 5000):
-        self._runner = web.AppRunner(self.app)
-        await self._runner.setup()
-        site = web.TCPSite(self._runner, host, port)
-        await site.start()
+    async def start(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        grpc_port: int | None = 5000,
+        fast_ingress: bool = False,
+    ):
+        if fast_ingress:
+            # purpose-built data-plane HTTP server (serving/fast_http.py):
+            # same wire-core handlers, roughly half the per-request server
+            # overhead of the general aiohttp app
+            from seldon_core_tpu.serving.fast_http import (
+                engine_routes,
+                start_fast_server,
+            )
+
+            self._fast_server = await start_fast_server(
+                engine_routes(self.service, self.state, metrics=self.metrics),
+                host,
+                port,
+            )
+        else:
+            self._runner = web.AppRunner(self.app)
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, host, port)
+            await site.start()
         if grpc_port:
             try:
                 from seldon_core_tpu.serving.grpc_server import start_grpc_server
@@ -115,6 +137,9 @@ class PredictorServer:
             await self.batcher.close()
         if self._grpc_server is not None:
             await self._grpc_server.stop(GRACE_DRAIN_S)
+        if self._fast_server is not None:
+            self._fast_server.close()
+            await self._fast_server.wait_closed()
         if self._runner is not None:
             await self._runner.cleanup()
         # release remote-unit channels + the shared HTTP pool

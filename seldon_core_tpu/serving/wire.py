@@ -1,0 +1,308 @@
+"""Transport-neutral hot-path handlers (the wire core).
+
+One implementation of the engine and gateway data-plane semantics, consumed
+by BOTH transports: the aiohttp apps (serving/rest.py, gateway/app.py) and
+the fast asyncio.Protocol ingress (serving/fast_http.py). aiohttp's
+per-request machinery costs ~150 us of a serving core; the reference embeds
+Tomcat and pays the same class of overhead (SURVEY C8/C13) — owning the
+data-plane HTTP layer is where a serving framework's ingress budget goes.
+Keeping the semantics HERE means the fast path can never drift from the
+general one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from urllib.parse import parse_qs
+
+from seldon_core_tpu.core.codec_json import (
+    feedback_from_dict,
+    message_from_dict,
+    message_from_json_fast,
+    message_to_json_fast,
+    meta_to_dict,
+)
+from seldon_core_tpu.core.codec_npy import is_npy
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import SeldonMessage
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class WireRequest:
+    """The request shape every transport reduces to: method, path, LOWERCASE
+    header dict, raw body bytes. ``declared_ctype`` distinguishes a client
+    that actually sent Content-Type from transports that synthesize a
+    default (classify_binary_bytes needs this: header-less bodies must fall
+    through to the JSON parser)."""
+
+    method: str
+    path: str
+    headers: dict[str, str]
+    body: bytes
+    declared_ctype: bool = True
+
+    @property
+    def content_type(self) -> str:
+        ctype = self.headers.get("content-type", "")
+        return ctype.split(";", 1)[0].strip().lower()
+
+
+@dataclass
+class WireResponse:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/json"
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def json_obj(obj, status: int = 200) -> "WireResponse":
+        return WireResponse(status=status, body=json.dumps(obj).encode())
+
+    @staticmethod
+    def text(text: str, status: int = 200) -> "WireResponse":
+        return WireResponse(
+            status=status, body=text.encode(), content_type="text/plain"
+        )
+
+
+NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
+
+
+def classify_binary_bytes(
+    ctype: str, declared: bool, raw: bytes, sniff_npy: bool = True
+) -> str:
+    """Byte-level twin of http_util.classify_binary_body: ``"npy"``,
+    ``"bin"`` or ``"json"`` (see that docstring for the full contract —
+    x-npy is an explicit opt-in honored regardless of sniffing; octet-stream
+    sniffs the magic only when the deployment allows; header-less bodies
+    fall to the JSON parser)."""
+    if ctype not in NPY_CONTENT_TYPES:
+        return "json"
+    if ctype == "application/x-npy" or (sniff_npy and is_npy(raw)):
+        return "npy"
+    if declared:
+        return "bin"
+    return "json"
+
+
+def _multipart_field(req: WireRequest, field_name: str) -> str | None:
+    """Extract one text field from a multipart/form-data body (the reference
+    wire quirk accepts the ``json=`` field from either form encoding)."""
+    import re
+
+    full_ctype = req.headers.get("content-type", "")
+    m = re.search(r'boundary="?([^";]+)"?', full_ctype)
+    if not m:
+        return None
+    delim = b"--" + m.group(1).encode()
+    needle = f'name="{field_name}"'.encode()
+    for part in req.body.split(delim):
+        head, sep, payload = part.partition(b"\r\n\r\n")
+        if sep and needle in head:
+            return payload.rstrip(b"\r\n").decode("utf-8", errors="replace")
+    return None
+
+
+def payload_obj(req: WireRequest, invalid_code: ErrorCode) -> dict:
+    """JSON body, or form field ``json=`` in urlencoded OR multipart form
+    (reference wire compat — wrappers/python/microservice.py:44-52)."""
+    ctype = req.content_type
+    if ctype in ("application/x-www-form-urlencoded", "multipart/form-data"):
+        if ctype.startswith("multipart"):
+            raw = _multipart_field(req, "json")
+        else:
+            fields = parse_qs(req.body.decode("utf-8", errors="replace"))
+            raw = (fields.get("json") or [None])[0]
+        if raw is None:
+            raise APIException(invalid_code, "missing 'json' form field")
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise APIException(invalid_code, str(e)) from e
+    try:
+        return json.loads(req.body)
+    except Exception as e:  # noqa: BLE001
+        raise APIException(invalid_code, str(e)) from e
+
+
+def failure_response(
+    e: BaseException, *, fallback_code: ErrorCode, op: str, metrics_error
+) -> WireResponse:
+    """Wire-boundary invariant as a WireResponse (http_util.wire_failure's
+    transport-neutral twin): status-JSON body, never an HTML 500."""
+    if not isinstance(e, APIException):
+        log.exception("unhandled error serving %s", op)
+        e = APIException(fallback_code, str(e))
+    if metrics_error is not None:
+        metrics_error(e.error.code)
+    return WireResponse(
+        status=e.error.http_status, body=json.dumps(e.to_status_json()).encode()
+    )
+
+
+def npy_wire_response(out: SeldonMessage) -> WireResponse:
+    """Raw npy body + meta in the Seldon-Meta header (http_util.npy_response
+    semantics, incl. the header-size truncation rule)."""
+    meta_json = json.dumps(meta_to_dict(out.meta))
+    if len(meta_json) > 6144:
+        meta_json = json.dumps(
+            {"puid": out.meta.puid, "routing": dict(out.meta.routing), "truncated": True}
+        )
+    return WireResponse(
+        body=out.bin_data,
+        content_type="application/x-npy",
+        headers={"Seldon-Meta": meta_json},
+    )
+
+
+# --------------------------------------------------------------- engine core
+async def engine_predictions(service, req: WireRequest) -> WireResponse:
+    """POST /api/v0.1/predictions against one PredictionService — the engine
+    data plane (reference RestClientController.predictions:102)."""
+    try:
+        ctype = req.content_type
+        kind = classify_binary_bytes(
+            ctype, req.declared_ctype, req.body, sniff_npy=service.decode_npy
+        )
+        if kind != "json":
+            out = await service.predict(
+                SeldonMessage(bin_data=req.body), wire_npy=kind == "npy"
+            )
+            if kind == "npy" and is_npy(out.bin_data):
+                return npy_wire_response(out)
+            return WireResponse(body=message_to_json_fast(out))
+        if ctype == "application/json" or not req.declared_ctype:
+            msg = message_from_json_fast(req.body)
+        else:
+            msg = message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+        out = await service.predict(msg)
+        return WireResponse(body=message_to_json_fast(out))
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            op="predict",
+            metrics_error=lambda c: service.metrics.ingress_error(
+                service.deployment_name, "predict", c
+            ),
+        )
+
+
+async def engine_feedback(service, req: WireRequest) -> WireResponse:
+    try:
+        fb = feedback_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+        out = await service.send_feedback(fb)
+        return WireResponse(body=message_to_json_fast(out))
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            op="feedback",
+            metrics_error=lambda c: service.metrics.ingress_error(
+                service.deployment_name, "feedback", c
+            ),
+        )
+
+
+# -------------------------------------------------------------- gateway core
+async def gateway_predictions(gw, req: WireRequest) -> WireResponse:
+    """POST /api/v0.1/predictions through the OAuth gateway — the external
+    hot path (reference apife RestClientController.prediction:127)."""
+    import time as _time
+
+    start = _time.perf_counter()
+    try:
+        principal = gw.principal_from_auth(req.headers.get("authorization", ""))
+        dep = gw._deployment(principal)
+        # predictors of one deployment share wire semantics (validated), so
+        # the first predictor's toggle speaks for the deployment
+        sniff = dep.predictors[0].tpu.decode_npy_bindata if dep.predictors else True
+        ctype = req.content_type
+        kind = classify_binary_bytes(ctype, req.declared_ctype, req.body, sniff_npy=sniff)
+        npy = kind == "npy"
+        if kind != "json":
+            # npy: wire_npy carries the explicit declaration to the backend
+            # (in-process: service decode; remote: raw x-npy forward).
+            # bin: opaque binData passthrough.
+            msg = SeldonMessage(bin_data=req.body)
+        elif ctype == "application/json" or not req.declared_ctype:
+            msg = message_from_json_fast(req.body)
+        else:
+            msg = message_from_dict(payload_obj(req, ErrorCode.APIFE_INVALID_JSON))
+        out = await gw.backend.predict(dep, msg, wire_npy=npy)
+        gw.audit.send(principal, msg, out)  # RestClientController.java:164
+        if gw.metrics is not None:
+            gw.metrics.ingress_request(dep.name, "predict", _time.perf_counter() - start)
+        if npy:
+            # mirror the request kind; the is_npy guard keeps opaque
+            # bytes-out responses in the JSON envelope
+            from seldon_core_tpu.serving.service import mirror_npy_kind
+
+            out = mirror_npy_kind(out)
+            if is_npy(out.bin_data):
+                return npy_wire_response(out)
+        return WireResponse(body=message_to_json_fast(out))
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
+            op="gateway predict",
+            metrics_error=lambda c: gw.metrics is not None
+            and gw.metrics.ingress_error("", "predict", c),
+        )
+
+
+async def gateway_feedback(gw, req: WireRequest) -> WireResponse:
+    import time as _time
+
+    start = _time.perf_counter()
+    try:
+        principal = gw.principal_from_auth(req.headers.get("authorization", ""))
+        dep = gw._deployment(principal)
+        fb = feedback_from_dict(payload_obj(req, ErrorCode.APIFE_INVALID_JSON))
+        out = await gw.backend.feedback(dep, fb)
+        if gw.metrics is not None:
+            gw.metrics.ingress_request(dep.name, "feedback", _time.perf_counter() - start)
+            gw.metrics.feedback(dep.name, "", "", fb.reward)
+        return WireResponse(body=message_to_json_fast(out))
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.APIFE_MICROSERVICE_ERROR,
+            op="gateway feedback",
+            metrics_error=lambda c: gw.metrics is not None
+            and gw.metrics.ingress_error("", "feedback", c),
+        )
+
+
+async def gateway_token(gw, req: WireRequest) -> WireResponse:
+    """POST /oauth/token — client_credentials via Basic auth or form."""
+    import base64
+
+    client_id = client_secret = ""
+    auth = req.headers.get("authorization", "")
+    if auth.lower().startswith("basic "):
+        try:
+            decoded = base64.b64decode(auth[6:]).decode()
+            client_id, _, client_secret = decoded.partition(":")
+        except Exception:  # noqa: BLE001
+            pass
+    if not client_id:
+        if req.content_type == "multipart/form-data":
+            client_id = _multipart_field(req, "client_id") or ""
+            client_secret = _multipart_field(req, "client_secret") or ""
+        else:
+            fields = parse_qs(req.body.decode("utf-8", errors="replace"))
+            client_id = (fields.get("client_id") or [""])[0]
+            client_secret = (fields.get("client_secret") or [""])[0]
+    try:
+        return WireResponse.json_obj(gw.oauth.issue_token(client_id, client_secret))
+    except PermissionError:
+        return WireResponse.json_obj(
+            {"error": "invalid_client", "error_description": "Bad client credentials"},
+            status=401,
+        )

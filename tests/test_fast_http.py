@@ -1,0 +1,346 @@
+"""Fast data-plane ingress (serving/fast_http.py + serving/wire.py).
+
+The fast server shares its handlers with the aiohttp apps through the wire
+core, so these tests assert the TRANSPORT: parsing, keep-alive, error
+statuses, and semantic equality with the aiohttp surface on the same
+service.
+"""
+
+import asyncio
+import base64
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tests.conftest import free_port
+from seldon_core_tpu.core.codec_npy import array_from_npy, npy_from_array
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.serving.fast_http import (
+    engine_routes,
+    gateway_routes,
+    start_fast_server,
+)
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.utils.env import default_predictor
+
+
+def _service(decode_npy: bool = True) -> PredictionService:
+    executor = build_executor(default_predictor())
+    return PredictionService(executor, deployment_name="d", decode_npy=decode_npy)
+
+
+async def _fast_engine(service=None, state=None):
+    service = service or _service()
+    state = state if state is not None else {"paused": False}
+    port = free_port()
+    server = await start_fast_server(
+        engine_routes(service, state), "127.0.0.1", port
+    )
+    return server, port
+
+
+async def _http(port: int, method: str, path: str, body: bytes = b"", headers=None):
+    """Tiny raw client so the test speaks plain HTTP/1.1 at the socket."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        hdrs = {"Content-Length": str(len(body)), **(headers or {})}
+        head = f"{method} {path} HTTP/1.1\r\nHost: t\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in hdrs.items()
+        )
+        writer.write(head.encode() + b"\r\n" + body)
+        await writer.drain()
+        status_line = await reader.readline()
+        status = int(status_line.split(b" ")[1])
+        resp_headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            resp_headers[k.strip().lower()] = v.strip()
+        clen = int(resp_headers.get("content-length", "0"))
+        resp_body = await reader.readexactly(clen) if clen else b""
+        return status, resp_headers, resp_body
+    finally:
+        writer.close()
+
+
+async def test_fast_engine_predictions_json_and_health():
+    server, port = await _fast_engine()
+    try:
+        st, hd, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert st == 200 and hd["content-type"].startswith("application/json")
+        out = json.loads(body)
+        assert out["data"]["ndarray"] and out["meta"]["puid"]
+
+        st, _, body = await _http(port, "GET", "/ready")
+        assert st == 200 and body == b"ready"
+        st, _, body = await _http(port, "GET", "/ping")
+        assert body == b"pong"
+        st, _, _ = await _http(port, "POST", "/pause")
+        st, _, _ = await _http(port, "GET", "/ready")
+        assert st == 503
+        st, _, body = await _http(port, "GET", "/nosuch")
+        assert st == 404
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_engine_npy_and_error_shape():
+    server, port = await _fast_engine()
+    try:
+        raw = npy_from_array(np.ones((2, 3), np.float32))
+        st, hd, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            raw,
+            {"Content-Type": "application/x-npy"},
+        )
+        assert st == 200 and hd["content-type"] == "application/x-npy"
+        assert array_from_npy(body).shape[0] == 2
+        assert json.loads(hd["seldon-meta"])["puid"]
+
+        # reference status-JSON error shape, never HTML
+        st, hd, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            b"{not json",
+            {"Content-Type": "application/json"},
+        )
+        assert st == 400
+        err = json.loads(body)
+        assert err["status"] == "FAILURE" and err["code"] == 101
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_engine_form_encoded_json_field():
+    """Reference wire quirk: form-encoded ``json=`` payloads."""
+    from urllib.parse import quote
+
+    server, port = await _fast_engine()
+    try:
+        payload = "json=" + quote(json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}))
+        st, _, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            payload.encode(),
+            {"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        assert st == 200
+        assert json.loads(body)["data"]["ndarray"]
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_server_keepalive_sequences_requests():
+    """Several requests over ONE connection, answered in order."""
+    server, port = await _fast_engine()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        body = json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()
+        req = (
+            f"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+        writer.write(req * 3)  # pipelined burst: must still answer all, in order
+        await writer.drain()
+        for _ in range(3):
+            status_line = await reader.readline()
+            assert b"200" in status_line
+            clen = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                if line.lower().startswith(b"content-length"):
+                    clen = int(line.split(b":")[1])
+            resp = await reader.readexactly(clen)
+            assert json.loads(resp)["data"]["ndarray"]
+        writer.close()
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_gateway_oauth_flow_matches_aiohttp_app():
+    """The fast gateway ingress and the aiohttp gateway app answer the same
+    requests identically (shared wire core)."""
+    from seldon_core_tpu.gateway.app import (
+        Gateway,
+        InProcessBackend,
+        build_gateway_app,
+    )
+    from seldon_core_tpu.gateway.oauth import OAuthProvider
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    backend = InProcessBackend()
+    gw = Gateway(store=store, oauth=oauth, backend=backend)
+    store.deployment_added(DeploymentSpec(name="dep1", oauth_key="k1", oauth_secret="s1"))
+    backend.register("dep1", _service())
+
+    port = free_port()
+    fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    aio_client = TestClient(TestServer(build_gateway_app(gw)))
+    await aio_client.start_server()
+    try:
+        # token via the fast ingress (form body)
+        st, _, body = await _http(
+            port,
+            "POST",
+            "/oauth/token",
+            b"grant_type=client_credentials&client_id=k1&client_secret=s1",
+            {"Content-Type": "application/x-www-form-urlencoded"},
+        )
+        assert st == 200
+        token = json.loads(body)["access_token"]
+
+        req_body = json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()
+        st, _, fast_body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            req_body,
+            {"Content-Type": "application/json", "Authorization": f"Bearer {token}"},
+        )
+        assert st == 200
+        aio_resp = await aio_client.post(
+            "/api/v0.1/predictions",
+            data=req_body,
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {token}",
+            },
+        )
+        assert aio_resp.status == 200
+        fast_out, aio_out = json.loads(fast_body), await aio_resp.json()
+        # identical up to the per-request puid
+        np.testing.assert_allclose(
+            fast_out["data"]["ndarray"], aio_out["data"]["ndarray"], rtol=1e-6
+        )
+
+        # bad token: same reference error shape on both
+        st, _, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            req_body,
+            {"Content-Type": "application/json", "Authorization": "Bearer bogus"},
+        )
+        aio_resp = await aio_client.post(
+            "/api/v0.1/predictions",
+            data=req_body,
+            headers={"Content-Type": "application/json", "Authorization": "Bearer bogus"},
+        )
+        assert st == aio_resp.status
+        assert json.loads(body)["code"] == (await aio_resp.json())["code"]
+
+        # basic-auth token issuance
+        basic = base64.b64encode(b"k1:s1").decode()
+        st, _, body = await _http(
+            port,
+            "POST",
+            "/oauth/token",
+            b"grant_type=client_credentials",
+            {
+                "Content-Type": "application/x-www-form-urlencoded",
+                "Authorization": f"Basic {basic}",
+            },
+        )
+        assert st == 200 and json.loads(body)["access_token"]
+    finally:
+        fast.close()
+        await fast.wait_closed()
+        await aio_client.close()
+
+
+async def test_fast_server_rejects_oversize_and_chunked():
+    server, port = await _fast_engine()
+    try:
+        # chunked request bodies are out of contract -> 411
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        await writer.drain()
+        status_line = await reader.readline()
+        assert b"411" in status_line
+        writer.close()
+
+        # declared oversize -> 413 without reading the body
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Length: 999999999999\r\n\r\n"
+        )
+        await writer.drain()
+        status_line = await reader.readline()
+        assert b"413" in status_line
+        writer.close()
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_multipart_form_json_field_kept():
+    """Reference wire compat: multipart/form-data with a 'json' field works
+    on every transport (code-review r3: the wire-core extraction must not
+    drop what http_util.payload_dict accepted)."""
+    server, port = await _fast_engine()
+    try:
+        boundary = "XbOuNdArYx"
+        payload = json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}})
+        body = (
+            f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="json"\r\n\r\n'
+            f"{payload}\r\n"
+            f"--{boundary}--\r\n"
+        ).encode()
+        st, _, resp = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            body,
+            {"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        )
+        assert st == 200
+        assert json.loads(resp)["data"]["ndarray"]
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_post_without_content_length_is_411():
+    server, port = await _fast_engine()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n\r\n"
+        )
+        await writer.drain()
+        status_line = await reader.readline()
+        assert b"411" in status_line
+        writer.close()
+    finally:
+        server.close()
+        await server.wait_closed()
