@@ -3,7 +3,9 @@
 PY ?= python
 TEST_ENV = PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test-fast bench dryrun protos native install-bundle clean
+IMAGE ?= seldon-core-tpu/platform:latest
+
+.PHONY: test test-fast bench dryrun protos native install-bundle image release clean
 
 test:  ## full suite on the 8-device virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -25,7 +27,13 @@ native:  ## force-rebuild the C wire codec
 	$(PY) -c "from seldon_core_tpu import native; assert native.available(); print('fastcodec ok')"
 
 install-bundle:  ## render k8s manifests to deploy/rendered/
-	$(PY) -m seldon_core_tpu.tools.install --with-redis -o deploy/rendered
+	$(PY) -m seldon_core_tpu.tools.install --with-redis --with-monitoring -o deploy/rendered
+
+image:  ## build the platform image the install bundle deploys
+	docker build -t $(IMAGE) .
+
+release:  ## VERSION=x.y.z make release — bump + tag (push tags to publish via CI)
+	$(PY) -m seldon_core_tpu.tools.release $(VERSION) --tag
 
 clean:
 	rm -rf .pytest_cache deploy/rendered seldon_core_tpu/native/_fastcodec.so*
