@@ -25,7 +25,7 @@ COPY pyproject.toml ./
 COPY seldon_core_tpu ./seldon_core_tpu
 COPY deploy ./deploy
 RUN pip install --no-cache-dir -e . \
-    && python -c "from seldon_core_tpu import native; native.available()"
+    && python -c "from seldon_core_tpu import native; assert native.available(), 'fastcodec failed to build'"
 
 # reference port layout: 8080 external API (apife), 8000 engine REST,
 # 5000 gRPC, /metrics on the API port

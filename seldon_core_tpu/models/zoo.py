@@ -140,6 +140,92 @@ def build_mnist_mlp(seed: int = 0, hidden: int = 512, **_) -> ModelSpec:
     return ModelSpec(_apply_mlp3_flat, params, (784,), tuple(str(i) for i in range(10)))
 
 
+def _pipe_stage_fn(p, h):
+    """One pipeline stage: residual tanh block, [mb, d] -> [mb, d] (the
+    uniform signature pipeline_apply requires)."""
+    return h + jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _apply_pipe_tower_seq(p, x):
+    """Single-device reference path: stages run sequentially via scan over
+    the stacked [S, ...] stage params — bitwise the same math the pipelined
+    path computes, so serving equivalence is testable."""
+    from jax import lax
+
+    h = x @ p["embed"]["w"] + p["embed"]["b"]
+
+    def body(h, stage_p):
+        return _pipe_stage_fn(stage_p, h), None
+
+    h, _ = lax.scan(body, h, p["stages"])
+    return jax.nn.softmax(h @ p["head"]["w"] + p["head"]["b"], axis=-1)
+
+
+@register_model("pipe_mlp")
+def build_pipe_mlp(
+    seed: int = 0, n_in: int = 16, d: int = 64, stages: int = 4, classes: int = 3, **_
+) -> ModelSpec:
+    """Pipeline-parallel SERVING model (VERDICT r2 item 6): a residual MLP
+    tower whose stages shard one-per-device over a "pipe" mesh axis.
+
+    With ``tpu.mesh: {"pipe": S}`` the apply_factory wraps
+    parallel/pipeline.pipeline_apply — each device holds ONE stage's
+    params, activations flow stage-to-stage over ICI (ppermute), and the
+    micro-batched GPipe schedule hides the per-stage latency. Without a
+    pipe axis the same stacked params run as a sequential scan, so the
+    deployment spec alone decides the execution strategy (the SURVEY §7
+    inversion: the CR compiles onto the slice)."""
+    keys = jax.random.split(jax.random.key(seed), 3)
+    scale = (1.0 / d) ** 0.5
+    params = {
+        "embed": _dense_init(keys[0], n_in, d),
+        "stages": {
+            "w": jax.random.normal(keys[1], (stages, d, d), jnp.float32) * scale,
+            "b": jnp.zeros((stages, d), jnp.float32),
+        },
+        "head": _dense_init(keys[2], d, classes),
+    }
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = {
+        "embed": {"w": P(), "b": P()},
+        # one stage per device along the pipe axis
+        "stages": {"w": P("pipe"), "b": P("pipe")},
+        "head": {"w": P(), "b": P()},
+    }
+
+    def apply_factory(mesh):
+        if "pipe" not in mesh.axis_names:
+            return _apply_pipe_tower_seq
+        from seldon_core_tpu.parallel.pipeline import pipeline_apply
+
+        n_stages = int(mesh.shape["pipe"])
+
+        def apply_pipelined(p, x):
+            h = x @ p["embed"]["w"] + p["embed"]["b"]
+            batch = h.shape[0]
+            # microbatch count: S microbatches fill the pipe (bubble
+            # fraction (S-1)/(2S-1)); shapes are static per bucket so this
+            # branch resolves at trace time, and power-of-two buckets are
+            # always divisible by a power-of-two stage count
+            m = n_stages if batch % n_stages == 0 else 1
+            h_micro = h.reshape(m, batch // m, h.shape[-1])
+            out = pipeline_apply(_pipe_stage_fn, p["stages"], h_micro, mesh)
+            h2 = out.reshape(batch, h.shape[-1])
+            return jax.nn.softmax(h2 @ p["head"]["w"] + p["head"]["b"], axis=-1)
+
+        return apply_pipelined
+
+    return ModelSpec(
+        _apply_pipe_tower_seq,
+        params,
+        (n_in,),
+        tuple(f"c{i}" for i in range(classes)),
+        param_pspecs=pspecs,
+        apply_factory=apply_factory,
+    )
+
+
 def _register_heavy_models() -> None:
     """resnet50 / bert_base import lazily — they pull flax."""
     from seldon_core_tpu.models import resnet as _resnet  # noqa: F401

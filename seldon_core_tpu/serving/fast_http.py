@@ -236,6 +236,17 @@ def engine_routes(service, state: dict, metrics=None) -> dict:
     for method in ("GET", "POST"):
         routes[(method, "/pause")] = pause
         routes[(method, "/unpause")] = unpause
+
+    # internal microservice API (reference internal-api.md) — same surface
+    # as the aiohttp app
+    def _unit_method(name: str):
+        async def handler(req: WireRequest) -> WireResponse:
+            return await wire.engine_unit_method(service, req, name)
+
+        return handler
+
+    for name in wire.INTERNAL_API_METHODS:
+        routes[("POST", f"/{name}")] = _unit_method(name)
     return routes
 
 

@@ -67,6 +67,25 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
         body = m.export() if m is not None else b""
         return web.Response(body=body, content_type="text/plain")
 
+    # internal microservice API (reference internal-api.md): the endpoints
+    # an engine's RemoteUnit dispatches to when THIS process is a wrapped
+    # single-unit microservice; shares the wire core with everything else
+    def _unit_method(method: str):
+        async def handler(request: web.Request) -> web.Response:
+            from seldon_core_tpu.serving import wire
+
+            req = await to_wire_request(request)
+            return from_wire_response(
+                await wire.engine_unit_method(service, req, method)
+            )
+
+        return handler
+
+    from seldon_core_tpu.serving.wire import INTERNAL_API_METHODS
+
+    for method in INTERNAL_API_METHODS:
+        app.router.add_post(f"/{method}", _unit_method(method))
+
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_get("/ready", ready)

@@ -208,6 +208,70 @@ async def engine_feedback(service, req: WireRequest) -> WireResponse:
         )
 
 
+# ------------------------------------------------- internal microservice API
+async def engine_unit_method(service, req: WireRequest, method: str) -> WireResponse:
+    """The reference's INTERNAL microservice API over REST
+    (docs/reference/internal-api.md:14-120; wrappers/python/microservice.py
+    routes): /predict /route /send-feedback /transform-input
+    /transform-output /aggregate on a wrapped single-unit service — the
+    endpoints the engine's RemoteUnit client dispatches to. Payloads accept
+    raw JSON or the form-encoded ``json=`` field; semantics mirror the gRPC
+    services (serving/grpc_server.py) exactly."""
+    import numpy as np
+
+    if method == "predict":
+        # /predict is the engine predictions surface under the internal-API
+        # path name: full semantics incl. the raw application/x-npy fast
+        # path and binData classification, not just the JSON envelope
+        return await engine_predictions(service, req)
+    try:
+        unit = service.executor.root.unit
+        if method == "transform-input":
+            out = await unit.transform_input(
+                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+            )
+        elif method == "transform-output":
+            out = await unit.transform_output(
+                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+            )
+        elif method == "route":
+            branch = await unit.route(
+                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+            )
+            out = SeldonMessage.from_array(np.asarray([[branch]], dtype=np.float32))
+        elif method == "aggregate":
+            obj = payload_obj(req, ErrorCode.ENGINE_INVALID_JSON)
+            msgs = [
+                message_from_dict(m) for m in obj.get("seldonMessages", [])
+            ]
+            out = await unit.aggregate(msgs)
+        elif method == "send-feedback":
+            fb = feedback_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+            out = await service.send_feedback(fb)
+        else:  # pragma: no cover - route tables only register the above
+            raise APIException(ErrorCode.ENGINE_INVALID_JSON, f"unknown method {method}")
+        return WireResponse(body=message_to_json_fast(out))
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            op=method,
+            metrics_error=lambda c: service.metrics.ingress_error(
+                service.deployment_name, method, c
+            ),
+        )
+
+
+INTERNAL_API_METHODS = (
+    "predict",
+    "route",
+    "send-feedback",
+    "transform-input",
+    "transform-output",
+    "aggregate",
+)
+
+
 # -------------------------------------------------------------- gateway core
 async def gateway_predictions(gw, req: WireRequest) -> WireResponse:
     """POST /api/v0.1/predictions through the OAuth gateway — the external

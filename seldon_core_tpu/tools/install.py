@@ -492,8 +492,16 @@ def monitoring_manifests(namespace: str, monitoring: dict) -> list[dict]:
     list/watch RBAC), loads the serving alert rules, and fires into
     alertmanager; grafana ships the predictions dashboard provisioned with
     a prometheus datasource. ``monitoring`` is the values section."""
-    rules = _monitoring_asset("prometheus-rules.yaml") or ""
-    dashboard = _monitoring_asset("grafana-predictions-dashboard.json") or ""
+    rules = _monitoring_asset("prometheus-rules.yaml")
+    dashboard = _monitoring_asset("grafana-predictions-dashboard.json")
+    if rules is None or dashboard is None:
+        # silently rendering empty rules / no grafana would look deployed
+        # while every documented alert is permanently absent
+        raise RuntimeError(
+            "--with-monitoring needs the deploy/monitoring assets "
+            "(prometheus-rules.yaml, grafana-predictions-dashboard.json) — "
+            "run from a repo checkout, or vendor them next to the package"
+        )
     prom_config = f"""\
 global:
   scrape_interval: 15s

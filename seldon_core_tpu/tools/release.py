@@ -87,8 +87,11 @@ def main() -> None:
     print(f"version {args.version} -> {', '.join(changed) or 'nothing changed'}")
 
     if args.tag:
-        run(["git", "add", *changed])
-        run(["git", "commit", "-m", f"Release {args.version}"])
+        if changed:
+            run(["git", "add", *changed])
+            run(["git", "commit", "-m", f"Release {args.version}"])
+        else:
+            print("version already current; tagging HEAD")
         run(["git", "tag", f"v{args.version}"])
 
     if args.build or args.push:

@@ -344,3 +344,19 @@ async def test_post_without_content_length_is_411():
     finally:
         server.close()
         await server.wait_closed()
+
+
+async def test_internal_predict_endpoint_serves_npy_fast_path():
+    """/predict (internal API) carries full engine-predictions semantics,
+    including the raw x-npy tensor fast path (code-review r3)."""
+    server, port = await _fast_engine()
+    try:
+        raw = npy_from_array(np.ones((2, 3), np.float32))
+        st, hd, body = await _http(
+            port, "POST", "/predict", raw, {"Content-Type": "application/x-npy"}
+        )
+        assert st == 200 and hd["content-type"] == "application/x-npy"
+        assert array_from_npy(body).shape[0] == 2
+    finally:
+        server.close()
+        await server.wait_closed()

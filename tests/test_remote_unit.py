@@ -77,3 +77,96 @@ async def test_remote_rest_model_node():
 
         await _RestSession.close()
         await runner.cleanup()
+
+
+async def test_our_microservice_serves_engine_remote_rest_unit(tmp_path):
+    """The full reference topology with OUR OWN pieces on both sides: a user
+    class wrapped by serve_microservice exposes the internal REST API
+    (/predict, form json=), and an engine graph's RemoteUnit consumes it —
+    previously the microservice only served /api/v0.1/* so this 404'd."""
+    from seldon_core_tpu.serving.microservice import (
+        load_user_object,
+        serve_microservice,
+    )
+    from tests.conftest import free_port
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    (model_dir / "Doubler.py").write_text(
+        "class Doubler:\n"
+        "    def predict(self, X, names):\n"
+        "        return X * 2.0\n"
+    )
+    user = load_user_object("Doubler", str(model_dir))
+    port = free_port()
+    runner, grpc_server, _ = await serve_microservice(
+        user, "Doubler", "MODEL", host="127.0.0.1", http_port=port
+    )
+    try:
+        ex = build_executor(_graph_with_remote(port, "REST"))
+        out = await ex.execute(SeldonMessage.from_array(np.full((1, 4), 3.0, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.array), [[6.0, 6.0, 6.0, 6.0]])
+    finally:
+        from seldon_core_tpu.engine.remote import _RestSession
+
+        await _RestSession.close()
+        if grpc_server is not None:
+            await grpc_server.stop(None)
+        if runner is not None:
+            await runner.cleanup()
+
+
+async def test_internal_api_route_aggregate_feedback_endpoints(tmp_path):
+    """Internal-API conformance (docs/reference/internal-api.md): /route
+    returns the branch as a 1x1 tensor, /aggregate consumes seldonMessages,
+    /send-feedback acks — REST forms matching the gRPC services."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.serving.microservice import (
+        load_user_object,
+        serve_microservice,
+    )
+    from tests.conftest import free_port
+
+    model_dir = tmp_path / "r"
+    model_dir.mkdir()
+    (model_dir / "PickOne.py").write_text(
+        "class PickOne:\n"
+        "    def route(self, X, names):\n"
+        "        return 1\n"
+        "    def send_feedback(self, X, names, routing, reward, truth):\n"
+        "        self.saw = reward\n"
+    )
+    user = load_user_object("PickOne", str(model_dir))
+    port = free_port()
+    runner, grpc_server, _ = await serve_microservice(
+        user, "PickOne", "ROUTER", host="127.0.0.1", http_port=port
+    )
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{port}/route",
+                json={"data": {"ndarray": [[1.0, 2.0]]}},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                # branch 1 as a 1x1 tensor (reference internal-api form)
+                assert body["data"]["tensor"] == {"shape": [1, 1], "values": [1.0]}
+
+            fb = {
+                "request": {"data": {"ndarray": [[1.0, 2.0]]}},
+                "response": {"meta": {"routing": {"PickOne": 1}}},
+                "reward": 0.5,
+            }
+            async with s.post(
+                f"http://127.0.0.1:{port}/send-feedback", json=fb
+            ) as resp:
+                assert resp.status == 200
+        assert user.saw == 0.5
+    finally:
+        if grpc_server is not None:
+            await grpc_server.stop(None)
+        if runner is not None:
+            await runner.cleanup()
