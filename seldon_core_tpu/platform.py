@@ -71,6 +71,7 @@ class Platform:
             hbm_budget_bytes=hbm_budget_bytes,
             allow_python_class=allow_python_class,
         )
+        self._fast_server = None
 
     def build_app(self) -> web.Application:
         app = build_gateway_app(self.gateway)
@@ -86,12 +87,35 @@ class Platform:
         watch_interval_s: float = 5.0,
         watch_k8s: bool = False,
         k8s_namespace: str = "default",
+        fast_ingress: bool = False,
+        admin_port: int = 8082,
     ):
+        self._fast_server = None
+        if fast_ingress:
+            # data plane on the purpose-built ingress (serving/fast_http.py,
+            # ~half the per-request overhead); the FULL aiohttp app — incl.
+            # the control-plane API — moves to the admin port, the
+            # reference engine's admin-port-8082 topology (TomcatConfig
+            # additionalPorts; operator wires admin=8082)
+            from seldon_core_tpu.serving.fast_http import (
+                gateway_routes,
+                start_fast_server,
+            )
+
+            self._fast_server = await start_fast_server(
+                gateway_routes(self.gateway), host, port
+            )
+        app_port = admin_port if fast_ingress else port
         runner = web.AppRunner(self.build_app())
         await runner.setup()
-        site = web.TCPSite(runner, host, port)
-        await site.start()
-        log.info("platform REST on %s:%s", host, port)
+        await web.TCPSite(runner, host, app_port).start()
+        if fast_ingress:
+            log.info(
+                "platform fast ingress on %s:%s, admin REST on %s:%s",
+                host, port, host, app_port,
+            )
+        else:
+            log.info("platform REST on %s:%s", host, port)
 
         grpc_server = None
         if grpc_port:
@@ -140,6 +164,8 @@ async def _amain(args) -> None:
         watch_dir=args.watch_dir,
         watch_k8s=args.watch_k8s,
         k8s_namespace=args.k8s_namespace,
+        fast_ingress=args.fast_ingress,
+        admin_port=args.admin_port,
     )
 
     stop = asyncio.Event()
@@ -152,6 +178,9 @@ async def _amain(args) -> None:
         watch_task.cancel()
     if grpc_server is not None:
         await grpc_server.stop(5)
+    if platform._fast_server is not None:
+        platform._fast_server.close()
+        await platform._fast_server.wait_closed()
     await runner.cleanup()
 
 
@@ -180,6 +209,19 @@ def main() -> None:
         help="reject deployments whose params would exceed this HBM budget (0 = unlimited)",
     )
     parser.add_argument("--no-grpc", action="store_true")
+    parser.add_argument(
+        "--fast-ingress",
+        action="store_true",
+        help="serve the data plane on the purpose-built HTTP ingress "
+        "(serving/fast_http.py, ~2x request throughput) and move the full "
+        "REST app incl. the control-plane API to --admin-port",
+    )
+    parser.add_argument(
+        "--admin-port",
+        type=int,
+        default=8082,  # the reference engine's admin port
+        help="control-plane/admin REST port when --fast-ingress is on",
+    )
     parser.add_argument(
         "--allow-python-class",
         action="store_true",

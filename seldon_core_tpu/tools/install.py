@@ -184,6 +184,13 @@ def platform_deployment(
                                     "8080",
                                     "--grpc-port",
                                     "5000",
+                                    # data plane on the fast ingress, full
+                                    # REST app (control API) on the admin
+                                    # port — the reference engine's
+                                    # admin-8082 topology
+                                    "--fast-ingress",
+                                    "--admin-port",
+                                    "8082",
                                     # reconcile SeldonDeployment CRs on the
                                     # API server — the reason the RBAC watch
                                     # verbs and CRD status subresource exist
@@ -194,6 +201,7 @@ def platform_deployment(
                                 "ports": [
                                     {"containerPort": 8080, "name": "http"},
                                     {"containerPort": 5000, "name": "grpc"},
+                                    {"containerPort": 8082, "name": "admin"},
                                 ],
                                 "readinessProbe": {
                                     "httpGet": {"path": "/ready", "port": "http"},
@@ -215,6 +223,9 @@ def platform_deployment(
                 "ports": [
                     {"name": "http", "port": 8080, "targetPort": 8080},
                     {"name": "grpc", "port": 5000, "targetPort": 5000},
+                    # control-plane REST (CR apply/list/delete) — the fast
+                    # ingress serves only the data plane on 8080
+                    {"name": "admin", "port": 8082, "targetPort": 8082},
                 ],
                 # reference knob apife_service_type (values.yaml:5)
                 **({"type": service_type} if service_type else {}),

@@ -360,3 +360,80 @@ async def test_internal_predict_endpoint_serves_npy_fast_path():
     finally:
         server.close()
         await server.wait_closed()
+
+
+async def test_platform_fast_ingress_with_admin_port():
+    """platform --fast-ingress: data plane on the fast ingress, control
+    API + full REST app on the admin port (reference admin-8082 topology).
+    A CR applied through the ADMIN port serves through the FAST port."""
+    import aiohttp
+
+    from seldon_core_tpu.platform import Platform
+
+    platform = Platform(metrics_enabled=False)
+    port, admin = free_port(), free_port()
+    runner, grpc_server, _ = await platform.serve(
+        host="127.0.0.1",
+        port=port,
+        admin_port=admin,
+        grpc_port=None,
+        fast_ingress=True,
+    )
+    try:
+        cr = {
+            "apiVersion": "machinelearning.seldon.io/v1alpha1",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "fidep"},
+            "spec": {
+                "name": "fidep",
+                "oauth_key": "fk",
+                "oauth_secret": "fs",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "m",
+                            "type": "MODEL",
+                            "implementation": "JAX_MODEL",
+                            "parameters": [
+                                {"name": "model", "value": "iris_logistic", "type": "STRING"}
+                            ],
+                        },
+                    }
+                ],
+            },
+        }
+        base = "/apis/machinelearning.seldon.io/v1alpha1/seldondeployments"
+        async with aiohttp.ClientSession() as s:
+            # control plane via ADMIN port
+            async with s.post(f"http://127.0.0.1:{admin}{base}", json=cr) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["action"] == "created"
+            # data plane via FAST port: token then predict
+            async with s.post(
+                f"http://127.0.0.1:{port}/oauth/token",
+                data={"grant_type": "client_credentials", "client_id": "fk", "client_secret": "fs"},
+            ) as resp:
+                assert resp.status == 200
+                token = (await resp.json())["access_token"]
+            async with s.post(
+                f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.json()
+                assert len(body["data"]["ndarray"][0]) == 3
+            # control API is NOT exposed on the data-plane port
+            async with s.post(f"http://127.0.0.1:{port}{base}", json=cr) as resp:
+                assert resp.status == 404
+            # health on both
+            async with s.get(f"http://127.0.0.1:{port}/ready") as resp:
+                assert resp.status == 200
+            async with s.get(f"http://127.0.0.1:{admin}/ready") as resp:
+                assert resp.status == 200
+    finally:
+        if platform._fast_server is not None:
+            platform._fast_server.close()
+            await platform._fast_server.wait_closed()
+        await runner.cleanup()
