@@ -59,15 +59,13 @@ def _layer_init(rng, hidden, ffn):
     }
 
 
-# long sequences switch to the blockwise (flash-style) kernel: O(block)
-# memory instead of the O(s^2) score matrix; exactness is unchanged
-_FLASH_MIN_SEQ = 1024
-
-
 def _default_attention(q, k, v):
-    """seq-length-adaptive: dense einsum below _FLASH_MIN_SEQ, blockwise
-    (flash-style, O(block) memory) above."""
-    if q.shape[2] >= _FLASH_MIN_SEQ:
+    """seq-length-adaptive: dense einsum below FLASH_MIN_SEQ, blockwise
+    (flash-style, O(block) memory) above — the shared policy constant lives
+    in ops/attention so seq-parallel local bodies can't drift from it."""
+    from seldon_core_tpu.ops.attention import FLASH_MIN_SEQ
+
+    if q.shape[2] >= FLASH_MIN_SEQ:
         from seldon_core_tpu.ops.attention import blockwise_attention
 
         return blockwise_attention(q, k, v, block_size=512)
@@ -92,6 +90,23 @@ def make_ring_attention(mesh, seq_axis: str = "seq"):
         from seldon_core_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, mesh, seq_axis=seq_axis)
+
+    return impl
+
+
+def make_ulysses_attention_impl(mesh, seq_axis: str = "seq"):
+    """The all-to-all (Ulysses-style) seq-parallel twin of
+    make_ring_attention: heads scatter / sequence gathers for the attention
+    op, then reverses (ops/ulysses.py). Same graceful fallback to the
+    single-device path when shapes don't divide the mesh axis."""
+
+    def impl(q, k, v):
+        n = mesh.shape[seq_axis]
+        if q.shape[2] % n != 0 or q.shape[1] % n != 0:
+            return _default_attention(q, k, v)
+        from seldon_core_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh, seq_axis=seq_axis)
 
     return impl
 
@@ -216,27 +231,47 @@ def _infer_heads(params: dict) -> int:
     return max(1, hidden // 64)
 
 
-# memoized per mesh: fused.py detects homogeneous ensembles by apply-fn
-# IDENTITY, so two builds on the same mesh must get the same function object
+# memoized per (mesh, strategy): fused.py detects homogeneous ensembles by
+# apply-fn IDENTITY, so two builds on the same mesh must get the same
+# function object
 _RING_APPLY_CACHE: dict = {}
 
 
-def _bert_apply_factory(mesh):
-    """Mesh-aware serving apply: a mesh with a "seq" axis turns on ring
-    attention (sequence parallelism) automatically; otherwise the default
+def _bert_apply_factory(mesh, seq_parallel: str = "ring"):
+    """Mesh-aware serving apply: a mesh with a "seq" axis turns on sequence
+    parallelism automatically — ring attention by default, or the
+    all-to-all (Ulysses) strategy when the deployment asks for it
+    (``seq_parallel`` model parameter); otherwise the default
     length-adaptive attention runs under whatever data/TP sharding the mesh
     provides."""
     if mesh is not None and "seq" in getattr(mesh, "shape", {}):
-        fn = _RING_APPLY_CACHE.get(mesh)
+        key = (mesh, seq_parallel)
+        fn = _RING_APPLY_CACHE.get(key)
         if fn is None:
-            fn = make_apply_bert(make_ring_attention(mesh))
-            _RING_APPLY_CACHE[mesh] = fn
+            if seq_parallel == "ulysses":
+                impl = make_ulysses_attention_impl(mesh)
+            elif seq_parallel == "ring":
+                impl = make_ring_attention(mesh)
+            else:
+                raise ValueError(
+                    f"seq_parallel must be 'ring' or 'ulysses', got {seq_parallel!r}"
+                )
+            fn = make_apply_bert(impl)
+            _RING_APPLY_CACHE[key] = fn
         return fn
     return apply_bert
 
 
 @register_model("bert_base")
-def build_bert_base(seed: int = 0, num_classes: int = 2, max_len: int = 512, **_) -> ModelSpec:
+def build_bert_base(
+    seed: int = 0,
+    num_classes: int = 2,
+    max_len: int = 512,
+    seq_parallel: str = "ring",
+    **_,
+) -> ModelSpec:
+    from functools import partial
+
     params = init_bert(seed, num_classes=num_classes, max_len=max_len)
     return ModelSpec(
         apply_bert,
@@ -244,7 +279,9 @@ def build_bert_base(seed: int = 0, num_classes: int = 2, max_len: int = 512, **_
         (128,),  # default serving seq length; buckets handle the batch axis
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
-        apply_factory=_bert_apply_factory,
+        # seq-parallel strategy is a deployment knob: a "seq" mesh axis plus
+        # model parameter seq_parallel=ring|ulysses picks the collective
+        apply_factory=partial(_bert_apply_factory, seq_parallel=seq_parallel),
         int_inputs="ids",
     )
 
@@ -258,9 +295,12 @@ def build_bert_tiny(
     ffn: int = 256,
     max_len: int = 128,
     num_classes: int = 2,
+    seq_parallel: str = "ring",
     **_,
 ) -> ModelSpec:
     """Shrunk config for tests / virtual-mesh dryruns."""
+    from functools import partial
+
     params = init_bert(
         seed,
         vocab=vocab,
@@ -276,6 +316,6 @@ def build_bert_tiny(
         (16,),
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
-        apply_factory=_bert_apply_factory,
+        apply_factory=partial(_bert_apply_factory, seq_parallel=seq_parallel),
         int_inputs="ids",
     )

@@ -316,6 +316,8 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
                 f"max_position_embeddings={max_len} — failing fast instead "
                 "of an opaque XLA broadcast error at warmup"
             )
+        from functools import partial
+
         ms = ModelSpec(
             apply_bert,
             params,
@@ -323,8 +325,12 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
             class_names,
             param_pspecs=bert_pspecs(params),
             # same mesh-aware apply as zoo bert builders: a 'seq' mesh axis
-            # turns on ring attention for imported checkpoints too
-            apply_factory=_bert_apply_factory,
+            # turns on sequence parallelism for imported checkpoints too,
+            # with the same ring|ulysses strategy knob (?seq_parallel=)
+            apply_factory=partial(
+                _bert_apply_factory,
+                seq_parallel=str(kwargs.get("seq_parallel", "ring")),
+            ),
             int_inputs="ids",
         )
         return _runtime_from_modelspec(ms, tpu_cfg, mesh)
@@ -340,6 +346,20 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
     uri = params.get("model_uri") or (
         f"zoo://{params['model']}" if "model" in params else None
     )
+    if "model" in params and "model_uri" not in params:
+        # every OTHER unit parameter forwards as a builder kwarg (typed by
+        # _parse_zoo_uri), so CR parameters like seq_parallel/num_classes
+        # reach the zoo builder instead of being silently dropped
+        extra = {
+            k: v
+            for k, v in params.items()
+            if k not in ("model", "model_uri", "finetune")
+        }
+        if extra:
+            uri = (
+                f"zoo://{params['model']}?"
+                + urllib.parse.urlencode({k: str(v) for k, v in extra.items()})
+            )
     if uri is None:
         container = (context.get("containers") or {}).get(spec.name)
         uri = getattr(container, "model_uri", "") or None

@@ -49,6 +49,12 @@ def combine_stats(m1, l1, o1, m2, l2, o2):
     return m, l, o
 
 
+# seq length at which the dense O(s^2) score matrix gives way to the
+# blockwise kernel — the ONE policy constant shared by the single-device
+# default (models/bert.py) and the seq-parallel local bodies (ops/ulysses.py)
+FLASH_MIN_SEQ = 1024
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -56,10 +62,16 @@ def blockwise_attention(
     *,
     block_size: int = 512,
     causal: bool = False,
+    vary_axes: tuple = (),
 ) -> jax.Array:
     """Exact attention with KV processed in blocks of ``block_size``.
 
     q,k,v: [batch, heads, seq, head_dim] -> [batch, heads, seq, head_dim].
+
+    ``vary_axes``: when called INSIDE shard_map, the scan carry is
+    initialized from axis-invariant constants and must be marked varying
+    over the manual mesh axes or the carry-in/carry-out types mismatch —
+    pass the enclosing mesh axis names (same fix ring_attention applies).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -90,6 +102,10 @@ def blockwise_attention(
         jnp.zeros((b, h, sq), q.dtype),
         jnp.zeros((b, h, sq, d), q.dtype),
     )
+    if vary_axes:
+        from seldon_core_tpu.parallel.compat import pvary
+
+        init = tuple(pvary(x, vary_axes) for x in init)
     (m, l, o), _ = lax.scan(body, init, jnp.arange(n_blocks))
     return o / l[..., None]
 
