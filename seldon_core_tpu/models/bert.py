@@ -237,14 +237,29 @@ def _infer_heads(params: dict) -> int:
 _RING_APPLY_CACHE: dict = {}
 
 
-def _bert_apply_factory(mesh, seq_parallel: str = "ring"):
+def _bert_apply_factory(mesh, seq_parallel: str = "ring", num_heads: int | None = None):
     """Mesh-aware serving apply: a mesh with a "seq" axis turns on sequence
     parallelism automatically — ring attention by default, or the
     all-to-all (Ulysses) strategy when the deployment asks for it
     (``seq_parallel`` model parameter); otherwise the default
     length-adaptive attention runs under whatever data/TP sharding the mesh
-    provides."""
+    provides.
+
+    ``num_heads`` (static model config, known at build time) lets ulysses
+    fail the DEPLOYMENT when heads don't divide the seq axis — heads are
+    the all-to-all resharding currency, and silently serving unsharded
+    attention would defeat the knob exactly at the long contexts that
+    motivated it. (Ring's seq-length fallback stays dynamic: request
+    lengths vary per bucket and must not error.)"""
     if mesh is not None and "seq" in getattr(mesh, "shape", {}):
+        if seq_parallel == "ulysses" and num_heads is not None:
+            n = int(mesh.shape["seq"])
+            if num_heads % n != 0:
+                raise ValueError(
+                    f"seq_parallel=ulysses needs attention heads divisible "
+                    f"by the seq-axis size: {num_heads} heads vs seq={n} — "
+                    "use a smaller seq axis or seq_parallel=ring"
+                )
         key = (mesh, seq_parallel)
         fn = _RING_APPLY_CACHE.get(key)
         if fn is None:
@@ -280,8 +295,11 @@ def build_bert_base(
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         # seq-parallel strategy is a deployment knob: a "seq" mesh axis plus
-        # model parameter seq_parallel=ring|ulysses picks the collective
-        apply_factory=partial(_bert_apply_factory, seq_parallel=seq_parallel),
+        # model parameter seq_parallel=ring|ulysses picks the collective;
+        # num_heads lets ulysses reject undivisible meshes at BUILD time
+        apply_factory=partial(
+            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=768 // 64
+        ),
         int_inputs="ids",
     )
 
@@ -316,6 +334,8 @@ def build_bert_tiny(
         (16,),
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
-        apply_factory=partial(_bert_apply_factory, seq_parallel=seq_parallel),
+        apply_factory=partial(
+            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=max(1, hidden // 64)
+        ),
         int_inputs="ids",
     )

@@ -276,12 +276,26 @@ def _parse_zoo_uri(uri: str) -> tuple[str, dict]:
     return name, kwargs
 
 
-def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
+def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None, extra_params: dict | None = None) -> ModelRuntime:
+    """``extra_params``: unit parameters beyond model/model_uri (typed by
+    the CR) — merged as builder kwargs under the URI's own query string, so
+    ``model_uri`` deployments get the same knobs (seq_parallel etc.) as the
+    ``model`` shorthand."""
+    extra_params = extra_params or {}
     if uri.startswith("zoo://"):
         name, kwargs = _parse_zoo_uri(uri)
+        kwargs = {**extra_params, **kwargs}  # the uri's own query wins
         ms = get_model(name, **kwargs)  # lazy-registers heavy models itself
         return _runtime_from_modelspec(ms, tpu_cfg, mesh)
     if uri.startswith("file://"):
+        if extra_params:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "file:// checkpoints ignore extra unit parameters %s (the "
+                "builder and its kwargs are baked into the checkpoint)",
+                sorted(extra_params),
+            )
         from seldon_core_tpu.persistence.checkpoint import restore_model
 
         ms = restore_model(uri[len("file://") :])
@@ -301,7 +315,10 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
 
         rest = uri[len("hf-bert://") :]
         path, _, query = rest.partition("?")
-        kwargs = dict(urllib.parse.parse_qsl(query))
+        kwargs = {
+            **{k: str(v) for k, v in extra_params.items()},
+            **dict(urllib.parse.parse_qsl(query)),  # the uri's query wins
+        }
         hf = transformers.BertForSequenceClassification.from_pretrained(path)
         params = bert_params_from_hf(hf.eval())
         id2label = getattr(hf.config, "id2label", None) or {}
@@ -330,6 +347,7 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None) -> ModelRuntime:
             apply_factory=partial(
                 _bert_apply_factory,
                 seq_parallel=str(kwargs.get("seq_parallel", "ring")),
+                num_heads=max(1, params["tok_emb"].shape[1] // 64),
             ),
             int_inputs="ids",
         )
@@ -346,20 +364,12 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
     uri = params.get("model_uri") or (
         f"zoo://{params['model']}" if "model" in params else None
     )
-    if "model" in params and "model_uri" not in params:
-        # every OTHER unit parameter forwards as a builder kwarg (typed by
-        # _parse_zoo_uri), so CR parameters like seq_parallel/num_classes
-        # reach the zoo builder instead of being silently dropped
-        extra = {
-            k: v
-            for k, v in params.items()
-            if k not in ("model", "model_uri", "finetune")
-        }
-        if extra:
-            uri = (
-                f"zoo://{params['model']}?"
-                + urllib.parse.urlencode({k: str(v) for k, v in extra.items()})
-            )
+    # every OTHER unit parameter forwards as a builder kwarg, so CR
+    # parameters like seq_parallel/num_classes reach the builder on every
+    # URI scheme instead of being silently dropped
+    extra = {
+        k: v for k, v in params.items() if k not in ("model", "model_uri", "finetune")
+    }
     if uri is None:
         container = (context.get("containers") or {}).get(spec.name)
         uri = getattr(container, "model_uri", "") or None
@@ -377,7 +387,9 @@ def make_jax_model_unit(spec: PredictiveUnit, context: dict) -> JaxModelUnit:
             "are undefined and updates would corrupt the frozen per-channel "
             "scales; serve the finetuning replica unquantized"
         )
-    runtime = build_runtime_from_uri(uri, context.get("tpu"), context.get("mesh"))
+    runtime = build_runtime_from_uri(
+        uri, context.get("tpu"), context.get("mesh"), extra_params=extra
+    )
 
     if finetune:
         from seldon_core_tpu.graph.spec import TYPE_METHODS, PredictiveUnitMethod

@@ -67,8 +67,48 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
             {"name": result.name, "action": result.action}, status=status
         )
 
+    # device profiling (SURVEY §5.1: jax.profiler hooks): capture an XLA/
+    # device trace viewable in XProf/TensorBoard. Admin surface only — the
+    # capture has process-wide overhead, so it never rides the data plane.
+    prof_state = {"dir": None}
+
+    async def profiler_start(request: web.Request) -> web.Response:
+        import jax
+
+        if prof_state["dir"] is not None:
+            return web.json_response(
+                {"error": f"already tracing to {prof_state['dir']}"}, status=409
+            )
+        out_dir = request.query.get("dir", "/tmp/seldon-tpu-profile")
+        try:
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # noqa: BLE001 - surface profiler errors as JSON
+            return web.json_response({"error": str(e)}, status=500)
+        prof_state["dir"] = out_dir
+        return web.json_response({"tracing": out_dir})
+
+    async def profiler_stop(request: web.Request) -> web.Response:
+        import jax
+
+        if prof_state["dir"] is None:
+            return web.json_response({"error": "not tracing"}, status=409)
+        out_dir = prof_state["dir"]
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            # keep the state: a failed stop (e.g. disk full mid-write) must
+            # stay retryable — clearing first would orphan the trace with
+            # 409s on retry and 500s on every future start
+            return web.json_response({"error": str(e)}, status=500)
+        prof_state["dir"] = None
+        return web.json_response(
+            {"written": out_dir, "view": "xprof / tensorboard --logdir " + out_dir}
+        )
+
     app.router.add_post(BASE, apply_dep)
     app.router.add_put(BASE, apply_dep)
     app.router.add_get(BASE, list_deps)
     app.router.add_get(BASE + "/{name}", get_dep)
     app.router.add_delete(BASE + "/{name}", delete_dep)
+    app.router.add_post("/profiler/start", profiler_start)
+    app.router.add_post("/profiler/stop", profiler_stop)

@@ -96,6 +96,19 @@ class RunningDeployment:
             if batcher is not None:
                 batcher.close_nowait()
 
+    def warmup(self) -> None:
+        """Compile every model runtime's batch buckets ahead of traffic
+        (same walk as PredictorServer.warmup — first XLA compile must not
+        land on a live request)."""
+        for svc in self.services.values():
+            executor = getattr(svc, "executor", None)
+            if executor is None:
+                continue
+            for unit in executor.units():
+                runtime = getattr(unit, "runtime", None)
+                if runtime is not None and getattr(runtime, "feature_shape", None) is not None:
+                    runtime.warmup()
+
     def close(self) -> None:
         self.close_batchers()
         self.flush_state()

@@ -472,3 +472,43 @@ def test_seq_parallel_cr_parameter_reaches_builder():
         rtol=2e-4,
         atol=2e-5,
     )
+
+
+def test_ulysses_heads_mesh_mismatch_rejected_at_build():
+    """Code-review r3: heads are static model config — a ulysses deployment
+    whose heads don't divide the seq axis fails at BUILD time (deployment
+    rejected) instead of silently serving unsharded attention."""
+    from seldon_core_tpu.graph.spec import TpuSpec
+    from seldon_core_tpu.models.zoo import get_model, _runtime_from_modelspec
+    from seldon_core_tpu.parallel.mesh import mesh_from_spec
+
+    mesh = mesh_from_spec({"seq": 4})
+    ms = get_model("bert_tiny", seq_parallel="ulysses")  # 2 heads, seq=4
+    with pytest.raises(ValueError, match="heads divisible"):
+        _runtime_from_modelspec(ms, TpuSpec(batch_buckets=[2], max_batch=2), mesh)
+
+
+def test_model_uri_deployments_forward_extra_params():
+    """Code-review r3: a CR using model_uri (not the model shorthand) still
+    forwards sibling parameters like seq_parallel/num_classes to the
+    builder; the uri's own query wins on conflict."""
+    from seldon_core_tpu.graph.spec import PredictiveUnit, TpuSpec
+    from seldon_core_tpu.models.zoo import make_jax_model_unit
+
+    unit_spec = PredictiveUnit.model_validate(
+        {
+            "name": "b",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "model_uri", "value": "zoo://bert_tiny?num_classes=7", "type": "STRING"},
+                {"name": "num_classes", "value": "3", "type": "INT"},  # uri wins
+                {"name": "vocab", "value": "64", "type": "INT"},
+            ],
+        }
+    )
+    unit = make_jax_model_unit(
+        unit_spec, {"tpu": TpuSpec(batch_buckets=[2], max_batch=2)}
+    )
+    assert unit.runtime.params["head"]["w"].shape[1] == 7  # uri query won
+    assert unit.runtime.params["tok_emb"].shape[0] == 64  # sibling param reached

@@ -423,3 +423,36 @@ async def test_platform_applied_cr_serves_sharded_and_ticks_feedback():
     exported = metrics.export().decode()
     assert 'seldon_api_model_feedback_total{' in exported
     assert 'model_name="ab"' in exported
+
+
+async def test_profiler_admin_endpoints(tmp_path):
+    """SURVEY §5.1 jax.profiler hooks: start/stop device tracing via the
+    admin surface; double-start and stop-without-start are clean 409s."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.operator.api import add_operator_routes
+
+    app = web.Application()
+    add_operator_routes(app, DeploymentManager())
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        out_dir = str(tmp_path / "prof")
+        r = await client.post(f"/profiler/start?dir={out_dir}")
+        assert r.status == 200 and (await r.json())["tracing"] == out_dir
+        r = await client.post("/profiler/start")
+        assert r.status == 409  # already tracing
+        import jax
+        import jax.numpy as jnp
+
+        float(jax.jit(lambda x: x * 2)(jnp.ones(8))[0])  # something to trace
+        r = await client.post("/profiler/stop")
+        assert r.status == 200 and (await r.json())["written"] == out_dir
+        r = await client.post("/profiler/stop")
+        assert r.status == 409  # not tracing
+        import glob as _glob
+
+        assert _glob.glob(f"{out_dir}/**/*", recursive=True)  # trace files exist
+    finally:
+        await client.close()
