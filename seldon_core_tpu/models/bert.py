@@ -297,8 +297,9 @@ def build_bert_base(
         # seq-parallel strategy is a deployment knob: a "seq" mesh axis plus
         # model parameter seq_parallel=ring|ulysses picks the collective;
         # num_heads lets ulysses reject undivisible meshes at BUILD time
+        # (derived by the SAME rule attention itself uses)
         apply_factory=partial(
-            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=768 // 64
+            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=_infer_heads(params)
         ),
         int_inputs="ids",
     )
@@ -335,7 +336,7 @@ def build_bert_tiny(
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         apply_factory=partial(
-            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=max(1, hidden // 64)
+            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=_infer_heads(params)
         ),
         int_inputs="ids",
     )

@@ -308,6 +308,7 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None, extra_params: dict | No
 
         from seldon_core_tpu.models.bert import (
             _bert_apply_factory,
+            _infer_heads,
             apply_bert,
             bert_pspecs,
         )
@@ -347,7 +348,7 @@ def build_runtime_from_uri(uri: str, tpu_cfg, mesh=None, extra_params: dict | No
             apply_factory=partial(
                 _bert_apply_factory,
                 seq_parallel=str(kwargs.get("seq_parallel", "ring")),
-                num_heads=max(1, params["tok_emb"].shape[1] // 64),
+                num_heads=_infer_heads(params),
             ),
             int_inputs="ids",
         )

@@ -107,6 +107,22 @@ def get_lib():
             ctypes.c_long,
             ctypes.POINTER(ctypes.c_float),
         ]
+        lib.http_parse_head.restype = ctypes.c_long
+        lib.http_parse_head.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),  # method_len
+            ctypes.POINTER(ctypes.c_long),  # path_off
+            ctypes.POINTER(ctypes.c_long),  # path_len
+            ctypes.POINTER(ctypes.c_longlong),  # content_length
+            ctypes.POINTER(ctypes.c_long),  # flags
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),  # ctype
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),  # auth
+        ]
         _lib = lib
         return _lib
 
@@ -191,3 +207,81 @@ def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
     if rc != 0:
         raise ValueError(f"pad_rows: batch {n} exceeds bucket {bucket}")
     return out
+
+
+# HTTP head-parse flag bits (mirror fastcodec.cpp)
+HDRF_HAS_CTYPE = 1
+HDRF_CONN_CLOSE = 2
+HDRF_CHUNKED = 4
+HDRF_HAS_CLEN = 8
+
+
+class ParsedHead:
+    """One parsed HTTP/1.1 request head (C fast path)."""
+
+    __slots__ = ("body_start", "method", "path", "content_length", "flags",
+                 "content_type", "authorization")
+
+    def __init__(self, body_start, method, path, content_length, flags,
+                 content_type, authorization):
+        self.body_start = body_start
+        self.method = method
+        self.path = path
+        self.content_length = content_length  # -1 when header absent
+        self.flags = flags
+        self.content_type = content_type  # raw value or None
+        self.authorization = authorization  # raw value or None
+
+
+def parse_http_head(buf) -> "ParsedHead | int | None":
+    """Parse an HTTP/1.1 request head in one C pass.
+
+    Returns a ParsedHead, 0 when the head is incomplete (read more), -1
+    when malformed, or None when the native library is unavailable (caller
+    uses its Python parse)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = bytes(buf)
+    method_len = ctypes.c_long()
+    path_off, path_len = ctypes.c_long(), ctypes.c_long()
+    clen = ctypes.c_longlong()
+    flags = ctypes.c_long()
+    ctype_buf = ctypes.create_string_buffer(512)
+    ctype_len = ctypes.c_long()
+    auth_buf = ctypes.create_string_buffer(4096)
+    auth_len = ctypes.c_long()
+    rc = lib.http_parse_head(
+        raw, len(raw),
+        ctypes.byref(method_len),
+        ctypes.byref(path_off), ctypes.byref(path_len),
+        ctypes.byref(clen), ctypes.byref(flags),
+        ctype_buf, 512, ctypes.byref(ctype_len),
+        auth_buf, 4096, ctypes.byref(auth_len),
+    )
+    if rc == 0:
+        return 0
+    if rc < 0:
+        return -1
+    if ctype_len.value >= 512 or auth_len.value >= 4096:
+        # possible truncation (oversized JWTs etc.): a clipped credential
+        # would 401 on this path but pass the Python parse — hand the
+        # request to the uncapped Python parser instead
+        return None
+    return ParsedHead(
+        body_start=int(rc),
+        method=raw[: method_len.value].decode("latin-1"),
+        path=raw[path_off.value : path_off.value + path_len.value].decode("latin-1"),
+        content_length=int(clen.value),
+        flags=int(flags.value),
+        content_type=(
+            ctype_buf.raw[: ctype_len.value].decode("latin-1")
+            if ctype_len.value >= 0
+            else None
+        ),
+        authorization=(
+            auth_buf.raw[: auth_len.value].decode("latin-1")
+            if auth_len.value >= 0
+            else None
+        ),
+    )

@@ -310,3 +310,130 @@ int pad_rows_f32(const float *src, long n, long feat, long bucket,
 }
 
 } // extern "C"
+
+// ---------------------------------------------------------------------------
+// HTTP/1.1 request-head parser — the fast ingress's hot-path front half
+// (serving/fast_http.py). One pass over the buffer extracts everything the
+// data plane needs: method/path spans, Content-Length, the raw Content-Type
+// and Authorization values, and connection flags. Python keeps the full
+// header-dict parse as the fallback/semantic reference.
+
+extern "C" {
+
+enum {
+  HTTP_INCOMPLETE = 0,   // no \r\n\r\n yet — read more
+  HTTP_MALFORMED = -1,
+};
+
+enum {
+  HDRF_HAS_CTYPE = 1,
+  HDRF_CONN_CLOSE = 2,
+  HDRF_CHUNKED = 4,
+  HDRF_HAS_CLEN = 8,
+};
+
+static int ieq_n(const unsigned char *a, long n, const char *lit) {
+  for (long i = 0; i < n; i++) {
+    if (lit[i] == '\0') return 0;  // name longer than lit (embedded NUL safe)
+    unsigned char c = a[i];
+    if (c >= 'A' && c <= 'Z') c += 32;
+    if (c != (unsigned char)lit[i]) return 0;
+  }
+  return lit[n] == '\0';
+}
+
+// Returns the body-start offset (> 0), HTTP_INCOMPLETE, or HTTP_MALFORMED.
+// method/path are returned as (offset, length) into buf; header values are
+// copied verbatim (caller buffers; value truncated to cap, reported length
+// is the TRUNCATED length — caps are sized far above legal values).
+long http_parse_head(const unsigned char *buf, long n,
+                     long *method_len,
+                     long *path_off, long *path_len,
+                     long long *content_length, long *flags,
+                     unsigned char *ctype_buf, long ctype_cap, long *ctype_len,
+                     unsigned char *auth_buf, long auth_cap, long *auth_len) {
+  *flags = 0;
+  *content_length = -1;
+  *ctype_len = -1;
+  *auth_len = -1;
+  // find end of head
+  long head_end = -1;
+  for (long i = 0; i + 3 < n; i++) {
+    if (buf[i] == '\r' && buf[i + 1] == '\n' && buf[i + 2] == '\r' &&
+        buf[i + 3] == '\n') {
+      head_end = i;
+      break;
+    }
+  }
+  if (head_end < 0) return HTTP_INCOMPLETE;
+
+  // request line: METHOD SP PATH SP VERSION
+  long p = 0;
+  while (p < head_end && buf[p] != ' ') p++;
+  if (p == 0 || p >= head_end) return HTTP_MALFORMED;
+  *method_len = p;
+  long ps = p + 1;
+  long pe = ps;
+  // bound the path scan at the request line's own end: without this, a
+  // request line missing the HTTP version would swallow header bytes
+  while (pe < head_end && buf[pe] != ' ' && buf[pe] != '\r') pe++;
+  if (pe == ps || pe >= head_end || buf[pe] != ' ') return HTTP_MALFORMED;
+  *path_off = ps;
+  *path_len = pe - ps;
+  // skip to end of request line
+  long line = pe;
+  while (line + 1 < head_end && !(buf[line] == '\r' && buf[line + 1] == '\n'))
+    line++;
+  long pos = line + 2;  // first header line (or == head_end + something)
+
+  while (pos < head_end) {
+    long eol = pos;
+    while (eol + 1 <= head_end && !(buf[eol] == '\r' && buf[eol + 1] == '\n'))
+      eol++;
+    // header: NAME ':' OWS VALUE
+    long colon = pos;
+    while (colon < eol && buf[colon] != ':') colon++;
+    if (colon < eol) {
+      long name_len = colon - pos;
+      long vs = colon + 1;
+      while (vs < eol && (buf[vs] == ' ' || buf[vs] == '\t')) vs++;
+      long ve = eol;
+      while (ve > vs && (buf[ve - 1] == ' ' || buf[ve - 1] == '\t')) ve--;
+      const unsigned char *name = buf + pos;
+      if (ieq_n(name, name_len, "content-length")) {
+        long long v = 0;
+        int any = 0;
+        for (long i = vs; i < ve; i++) {
+          if (buf[i] < '0' || buf[i] > '9') return HTTP_MALFORMED;
+          if (v > (1LL << 53)) return HTTP_MALFORMED;  // overflow guard:
+          // a 20-digit length would wrap signed 64-bit (UB) and smuggle
+          // body bytes into the next pipelined request
+          v = v * 10 + (buf[i] - '0');
+          any = 1;
+        }
+        if (!any) return HTTP_MALFORMED;
+        *content_length = v;
+        *flags |= HDRF_HAS_CLEN;
+      } else if (ieq_n(name, name_len, "content-type")) {
+        *flags |= HDRF_HAS_CTYPE;
+        long len = ve - vs;
+        if (len > ctype_cap) len = ctype_cap;
+        memcpy(ctype_buf, buf + vs, (size_t)len);
+        *ctype_len = len;
+      } else if (ieq_n(name, name_len, "authorization")) {
+        long len = ve - vs;
+        if (len > auth_cap) len = auth_cap;
+        memcpy(auth_buf, buf + vs, (size_t)len);
+        *auth_len = len;
+      } else if (ieq_n(name, name_len, "connection")) {
+        if (ve - vs == 5 && ieq_n(buf + vs, 5, "close")) *flags |= HDRF_CONN_CLOSE;
+      } else if (ieq_n(name, name_len, "transfer-encoding")) {
+        if (ve - vs == 7 && ieq_n(buf + vs, 7, "chunked")) *flags |= HDRF_CHUNKED;
+      }
+    }
+    pos = eol + 2;
+  }
+  return head_end + 4;
+}
+
+} // extern "C"

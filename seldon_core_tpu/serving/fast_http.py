@@ -60,6 +60,9 @@ class HttpProtocol(asyncio.Protocol):
         self._buf = bytearray()
         self._busy = False
         self._closing = False
+        # head parsed, body still streaming in: cache the parse so large
+        # uploads don't re-parse (or re-copy) the buffer per TCP chunk
+        self._pending_head = None
 
     # ------------------------------------------------------------- plumbing
     def connection_made(self, transport: asyncio.BaseTransport) -> None:
@@ -76,7 +79,84 @@ class HttpProtocol(asyncio.Protocol):
 
     # -------------------------------------------------------------- parsing
     def _try_dispatch(self) -> None:
-        """Parse one complete request from the buffer and run its handler."""
+        """Parse one complete request from the buffer and run its handler.
+        The C head parser (native/fastcodec.cpp http_parse_head) handles the
+        hot path in one pass; the Python parse below stays as the fallback
+        and the semantic reference."""
+        from seldon_core_tpu import native
+
+        if self._pending_head is not None:
+            # head already parsed — only waiting on body bytes
+            self._dispatch_parsed(self._pending_head)
+            return
+        # only the head region crosses into C: copying the whole buffer
+        # would make chunked large-body uploads O(n^2) in memcpy
+        parsed = native.parse_http_head(
+            bytes(self._buf[: _MAX_HEADER + 4])
+        )
+        if parsed is not None:
+            self._dispatch_parsed(parsed)
+            return
+        self._try_dispatch_py()
+
+    def _dispatch_parsed(self, parsed) -> None:
+        from seldon_core_tpu import native
+
+        buf = self._buf
+        if parsed == 0:
+            if len(buf) > _MAX_HEADER:
+                self._respond_simple(400, b"header too large")
+                self._close()
+            return
+        if parsed == -1:
+            self._respond_simple(400, b"bad request")
+            self._close()
+            return
+        flags = parsed.flags
+        method = parsed.method
+        if flags & native.HDRF_HAS_CLEN:
+            clen = parsed.content_length
+        elif method in ("GET", "HEAD", "DELETE"):
+            clen = 0
+        else:
+            self._respond_simple(411, b"Content-Length required")
+            self._close()
+            return
+        if flags & native.HDRF_CHUNKED:
+            self._respond_simple(411, b"chunked bodies not supported")
+            self._close()
+            return
+        if clen > _MAX_BODY:
+            self._respond_simple(413, b"body too large")
+            self._close()
+            return
+        if len(buf) - parsed.body_start < clen:
+            self._pending_head = parsed  # wait for the body; parse once
+            return
+        self._pending_head = None
+        body = bytes(buf[parsed.body_start : parsed.body_start + clen])
+        del buf[: parsed.body_start + clen]
+
+        headers: dict[str, str] = {}
+        if parsed.content_type is not None:
+            headers["content-type"] = parsed.content_type
+        if parsed.authorization is not None:
+            headers["authorization"] = parsed.authorization
+        path = parsed.path.split("?", 1)[0]
+        req = WireRequest(
+            method=method,
+            path=path,
+            headers=headers,
+            body=body,
+            declared_ctype=bool(flags & native.HDRF_HAS_CTYPE),
+        )
+        handler = self._routes.get((method, path))
+        keep_alive = not (flags & native.HDRF_CONN_CLOSE)
+        self._busy = True
+        task = asyncio.ensure_future(self._run(handler, req, keep_alive))
+        task.add_done_callback(self._on_handler_done)
+
+    def _try_dispatch_py(self) -> None:
         buf = self._buf
         head_end = buf.find(b"\r\n\r\n")
         if head_end < 0:

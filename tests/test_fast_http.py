@@ -437,3 +437,27 @@ async def test_platform_fast_ingress_with_admin_port():
             platform._fast_server.close()
             await platform._fast_server.wait_closed()
         await runner.cleanup()
+
+
+async def test_fast_server_python_fallback_parse_agrees(monkeypatch):
+    """The Python head parse (fallback when the C lib is absent) serves the
+    same requests as the native path."""
+    from seldon_core_tpu import native
+
+    monkeypatch.setattr(native, "parse_http_head", lambda buf: None)
+    server, port = await _fast_engine()
+    try:
+        st, hd, body = await _http(
+            port,
+            "POST",
+            "/api/v0.1/predictions",
+            json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        assert st == 200
+        assert json.loads(body)["data"]["ndarray"]
+        st, _, _ = await _http(port, "GET", "/ready")
+        assert st == 200
+    finally:
+        server.close()
+        await server.wait_closed()

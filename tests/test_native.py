@@ -189,3 +189,74 @@ def test_fast_decode_prefers_tensor_like_oracle():
     slow = message_from_json(raw)
     np.testing.assert_array_equal(fast.array, slow.array)
     assert fast.data.kind == slow.data.kind == DataKind.TENSOR
+
+
+def test_http_parse_head_fields_and_edges():
+    """C HTTP head parser: fields, flags, incomplete/malformed signals."""
+    from seldon_core_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native lib")
+    req = (
+        b"POST /api/v0.1/predictions?x=1 HTTP/1.1\r\n"
+        b"Host: h\r\n"
+        b"Content-Type: multipart/form-data; boundary=abc\r\n"
+        b"AUTHORIZATION: Bearer tok\r\n"
+        b"Connection: close\r\n"
+        b"Content-Length: 3\r\n\r\nxyz"
+    )
+    h = native.parse_http_head(req)
+    assert h.method == "POST" and h.path == "/api/v0.1/predictions?x=1"
+    assert h.content_length == 3
+    assert h.content_type == "multipart/form-data; boundary=abc"  # raw, params kept
+    assert h.authorization == "Bearer tok"  # case-insensitive header name
+    assert h.flags & native.HDRF_HAS_CTYPE
+    assert h.flags & native.HDRF_CONN_CLOSE
+    assert h.flags & native.HDRF_HAS_CLEN
+    assert req[h.body_start:] == b"xyz"
+
+    assert native.parse_http_head(req[:25]) == 0  # incomplete
+    assert native.parse_http_head(b"NOSPACES\r\n\r\n") == -1  # malformed
+    assert native.parse_http_head(b"GET /p HTTP/1.1\r\nContent-Length: 1x\r\n\r\n") == -1
+
+    # no content-length header: HAS_CLEN unset, length reported -1
+    h2 = native.parse_http_head(b"GET /ready HTTP/1.1\r\nHost: h\r\n\r\n")
+    assert not (h2.flags & native.HDRF_HAS_CLEN) and h2.content_length == -1
+
+    # chunked flag
+    h3 = native.parse_http_head(
+        b"POST /p HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+    )
+    assert h3.flags & native.HDRF_CHUNKED
+
+
+def test_http_parse_head_hardening():
+    """Code-review r3 security findings: content-length overflow rejected,
+    missing-version request line rejected, embedded-NUL header names safe,
+    oversized auth values defer to the Python parser."""
+    from seldon_core_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native lib")
+    # 20-digit length would wrap int64 and smuggle body bytes
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nContent-Length: 18446744073709551620\r\n\r\n"
+        )
+        == -1
+    )
+    # request line without an HTTP version must not swallow header bytes
+    assert native.parse_http_head(b"GET /p\r\nContent-Length: 5\r\n\r\nhello") == -1
+    # embedded NUL in a header name: parses without OOB, not treated as clen
+    h = native.parse_http_head(
+        b"GET /p HTTP/1.1\r\ncontent-length\x00x: 3\r\n\r\n"
+    )
+    assert h is not None and h != -1 and not (h.flags & native.HDRF_HAS_CLEN)
+    # >4KB authorization: C path declines (None) so Python handles it uncapped
+    big = b"Bearer " + b"a" * 5000
+    req = b"GET /p HTTP/1.1\r\nAuthorization: " + big + b"\r\n\r\n"
+    assert native.parse_http_head(req) is None
