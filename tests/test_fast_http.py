@@ -68,6 +68,21 @@ async def _http(port: int, method: str, path: str, body: bytes = b"", headers=No
         writer.close()
 
 
+async def _read_response(reader):
+    """Read one HTTP/1.1 response from a raw stream: (status, body)."""
+    status_line = await reader.readline()
+    status = int(status_line.split(b" ")[1])
+    clen = 0
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b""):
+            break
+        if line.lower().startswith(b"content-length"):
+            clen = int(line.split(b":")[1])
+    body = await reader.readexactly(clen) if clen else b""
+    return status, body
+
+
 async def test_fast_engine_predictions_json_and_health():
     server, port = await _fast_engine()
     try:
@@ -161,16 +176,8 @@ async def test_fast_server_keepalive_sequences_requests():
         writer.write(req * 3)  # pipelined burst: must still answer all, in order
         await writer.drain()
         for _ in range(3):
-            status_line = await reader.readline()
-            assert b"200" in status_line
-            clen = 0
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b""):
-                    break
-                if line.lower().startswith(b"content-length"):
-                    clen = int(line.split(b":")[1])
-            resp = await reader.readexactly(clen)
+            status, resp = await _read_response(reader)
+            assert status == 200
             assert json.loads(resp)["data"]["ndarray"]
         writer.close()
     finally:
@@ -458,6 +465,58 @@ async def test_fast_server_python_fallback_parse_agrees(monkeypatch):
         assert json.loads(body)["data"]["ndarray"]
         st, _, _ = await _http(port, "GET", "/ready")
         assert st == 200
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_server_fragmented_writes_and_concurrency():
+    """Torture the parser: many concurrent connections, each dribbling its
+    request in tiny fragments (head split mid-header, body split mid-way) —
+    every request must still answer correctly, in order, per connection."""
+    server, port = await _fast_engine()
+
+    async def one_client(i: int) -> None:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        try:
+            body = json.dumps({"data": {"ndarray": [[float(i), 2.0, 3.0]]}}).encode()
+            req = (
+                f"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+                f"Content-Type: application/json\r\nContent-Length: {len(body)}\r\n\r\n"
+            ).encode() + body
+            for _ in range(2):  # two sequential requests per conn
+                step = 7 + i % 5
+                for off in range(0, len(req), step):
+                    writer.write(req[off : off + step])
+                    await writer.drain()
+                    await asyncio.sleep(0)  # let the server parse fragments
+                status, resp = await _read_response(reader)
+                assert status == 200
+                assert json.loads(resp)["data"]["ndarray"]
+        finally:
+            writer.close()
+
+    try:
+        await asyncio.gather(*(one_client(i) for i in range(16)))
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_fast_server_handler_exception_is_500_json():
+    """A handler that RAISES (outside the wire-core catch) still answers
+    with a JSON 500, never a hung connection."""
+    from seldon_core_tpu.serving.wire import WireRequest
+
+    async def boom(req: WireRequest):
+        raise RuntimeError("handler blew up")
+
+    port = free_port()
+    server = await start_fast_server({("POST", "/x"): boom}, "127.0.0.1", port)
+    try:
+        st, hd, body = await _http(port, "POST", "/x", b"{}", {"Content-Type": "application/json"})
+        assert st == 500
+        assert json.loads(body)["status"] == "FAILURE"
     finally:
         server.close()
         await server.wait_closed()
