@@ -4,7 +4,7 @@ Parity: reference in-engine implementations (SURVEY C5) —
 SimpleModelUnit.java (constant logits test stub), SimpleRouterUnit.java
 (always child 0), RandomABTestUnit.java (seeded A/B split, param ``ratioA``,
 seed 1337), AverageCombinerUnit.java (element-wise mean ensemble) — plus two
-TPU-native additions: EPSILON_GREedy bandit router (BASELINE full-DAG config)
+TPU-native additions: EPSILON_GREEDY bandit router (BASELINE full-DAG config)
 and JAX_MODEL (a model-zoo model resident in HBM).
 
 The AverageCombiner is where TPU-first pays: in the reference an N-model
@@ -161,6 +161,32 @@ class RandomABTestUnit(Unit):
         with self._lock:
             draw = self._rng.random()
         return 0 if draw < self.ratio_a else 1
+
+
+class ShadowRouterUnit(Unit):
+    """Traffic shadowing (TPU-native addition; no reference analogue):
+    child 0 is the PRIMARY and serves the response; every other child is a
+    SHADOW that receives a COPY of the same input fire-and-forget — its
+    latency and failures never touch the caller, but its unit timers
+    (prometheus) tick, so a candidate model can be validated under real
+    production traffic before an A/B test sends it live requests. Routing
+    records branch 0, so feedback replays down the primary only. The detached fan-out itself
+    lives in the executor (GraphExecutor._spawn_shadow), keyed off
+    ``shadow_fanout``."""
+
+    shadow_fanout = True
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        if len(spec.children) < 2:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_ROUTING,
+                f"SHADOW '{self.name}' needs >= 2 children "
+                f"(primary + shadows), has {len(spec.children)}",
+            )
+
+    async def route(self, msg: SeldonMessage) -> int:
+        return 0  # the primary; shadows are mirrored by the executor
 
 
 class EpsilonGreedyRouter(Unit):
@@ -404,6 +430,9 @@ def register_builtins(registry: UnitRegistry) -> None:
     )
     registry.register(
         PredictiveUnitImplementation.PYTHON_CLASS, make_python_class_unit
+    )
+    registry.register(
+        PredictiveUnitImplementation.SHADOW, lambda spec, ctx: ShadowRouterUnit(spec)
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

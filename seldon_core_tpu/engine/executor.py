@@ -22,10 +22,13 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import time
 from typing import Any, Callable, Sequence
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
@@ -100,6 +103,9 @@ class GraphExecutor:
         # (SeldonRestTemplateExchangeTagsProvider); here calls are in-process
         # but the observability contract survives
         self._unit_hook = unit_call_hook
+        # in-flight SHADOW mirror walks (fire-and-forget by design; tracked
+        # so tests/shutdown can drain them)
+        self._shadow_tasks: set = set()
 
     def units(self):
         """All runtime units in the graph, pre-order (used by persistence,
@@ -201,6 +207,52 @@ class GraphExecutor:
         if not node.children:
             return msgs
 
+        if getattr(unit, "shadow_fanout", False):
+            # batch twin of the shadow path in _get_output: route each
+            # message to its primary (same per-request route semantics and
+            # 'route' timer as the single path), serve the primary groups,
+            # and mirror every message to each child that is NOT its primary
+            branches = []
+            for m in msgs:
+                b = await self._timed(node, "route", unit.route(m), spans)
+                b = 0 if b == ROUTE_ALL else b
+                if not (0 <= b < len(node.children)):
+                    raise APIException(
+                        ErrorCode.ENGINE_INVALID_ROUTING,
+                        f"unit '{node.name}' routed to {b} with {len(node.children)} children",
+                    )
+                branches.append(b)
+            for i, child in enumerate(node.children):
+                mirror = [m for m, b in zip(msgs, branches) if b != i]
+                if mirror:
+                    self._spawn_shadow(child, mirror)
+            msgs = [
+                m.with_meta(m.meta.merged_with(Meta(routing={node.name: b})))
+                for m, b in zip(msgs, branches)
+            ]
+            groups: dict[int, list[int]] = {}
+            for idx, b in enumerate(branches):
+                groups.setdefault(b, []).append(idx)
+
+            async def _run_primary(b: int, idxs: list[int]):
+                outs = await self._get_output_many(
+                    node.children[b], [msgs[i] for i in idxs], spans
+                )
+                return idxs, outs
+
+            results: list[SeldonMessage | None] = [None] * len(msgs)
+            for idxs, outs in await _gather_settled(
+                *(_run_primary(b, idxs) for b, idxs in groups.items())
+            ):
+                for i, o in zip(idxs, outs):
+                    results[i] = o
+            out_msgs = results  # type: ignore[assignment]
+            if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
+                out_msgs = await self._merged_call(
+                    node, "transform_output", unit.transform_output, out_msgs, spans
+                )
+            return out_msgs
+
         if _has_method(node, PredictiveUnitMethod.ROUTE):
             branches = []
             for m in msgs:
@@ -294,6 +346,50 @@ class GraphExecutor:
                     {"unit": node.name, "method": method, "ms": round(dt * 1e3, 3)}
                 )
 
+    @staticmethod
+    def _shadow_copy(msg: SeldonMessage) -> SeldonMessage:
+        """Defensive payload copy for a mirror walk: shadows exist to run
+        UNVETTED candidates, and an in-place-mutating candidate must not
+        corrupt the array the primary is about to serve from."""
+        if msg.data is not None and msg.data.array is not None:
+            return msg.with_array(np.array(np.asarray(msg.array)), msg.names)
+        return msg  # bytes/str payloads are immutable
+
+    def _spawn_shadow(self, child: Node, payload) -> None:
+        """Detached mirror walk of ``child`` (SHADOW fan-out): failures log,
+        never propagate — the shadow candidate's behavior must not affect
+        the response its primary already owns."""
+        if isinstance(payload, list):
+            payload = [self._shadow_copy(m) for m in payload]
+        else:
+            payload = self._shadow_copy(payload)
+
+        async def _run() -> None:
+            try:
+                if isinstance(payload, list):
+                    await self._get_output_many(child, payload, None)
+                else:
+                    await self._get_output(child, payload, None)
+            except Exception as e:  # noqa: BLE001 - shadow failures are data, not errors
+                log.warning("shadow child '%s' failed: %s", child.name, e)
+
+        task = asyncio.ensure_future(_run())
+        self._shadow_tasks.add(task)
+        task.add_done_callback(self._shadow_tasks.discard)
+
+    async def drain_shadows(self) -> None:
+        """Await in-flight shadow walks (tests / graceful shutdown).
+
+        The set is drained explicitly: a task can be FINISHED while its
+        done-callback (the set discard) is still queued on the loop, and
+        awaiting a gather of already-done tasks does not yield — relying on
+        the callback alone would busy-spin forever."""
+        while self._shadow_tasks:
+            pending = list(self._shadow_tasks)
+            await asyncio.gather(*pending, return_exceptions=True)
+            self._shadow_tasks.difference_update(pending)
+            await asyncio.sleep(0)  # let queued done-callbacks run
+
     async def _get_output(
         self, node: Node, msg: SeldonMessage, spans: list | None = None
     ) -> SeldonMessage:
@@ -326,7 +422,22 @@ class GraphExecutor:
                 msg.meta.merged_with(Meta(routing={node.name: branch}))
             )
 
-        if branch == ROUTE_ALL:
+        if getattr(unit, "shadow_fanout", False):
+            # SHADOW semantics: serve the routed (primary) child; mirror a
+            # COPY of the input to every other child fire-and-forget —
+            # their latency and failures never touch the response, but
+            # their unit TIMERS (unit_call_hook -> prometheus) still tick,
+            # which is the point: validate a candidate under production
+            # traffic. (Request trace spans cover the primary only — the
+            # response has shipped before a shadow finishes.) Deliberately
+            # detached (the one exception to settle-before-raise): a slow
+            # shadow must not hold the primary's response.
+            primary = 0 if branch == ROUTE_ALL else branch
+            for i, child in enumerate(node.children):
+                if i != primary:
+                    self._spawn_shadow(child, msg)
+            targets = [node.children[primary]]
+        elif branch == ROUTE_ALL:
             targets = node.children
         else:
             targets = [node.children[branch]]

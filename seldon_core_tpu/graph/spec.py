@@ -48,6 +48,9 @@ class PredictiveUnitImplementation(str, enum.Enum):
     PYTHON_CLASS = "PYTHON_CLASS"  # duck-typed user class loaded in-process
     # from params module/model_dir (single-host platform mode; the reference
     # always puts user classes behind a container endpoint)
+    SHADOW = "SHADOW"  # serve child 0, mirror traffic to the other children
+    # fire-and-forget (candidate validation under production load; their
+    # latency/failures never touch the response, their metrics still tick)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -262,5 +265,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.FAULT_INJECTOR,
         PredictiveUnitImplementation.OUTLIER_DETECTOR,
         PredictiveUnitImplementation.PYTHON_CLASS,
+        PredictiveUnitImplementation.SHADOW,
     }
 )

@@ -135,6 +135,10 @@ class PredictorServer:
         await asyncio.sleep(0)
         if self.batcher is not None:
             await self.batcher.close()
+        # let in-flight SHADOW mirror walks finish BEFORE closing the remote
+        # channels/session they may still be using — the shutdown window's
+        # candidate-validation traffic must not be lost or error spuriously
+        await self.executor.drain_shadows()
         if self._grpc_server is not None:
             await self._grpc_server.stop(GRACE_DRAIN_S)
         if self._fast_server is not None:

@@ -437,3 +437,115 @@ async def test_failing_branch_waits_for_siblings_to_settle():
         await ex.execute(req)
     # the slow sibling finished BEFORE the error surfaced, not detached
     assert state["slow_done"] is True
+
+
+async def test_shadow_router_mirrors_without_blocking():
+    """SHADOW: child 0 serves the response; other children get the same
+    input fire-and-forget — slow or FAILING shadows never touch the caller,
+    but they do run (validated after drain)."""
+    import asyncio as _asyncio
+
+    from seldon_core_tpu.engine.units import PythonClassUnit
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "sh",
+                "type": "ROUTER",
+                "implementation": "SHADOW",
+                "children": [
+                    {"name": "primary", "type": "MODEL"},
+                    {"name": "cand", "type": "MODEL"},
+                    {"name": "broken", "type": "MODEL"},
+                ],
+            },
+        }
+    )
+    seen = {"cand": 0, "broken": 0}
+
+    class Primary:
+        def predict(self, X, names):
+            return X * 10.0
+
+    class Candidate:
+        def predict(self, X, names):
+            seen["cand"] += 1
+            return X * 99.0  # must NEVER reach the caller
+
+    class Broken:
+        def predict(self, X, names):
+            seen["broken"] += 1
+            raise RuntimeError("candidate blew up")
+
+    units = {
+        "primary": PythonClassUnit(pred.graph.children[0], Primary()),
+        "cand": PythonClassUnit(pred.graph.children[1], Candidate()),
+        "broken": PythonClassUnit(pred.graph.children[2], Broken()),
+    }
+    ex = build_executor(pred, context={"units": units})
+    req = SeldonMessage.from_array(np.ones((1, 4), np.float32))
+    out = await ex.execute(req)
+    np.testing.assert_allclose(np.asarray(out.array), np.full((1, 4), 10.0))
+    assert out.meta.routing == {"sh": 0}  # feedback follows the primary
+    await ex.drain_shadows()
+    assert seen["cand"] == 1 and seen["broken"] == 1  # shadows DID run
+
+    # batch path: split-batch walk mirrors the merged batch once per shadow
+    msgs = [SeldonMessage.from_array(np.ones((1, 4), np.float32)) for _ in range(4)]
+    outs = await ex.execute_many(msgs)
+    for o in outs:
+        np.testing.assert_allclose(np.asarray(o.array), np.full((1, 4), 10.0))
+        assert o.meta.routing == {"sh": 0}
+    await ex.drain_shadows()
+    assert seen["cand"] == 2 and seen["broken"] == 2
+
+
+def test_shadow_requires_two_children():
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "sh",
+                "type": "ROUTER",
+                "implementation": "SHADOW",
+                "children": [{"name": "only", "type": "MODEL", "implementation": "SIMPLE_MODEL"}],
+            },
+        }
+    )
+    with pytest.raises(Exception, match="SHADOW"):
+        build_executor(pred)
+
+
+async def test_drain_shadows_with_already_finished_task():
+    """Regression (found by live drive): a shadow task can FINISH while its
+    set-discard callback is still queued; drain_shadows must not busy-spin
+    on the stale set entry."""
+    import asyncio as _asyncio
+
+    from seldon_core_tpu.engine.units import PythonClassUnit
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "sh",
+                "type": "ROUTER",
+                "implementation": "SHADOW",
+                "children": [
+                    {"name": "primary", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "cand", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+    ex = build_executor(pred)
+    await ex.execute(SeldonMessage.from_array(np.ones((1, 4), np.float32)))
+    # let the (instant) shadow finish but NOT its done-callback cleanup race
+    # matter: drain must terminate promptly either way
+    await _asyncio.wait_for(ex.drain_shadows(), timeout=5)
+    assert not ex._shadow_tasks
