@@ -95,6 +95,7 @@ class GraphExecutor:
         root: Node,
         feedback_metrics_hook: Callable[[str, float], None] | None = None,
         unit_call_hook: Callable[[str, str, float], None] | None = None,
+        shadow_compare_hook: Callable[[str, bool], None] | None = None,
     ):
         self.root = root
         self._feedback_hook = feedback_metrics_hook
@@ -106,6 +107,10 @@ class GraphExecutor:
         # in-flight SHADOW mirror walks (fire-and-forget by design; tracked
         # so tests/shutdown can drain them)
         self._shadow_tasks: set = set()
+        # (shadow_unit_name, agree: bool) per mirrored prediction — feeds
+        # seldon_tpu_shadow_comparisons so a candidate's agreement rate with
+        # production is a dashboard number, not a log-diving exercise
+        self._shadow_hook = shadow_compare_hook
 
     def units(self):
         """All runtime units in the graph, pre-order (used by persistence,
@@ -207,62 +212,30 @@ class GraphExecutor:
         if not node.children:
             return msgs
 
-        if getattr(unit, "shadow_fanout", False):
-            # batch twin of the shadow path in _get_output: route each
-            # message to its primary (same per-request route semantics and
-            # 'route' timer as the single path), serve the primary groups,
-            # and mirror every message to each child that is NOT its primary
-            branches = []
-            for m in msgs:
-                b = await self._timed(node, "route", unit.route(m), spans)
-                b = 0 if b == ROUTE_ALL else b
-                if not (0 <= b < len(node.children)):
-                    raise APIException(
-                        ErrorCode.ENGINE_INVALID_ROUTING,
-                        f"unit '{node.name}' routed to {b} with {len(node.children)} children",
-                    )
-                branches.append(b)
-            for i, child in enumerate(node.children):
-                mirror = [m for m, b in zip(msgs, branches) if b != i]
-                if mirror:
-                    self._spawn_shadow(child, mirror)
-            msgs = [
-                m.with_meta(m.meta.merged_with(Meta(routing={node.name: b})))
-                for m, b in zip(msgs, branches)
-            ]
-            groups: dict[int, list[int]] = {}
-            for idx, b in enumerate(branches):
-                groups.setdefault(b, []).append(idx)
-
-            async def _run_primary(b: int, idxs: list[int]):
-                outs = await self._get_output_many(
-                    node.children[b], [msgs[i] for i in idxs], spans
-                )
-                return idxs, outs
-
-            results: list[SeldonMessage | None] = [None] * len(msgs)
-            for idxs, outs in await _gather_settled(
-                *(_run_primary(b, idxs) for b, idxs in groups.items())
-            ):
-                for i, o in zip(idxs, outs):
-                    results[i] = o
-            out_msgs = results  # type: ignore[assignment]
-            if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
-                out_msgs = await self._merged_call(
-                    node, "transform_output", unit.transform_output, out_msgs, spans
-                )
-            return out_msgs
-
+        shadow = getattr(unit, "shadow_fanout", False)
         if _has_method(node, PredictiveUnitMethod.ROUTE):
             branches = []
             for m in msgs:
                 b = await self._timed(node, "route", unit.route(m), spans)
+                if shadow and b == ROUTE_ALL:
+                    b = 0  # shadow default primary (matches the single path)
                 if b != ROUTE_ALL and not (0 <= b < len(node.children)):
                     raise APIException(
                         ErrorCode.ENGINE_INVALID_ROUTING,
                         f"unit '{node.name}' routed to {b} with {len(node.children)} children",
                     )
                 branches.append(b)
+            shadow_spawned = []
+            if shadow:
+                # mirror every message to each child that is NOT its
+                # primary, detached (same SHADOW semantics as _get_output)
+                for i, child in enumerate(node.children):
+                    mirror_idxs = [j for j, b in enumerate(branches) if b != i]
+                    if mirror_idxs:
+                        task = self._spawn_shadow(
+                            child, [msgs[j] for j in mirror_idxs]
+                        )
+                        shadow_spawned.append((child.name, task, mirror_idxs))
             msgs = [
                 m.with_meta(m.meta.merged_with(Meta(routing={node.name: b})))
                 for m, b in zip(msgs, branches)
@@ -288,6 +261,11 @@ class GraphExecutor:
             ):
                 for i, o in zip(idxs, outs):
                     results[i] = o
+            for shadow_name, task, mirror_idxs in shadow_spawned:
+                # primaries exist now: compare each mirror when it finishes
+                self._attach_compare(
+                    shadow_name, task, [results[j] for j in mirror_idxs]
+                )
             out_msgs = results  # type: ignore[assignment]
         else:
             out_msgs = await self._fanout_many(node, msgs, spans)
@@ -350,32 +328,92 @@ class GraphExecutor:
     def _shadow_copy(msg: SeldonMessage) -> SeldonMessage:
         """Defensive payload copy for a mirror walk: shadows exist to run
         UNVETTED candidates, and an in-place-mutating candidate must not
-        corrupt the array the primary is about to serve from."""
+        corrupt the payload the primary is about to serve from."""
         if msg.data is not None and msg.data.array is not None:
             return msg.with_array(np.array(np.asarray(msg.array)), msg.names)
+        if msg.json_data is not None:
+            # json payloads are mutable dicts/lists — deep-copy them too
+            import copy
+
+            return msg._copy(
+                None, None, None, copy.deepcopy(msg.json_data), msg.meta, msg.status
+            )
         return msg  # bytes/str payloads are immutable
 
-    def _spawn_shadow(self, child: Node, payload) -> None:
+    def _spawn_shadow(self, child: Node, payload) -> asyncio.Task:
         """Detached mirror walk of ``child`` (SHADOW fan-out): failures log,
         never propagate — the shadow candidate's behavior must not affect
-        the response its primary already owns."""
+        the response its primary already owns. Returns the task so the
+        caller can attach the agreement comparison once the primary's own
+        output exists."""
         if isinstance(payload, list):
             payload = [self._shadow_copy(m) for m in payload]
         else:
             payload = self._shadow_copy(payload)
 
-        async def _run() -> None:
+        async def _run():
             try:
                 if isinstance(payload, list):
-                    await self._get_output_many(child, payload, None)
-                else:
-                    await self._get_output(child, payload, None)
+                    return await self._get_output_many(child, payload, None)
+                return await self._get_output(child, payload, None)
             except Exception as e:  # noqa: BLE001 - shadow failures are data, not errors
                 log.warning("shadow child '%s' failed: %s", child.name, e)
+                return None
 
         task = asyncio.ensure_future(_run())
         self._shadow_tasks.add(task)
         task.add_done_callback(self._shadow_tasks.discard)
+        return task
+
+    @staticmethod
+    def _outputs_agree(primary: SeldonMessage | None, shadow: SeldonMessage | None):
+        """Did the shadow candidate make the same call as the primary?
+        Classifier outputs compare by rowwise argmax (the serving decision);
+        other tensors by tolerant allclose; bytes/str/json payloads by
+        equality. A failed shadow (None) or a payload-KIND mismatch is a
+        disagreement — a candidate that errors or answers in a different
+        form where production serves is exactly what shadowing surfaces."""
+        if primary is None or shadow is None:
+            return False
+        if primary.array is not None and shadow.array is not None:
+            x, y = np.asarray(primary.array), np.asarray(shadow.array)
+            if x.shape != y.shape:
+                return False
+            if x.ndim >= 2 and x.shape[-1] > 1:
+                return bool(np.array_equal(np.argmax(x, -1), np.argmax(y, -1)))
+            return bool(np.allclose(x, y, rtol=1e-3, atol=1e-5))
+        if primary.array is not None or shadow.array is not None:
+            return False  # tensor vs non-tensor: different kinds
+        # non-tensor arms: exact equality (the oneof keeps at most one set)
+        return bool(
+            primary.bin_data == shadow.bin_data
+            and primary.str_data == shadow.str_data
+            and primary.json_data == shadow.json_data
+        )
+
+    def _attach_compare(self, shadow_name: str, task: asyncio.Task, primary_out) -> None:
+        """When the shadow finishes, compare its output against the primary's
+        (already-served) output and tick the agreement counter. primary_out:
+        a SeldonMessage, or (for the batch path) a list aligned with the
+        mirror payload."""
+        if self._shadow_hook is None:
+            return
+
+        def _done(t: asyncio.Task) -> None:
+            if t.cancelled():
+                return
+            out = t.result()
+            try:
+                if isinstance(primary_out, list):
+                    shadows = out if isinstance(out, list) else [None] * len(primary_out)
+                    for p, s in zip(primary_out, shadows):
+                        self._shadow_hook(shadow_name, self._outputs_agree(p, s))
+                else:
+                    self._shadow_hook(shadow_name, self._outputs_agree(primary_out, out))
+            except Exception as e:  # noqa: BLE001 - metrics must not break serving
+                log.warning("shadow comparison for '%s' failed: %s", shadow_name, e)
+
+        task.add_done_callback(_done)
 
     async def drain_shadows(self) -> None:
         """Await in-flight shadow walks (tests / graceful shutdown).
@@ -389,6 +427,10 @@ class GraphExecutor:
             await asyncio.gather(*pending, return_exceptions=True)
             self._shadow_tasks.difference_update(pending)
             await asyncio.sleep(0)  # let queued done-callbacks run
+        # shadows that finished BEFORE their comparison was attached leave
+        # the agreement callback queued on the loop even with an empty set —
+        # one final yield flushes them so post-drain metrics are complete
+        await asyncio.sleep(0)
 
     async def _get_output(
         self, node: Node, msg: SeldonMessage, spans: list | None = None
@@ -433,9 +475,11 @@ class GraphExecutor:
             # detached (the one exception to settle-before-raise): a slow
             # shadow must not hold the primary's response.
             primary = 0 if branch == ROUTE_ALL else branch
-            for i, child in enumerate(node.children):
-                if i != primary:
-                    self._spawn_shadow(child, msg)
+            shadow_spawned = [
+                (child.name, self._spawn_shadow(child, msg))
+                for i, child in enumerate(node.children)
+                if i != primary
+            ]
             targets = [node.children[primary]]
         elif branch == ROUTE_ALL:
             targets = node.children
@@ -450,6 +494,12 @@ class GraphExecutor:
                     *(self._get_output(c, msg, spans) for c in targets)
                 )
             )
+
+        if getattr(unit, "shadow_fanout", False):
+            # the primary's output exists now: compare each mirror against
+            # it when the mirror finishes (agreement counter)
+            for shadow_name, task in shadow_spawned:
+                self._attach_compare(shadow_name, task, child_outputs[0])
 
         merged_meta = msg.meta
         for co in child_outputs:
@@ -567,6 +617,7 @@ def build_executor(
     context: dict[str, Any] | None = None,
     feedback_metrics_hook: Callable[[str, float], None] | None = None,
     unit_call_hook: Callable[[str, str, float], None] | None = None,
+    shadow_compare_hook: Callable[[str, bool], None] | None = None,
 ) -> GraphExecutor:
     registry = registry or default_registry()
     context = dict(context or {})
@@ -582,4 +633,5 @@ def build_executor(
         root,
         feedback_metrics_hook=feedback_metrics_hook,
         unit_call_hook=unit_call_hook,
+        shadow_compare_hook=shadow_compare_hook,
     )

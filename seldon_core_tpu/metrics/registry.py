@@ -57,6 +57,11 @@ class NullMetrics:
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
+    def shadow_compare(
+        self, deployment: str, predictor: str, shadow_unit: str, agree: bool
+    ) -> None:
+        pass
+
     def export(self) -> bytes:
         return b""
 
@@ -121,6 +126,14 @@ class Metrics(NullMetrics):
             registry=registry,
             buckets=(0.1, 0.5, 1, 5, 10, 30, 60, 120),
         )
+        # SHADOW router candidate validation: per-shadow-child prediction
+        # agreement with the primary (argmax match on classifier outputs)
+        self._shadow = Counter(
+            "seldon_tpu_shadow_comparisons",
+            "Shadow-vs-primary output comparisons",
+            ["deployment_name", "predictor_name", "shadow_unit", "agree"],
+            registry=registry,
+        )
 
     def ingress_request(self, deployment, method, duration_s):
         self._ingress.labels(deployment, method).observe(duration_s)
@@ -141,6 +154,11 @@ class Metrics(NullMetrics):
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)
+
+    def shadow_compare(self, deployment, predictor, shadow_unit, agree):
+        self._shadow.labels(
+            deployment, predictor, shadow_unit, "true" if agree else "false"
+        ).inc()
 
     def export(self) -> bytes:
         return generate_latest(self.registry)

@@ -274,12 +274,16 @@ class DeploymentManager:
         metrics = self.metrics
         unit_call_hook = None
         feedback_hook = None
+        shadow_hook = None
         if metrics is not None:
             def unit_call_hook(unit_name, method, duration_s):  # noqa: E306
                 metrics.unit_call(dep_name, predictor.name, unit_name, method, duration_s)
 
             def feedback_hook(unit_name, reward):  # noqa: E306
                 metrics.feedback(dep_name, predictor.name, unit_name, reward)
+
+            def shadow_hook(shadow_unit, agree):  # noqa: E306
+                metrics.shadow_compare(dep_name, predictor.name, shadow_unit, agree)
 
         # the CR's tpu.mesh governs sharding on EVERY path into the platform
         # (dir watcher, control API, k8s watcher, CLI), same as the standalone
@@ -294,6 +298,7 @@ class DeploymentManager:
             },
             feedback_metrics_hook=feedback_hook,
             unit_call_hook=unit_call_hook,
+            shadow_compare_hook=shadow_hook,
         )
         batcher = make_batcher(
             predictor.tpu,

@@ -64,11 +64,17 @@ class PredictorServer:
                 self.deployment_name, predictor.name, unit_name, method, duration_s
             )
 
+        def shadow_hook(shadow_unit: str, agree: bool) -> None:
+            self.metrics.shadow_compare(
+                self.deployment_name, predictor.name, shadow_unit, agree
+            )
+
         self.executor: GraphExecutor = build_executor(
             predictor,
             context=context,
             feedback_metrics_hook=feedback_hook,
             unit_call_hook=unit_call_hook,
+            shadow_compare_hook=shadow_hook,
         )
         self.batcher = (
             make_batcher(

@@ -549,3 +549,67 @@ async def test_drain_shadows_with_already_finished_task():
     # matter: drain must terminate promptly either way
     await _asyncio.wait_for(ex.drain_shadows(), timeout=5)
     assert not ex._shadow_tasks
+
+
+async def test_shadow_agreement_metric_ticks():
+    """The shadow comparison hook records per-prediction agreement:
+    identical candidate -> agree; different-argmax candidate -> disagree;
+    failing candidate -> disagree (an erroring candidate is the finding)."""
+    from seldon_core_tpu.engine.units import PythonClassUnit
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "sh",
+                "type": "ROUTER",
+                "implementation": "SHADOW",
+                "children": [
+                    {"name": "primary", "type": "MODEL"},
+                    {"name": "same", "type": "MODEL"},
+                    {"name": "diff", "type": "MODEL"},
+                    {"name": "boom", "type": "MODEL"},
+                ],
+            },
+        }
+    )
+
+    class Same:
+        def predict(self, X, names):
+            return X  # identical -> same argmax
+
+    class Diff:
+        def predict(self, X, names):
+            return X[:, ::-1] * -1.0  # reversed/negated -> different argmax
+
+    class Boom:
+        def predict(self, X, names):
+            raise RuntimeError("candidate crashed")
+
+    units = {
+        "primary": PythonClassUnit(pred.graph.children[0], Same()),
+        "same": PythonClassUnit(pred.graph.children[1], Same()),
+        "diff": PythonClassUnit(pred.graph.children[2], Diff()),
+        "boom": PythonClassUnit(pred.graph.children[3], Boom()),
+    }
+    seen: list[tuple[str, bool]] = []
+    ex = build_executor(
+        pred,
+        context={"units": units},
+        shadow_compare_hook=lambda name, agree: seen.append((name, agree)),
+    )
+    x = np.asarray([[1.0, 5.0, 2.0]], np.float32)
+    await ex.execute(SeldonMessage.from_array(x))
+    await ex.drain_shadows()
+    got = dict(seen)
+    assert got == {"same": True, "diff": False, "boom": False}
+
+    # batch path ticks once per mirrored message
+    seen.clear()
+    msgs = [SeldonMessage.from_array(x) for _ in range(3)]
+    await ex.execute_many(msgs)
+    await ex.drain_shadows()
+    assert len([s for s in seen if s[0] == "same"]) == 3
+    assert all(agree for n, agree in seen if n == "same")
+    assert not any(agree for n, agree in seen if n in ("diff", "boom"))
