@@ -415,3 +415,30 @@ def test_crd_validation_schema_is_structural_and_depth_limited():
     ]["spec"]
     assert spec_schema["properties"]["predictors"]["type"] == "array"
     assert "oauth_key" in spec_schema["properties"]
+
+
+async def test_iris_shadow_example_serves_and_compares():
+    """examples/deployments/iris_shadow.json end-to-end: primary serves,
+    candidate mirrors, the agreement counter ticks."""
+    import json as _json
+
+    from seldon_core_tpu.core.codec_json import message_from_dict
+    from seldon_core_tpu.metrics.registry import Metrics
+    from seldon_core_tpu.operator import DeploymentManager
+
+    metrics = Metrics()
+    m = DeploymentManager(metrics=metrics)
+    r = m.apply(_json.load(open("examples/deployments/iris_shadow.json")))
+    assert r.action == "created", r.message
+    running = m.get("iris-shadow")
+    out = await running.predict(
+        message_from_dict({"data": {"ndarray": [[5.1, 3.5, 1.4, 0.2]]}})
+    )
+    assert out.array.shape == (1, 3)
+    assert out.meta.routing == {"mirror": 0}
+    for svc in running.services.values():
+        await svc.executor.drain_shadows()
+    text = metrics.export().decode()
+    assert 'seldon_tpu_shadow_comparisons_total{' in text
+    assert 'shadow_unit="candidate"' in text
+    m.delete("iris-shadow")
