@@ -2,15 +2,19 @@
 
 Parity: reference engine PredictionService (engine/.../service/
 PredictionService.java:52-57,71-78) generates a 130-bit SecureRandom integer
-rendered in base32 and assigns it when a request has no puid. Same contract
-here: 130 bits, base32 (RFC 4648 lowercase, no padding), assigned-if-missing.
+rendered in base32 and assigns it when a request has no puid. Same entropy
+and digit set here, with one deliberate format difference: the Java
+BigInteger.toString(32) emits variable-length output (no leading zeros);
+this implementation emits a FIXED 26-character string, leading '0' digits
+included — fixed width keeps generation allocation-free and log fields
+aligned, and no consumer parses the puid numerically.
 """
 
 from __future__ import annotations
 
 import os
 
-_ALPHABET = "0123456789abcdefghijklmnopqrstuv"  # base32, matches Java BigInteger.toString(32)
+_ALPHABET = "0123456789abcdefghijklmnopqrstuv"  # digit set of Java BigInteger.toString(32)
 
 
 def new_puid(bits: int = 130) -> str:

@@ -17,6 +17,7 @@ the pure-Python path as the semantic source of truth.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import logging
 import os
@@ -40,15 +41,25 @@ def _build() -> str | None:
     try:
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
             return _SO
-        res = subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
-            capture_output=True,
-            timeout=120,
-        )
-        if res.returncode != 0:
-            log.warning("fastcodec build failed: %s", res.stderr.decode()[:500])
-            return None
-        os.replace(_SO + ".tmp", _SO)
+        # pid-unique temp name: concurrent processes (platform + microservice
+        # on one host) may both build; a shared .tmp path would interleave
+        # writes and os.replace could install a corrupt .so
+        tmp = f"{_SO}.tmp.{os.getpid()}"
+        try:
+            res = subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                capture_output=True,
+                timeout=120,
+            )
+            if res.returncode != 0:
+                log.warning("fastcodec build failed: %s", res.stderr.decode()[:500])
+                return None
+            os.replace(tmp, _SO)
+        finally:
+            # failed/timed-out builds must not strand pid-unique temp files
+            # in the package dir (they are never overwritten by later pids)
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
         return _SO
     except Exception as e:  # noqa: BLE001 - no compiler / RO filesystem
         log.warning("fastcodec build unavailable: %s", e)
@@ -212,7 +223,7 @@ def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
 # HTTP head-parse flag bits (mirror fastcodec.cpp)
 HDRF_HAS_CTYPE = 1
 HDRF_CONN_CLOSE = 2
-HDRF_CHUNKED = 4
+HDRF_HAS_TE = 4  # Transfer-Encoding header present (any value)
 HDRF_HAS_CLEN = 8
 
 

@@ -328,9 +328,25 @@ enum {
 enum {
   HDRF_HAS_CTYPE = 1,
   HDRF_CONN_CLOSE = 2,
-  HDRF_CHUNKED = 4,
+  HDRF_HAS_TE = 4,
   HDRF_HAS_CLEN = 8,
 };
+
+// RFC 7230 3.2.6 token charset for header field-names. Names containing
+// anything else (form-feed, vertical tab, NBSP, NUL...) are rejected
+// outright — lenient proxies normalize some of these, re-opening the
+// hidden-Transfer-Encoding smuggling family if we merely mis-file them.
+static int is_tchar(unsigned char c) {
+  if ((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || (c >= '0' && c <= '9'))
+    return 1;
+  switch (c) {
+    case '!': case '#': case '$': case '%': case '&': case '\'': case '*':
+    case '+': case '-': case '.': case '^': case '_': case '`': case '|':
+    case '~':
+      return 1;
+  }
+  return 0;
+}
 
 static int ieq_n(const unsigned char *a, long n, const char *lit) {
   for (long i = 0; i < n; i++) {
@@ -367,6 +383,16 @@ long http_parse_head(const unsigned char *buf, long n,
   }
   if (head_end < 0) return HTTP_INCOMPLETE;
 
+  // strict line discipline over the whole head: every '\n' must be part of
+  // a CRLF and every '\r' must start one. A bare LF accepted as a line
+  // break by a tolerant front proxy (RFC 7230 3.5) would hide a
+  // Transfer-Encoding header inside what we'd treat as a header VALUE —
+  // the TE.CL smuggling family again, via framing disagreement
+  for (long i = 0; i < head_end; i++) {
+    if (buf[i] == '\n' && (i == 0 || buf[i - 1] != '\r')) return HTTP_MALFORMED;
+    if (buf[i] == '\r' && buf[i + 1] != '\n') return HTTP_MALFORMED;
+  }
+
   // request line: METHOD SP PATH SP VERSION
   long p = 0;
   while (p < head_end && buf[p] != ' ') p++;
@@ -390,11 +416,21 @@ long http_parse_head(const unsigned char *buf, long n,
     long eol = pos;
     while (eol + 1 <= head_end && !(buf[eol] == '\r' && buf[eol + 1] == '\n'))
       eol++;
+    // leading whitespace = obs-fold line continuation (RFC 7230 3.2.4):
+    // reject rather than guess — a proxy that trims it would file
+    // " Transfer-Encoding: chunked" under TE while we'd skip it
+    if (buf[pos] == ' ' || buf[pos] == '\t') return HTTP_MALFORMED;
     // header: NAME ':' OWS VALUE
     long colon = pos;
     while (colon < eol && buf[colon] != ':') colon++;
     if (colon < eol) {
       long name_len = colon - pos;
+      // RFC 7230 3.2.4/3.2.6: the field-name must be pure token chars —
+      // rejects "Transfer-Encoding : chunked" (space before colon) and
+      // form-feed/NBSP variants alike; empty names are malformed too
+      if (name_len == 0) return HTTP_MALFORMED;
+      for (long i = pos; i < colon; i++)
+        if (!is_tchar(buf[i])) return HTTP_MALFORMED;
       long vs = colon + 1;
       while (vs < eol && (buf[vs] == ' ' || buf[vs] == '\t')) vs++;
       long ve = eol;
@@ -412,6 +448,10 @@ long http_parse_head(const unsigned char *buf, long n,
           any = 1;
         }
         if (!any) return HTTP_MALFORMED;
+        // RFC 7230 3.3.2: multiple differing Content-Length values MUST be
+        // rejected (CL.CL desync); equal duplicates are tolerated
+        if ((*flags & HDRF_HAS_CLEN) && *content_length != v)
+          return HTTP_MALFORMED;
         *content_length = v;
         *flags |= HDRF_HAS_CLEN;
       } else if (ieq_n(name, name_len, "content-type")) {
@@ -428,7 +468,10 @@ long http_parse_head(const unsigned char *buf, long n,
       } else if (ieq_n(name, name_len, "connection")) {
         if (ve - vs == 5 && ieq_n(buf + vs, 5, "close")) *flags |= HDRF_CONN_CLOSE;
       } else if (ieq_n(name, name_len, "transfer-encoding")) {
-        if (ve - vs == 7 && ieq_n(buf + vs, 7, "chunked")) *flags |= HDRF_CHUNKED;
+        // ANY Transfer-Encoding (chunked, "gzip, chunked", unknown codings)
+        // is outside this server's contract; flag on presence so the caller
+        // rejects instead of framing by Content-Length (TE.CL smuggling)
+        *flags |= HDRF_HAS_TE;
       }
     }
     pos = eol + 2;

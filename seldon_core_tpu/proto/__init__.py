@@ -1,0 +1,5 @@
+"""Wire-contract protos (see prediction.proto for the compatibility notes)."""
+
+from pathlib import Path
+
+PROTO_DIR = Path(__file__).resolve().parent

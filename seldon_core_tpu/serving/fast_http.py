@@ -33,6 +33,13 @@ Handler = Callable[[WireRequest], Awaitable[WireResponse]]
 _MAX_BODY = 64 * 1024 * 1024  # matches the aiohttp apps' client_max_size
 _MAX_HEADER = 64 * 1024
 
+# RFC 7230 3.2.6 token charset for header field-names (must stay in lockstep
+# with fastcodec.cpp is_tchar — the C parser rejects non-token names too)
+_TCHAR = frozenset(
+    "!#$%&'*+-.^_`|~0123456789"
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+)
+
 _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",
     400: b"HTTP/1.1 400 Bad Request\r\n",
@@ -114,16 +121,19 @@ class HttpProtocol(asyncio.Protocol):
             return
         flags = parsed.flags
         method = parsed.method
+        if flags & native.HDRF_HAS_TE:
+            # reject ANY Transfer-Encoding, even alongside Content-Length:
+            # framing by CL while a TE-honoring front proxy frames by
+            # chunked is the classic TE.CL request-smuggling desync
+            self._respond_simple(400, b"Transfer-Encoding not supported")
+            self._close()
+            return
         if flags & native.HDRF_HAS_CLEN:
             clen = parsed.content_length
         elif method in ("GET", "HEAD", "DELETE"):
             clen = 0
         else:
             self._respond_simple(411, b"Content-Length required")
-            self._close()
-            return
-        if flags & native.HDRF_CHUNKED:
-            self._respond_simple(411, b"chunked bodies not supported")
             self._close()
             return
         if clen > _MAX_BODY:
@@ -166,6 +176,14 @@ class HttpProtocol(asyncio.Protocol):
             return
         head = bytes(buf[:head_end])
         lines = head.split(b"\r\n")
+        if any(b"\n" in ln or b"\r" in ln for ln in lines):
+            # bare LF/CR anywhere in the head (request line included): an
+            # LF-tolerant front proxy would see an extra line (e.g. a hidden
+            # Transfer-Encoding header) where we see one — reject, matching
+            # the C parser's whole-head CRLF discipline
+            self._respond_simple(400, b"bad line terminator")
+            self._close()
+            return
         try:
             method, path, _ = lines[0].decode("latin-1").split(" ", 2)
         except ValueError:
@@ -174,16 +192,53 @@ class HttpProtocol(asyncio.Protocol):
             return
         headers: dict[str, str] = {}
         for line in lines[1:]:
+            if line[:1] in (b" ", b"\t"):
+                # obs-fold continuation, colon or not — same rule as the C
+                # parser (a colon-less fold would silently skip below)
+                self._respond_simple(400, b"bad header name")
+                self._close()
+                return
             k, sep, v = line.decode("latin-1").partition(":")
-            if sep:
-                headers[k.strip().lower()] = v.strip()
+            if not sep:
+                continue
+            if not k or any(c not in _TCHAR for c in k):
+                # RFC 7230 3.2.4/3.2.6: field-name must be pure token chars
+                # — rejects "Transfer-Encoding : chunked" (space before
+                # colon) and form-feed/NBSP/NUL variants, same as the C path
+                self._respond_simple(400, b"bad header name")
+                self._close()
+                return
+            key = k.lower()
+            v = v.strip()
+            if key == "content-length":
+                if not (v.isascii() and v.isdigit()):
+                    self._respond_simple(400, b"bad content-length")
+                    self._close()
+                    return
+                if key in headers and int(headers[key]) != int(v):
+                    # RFC 7230 3.3.2: differing duplicate Content-Length
+                    # values MUST be rejected (CL.CL desync); numeric
+                    # comparison so '4' vs '04' tolerates, like the C path
+                    self._respond_simple(400, b"conflicting content-length")
+                    self._close()
+                    return
+            headers[key] = v
+        if "transfer-encoding" in headers:
+            # same rule as the C path: any TE (chunked, "gzip, chunked", …)
+            # is rejected outright — never frame a TE request by CL
+            self._respond_simple(400, b"Transfer-Encoding not supported")
+            self._close()
+            return
         if "content-length" in headers:
-            try:
-                clen = int(headers["content-length"])
-            except ValueError:
+            cl_raw = headers["content-length"]
+            # digits-only, same rule as the C parser: bare int() would also
+            # accept '+4', '-4', '1_0' and unicode digits, and a negative
+            # value slips past every downstream bound check
+            if not (cl_raw.isascii() and cl_raw.isdigit()):
                 self._respond_simple(400, b"bad content-length")
                 self._close()
                 return
+            clen = int(cl_raw)
         elif method in ("GET", "HEAD", "DELETE"):
             clen = 0
         else:

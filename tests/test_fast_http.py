@@ -282,7 +282,7 @@ async def test_fast_gateway_oauth_flow_matches_aiohttp_app():
 async def test_fast_server_rejects_oversize_and_chunked():
     server, port = await _fast_engine()
     try:
-        # chunked request bodies are out of contract -> 411
+        # any Transfer-Encoding is out of contract -> 400 reject
         reader, writer = await asyncio.open_connection("127.0.0.1", port)
         writer.write(
             b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
@@ -290,7 +290,7 @@ async def test_fast_server_rejects_oversize_and_chunked():
         )
         await writer.drain()
         status_line = await reader.readline()
-        assert b"411" in status_line
+        assert b"400" in status_line
         writer.close()
 
         # declared oversize -> 413 without reading the body
@@ -334,6 +334,87 @@ async def test_multipart_form_json_field_kept():
     finally:
         server.close()
         await server.wait_closed()
+
+
+async def test_transfer_encoding_with_content_length_rejected():
+    """Advisor r3 (medium): TE.CL request smuggling. A request carrying BOTH
+    Transfer-Encoding and Content-Length must be rejected outright — framing
+    it by CL while a TE-honoring front proxy frames it by chunked lets an
+    attacker smuggle a second request. Applies to any TE token list
+    ('gzip, chunked' included) on both the C and Python parsers."""
+    from seldon_core_tpu import native
+
+    async def attempt(port: int, te_value: bytes) -> bytes:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        # chunked framing says "empty body then a smuggled GET"; CL=4 framing
+        # would read b"0\r\n\r" as the body and parse the rest as a request
+        writer.write(
+            b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: t\r\n"
+            b"Transfer-Encoding: " + te_value + b"\r\n"
+            b"Content-Length: 4\r\n\r\n"
+            b"0\r\n\r\nGET /smuggled HTTP/1.1\r\nHost: t\r\n\r\n"
+        )
+        await writer.drain()
+        status_line = await reader.readline()
+        writer.close()
+        return status_line
+
+    async def raw_status(port: int, req: bytes) -> bytes:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(req)
+        await writer.drain()
+        status_line = await reader.readline()
+        writer.close()
+        return status_line
+
+    # smuggling-family probes that must 400 on BOTH parsers
+    probes = [
+        # whitespace before the colon (RFC 7230 3.2.4 MUST reject)
+        b"POST /p HTTP/1.1\r\nHost: t\r\nTransfer-Encoding : chunked\r\n"
+        b"Content-Length: 4\r\n\r\nbody",
+        # differing duplicate Content-Length (RFC 7230 3.3.2 MUST reject)
+        b"POST /p HTTP/1.1\r\nHost: t\r\nContent-Length: 4\r\n"
+        b"Content-Length: 10\r\n\r\nbody",
+        # leading whitespace on a header line (obs-fold variant)
+        b"POST /p HTTP/1.1\r\nHost: t\r\n Transfer-Encoding: chunked\r\n"
+        b"Content-Length: 4\r\n\r\nbody",
+        # negative / signed / non-digit Content-Length forms
+        b"POST /p HTTP/1.1\r\nHost: t\r\nContent-Length: -4\r\n\r\n",
+        b"POST /p HTTP/1.1\r\nHost: t\r\nContent-Length: +4\r\n\r\nbody",
+        # bare LF hiding a TE header inside a header value (LF-tolerant
+        # proxies split there; we must not frame by the trailing CL)
+        b"POST /p HTTP/1.1\r\nX-A: a\nTransfer-Encoding: chunked\r\n"
+        b"Content-Length: 4\r\n\r\nbody",
+        # colon-less obs-fold continuation line
+        b"POST /p HTTP/1.1\r\nHost: t\r\nContent-Length: 4\r\n 2\r\n\r\nbody",
+    ]
+
+    async def check_all(port: int) -> None:
+        for te in (b"chunked", b"gzip, chunked", b"identity"):
+            assert b"400" in await attempt(port, te), te
+        for p in probes:
+            assert b"400" in await raw_status(port, p), p
+
+    server, port = await _fast_engine()
+    try:
+        await check_all(port)
+    finally:
+        server.close()
+        await server.wait_closed()
+
+    # same contract on the pure-Python fallback parser
+    if native.available():
+        orig = native.parse_http_head
+        native.parse_http_head = lambda buf: None
+        try:
+            server, port = await _fast_engine()
+            try:
+                await check_all(port)
+            finally:
+                server.close()
+                await server.wait_closed()
+        finally:
+            native.parse_http_head = orig
 
 
 async def test_post_without_content_length_is_411():

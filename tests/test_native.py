@@ -225,11 +225,65 @@ def test_http_parse_head_fields_and_edges():
     h2 = native.parse_http_head(b"GET /ready HTTP/1.1\r\nHost: h\r\n\r\n")
     assert not (h2.flags & native.HDRF_HAS_CLEN) and h2.content_length == -1
 
-    # chunked flag
+    # transfer-encoding flag: set on ANY TE value, not just exact "chunked"
+    # ("gzip, chunked" with a Content-Length is the TE.CL smuggling shape)
     h3 = native.parse_http_head(
         b"POST /p HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
     )
-    assert h3.flags & native.HDRF_CHUNKED
+    assert h3.flags & native.HDRF_HAS_TE
+    h4 = native.parse_http_head(
+        b"POST /p HTTP/1.1\r\nTransfer-Encoding: gzip, chunked\r\n"
+        b"Content-Length: 4\r\n\r\nbody"
+    )
+    assert h4.flags & native.HDRF_HAS_TE and h4.flags & native.HDRF_HAS_CLEN
+
+    # whitespace before the colon: MUST reject (RFC 7230 3.2.4) — a lenient
+    # parse would mis-file "Transfer-Encoding : chunked" as an unknown header
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nTransfer-Encoding : chunked\r\n"
+            b"Content-Length: 4\r\n\r\nbody"
+        )
+        == -1
+    )
+    # differing duplicate Content-Length: MUST reject (RFC 7230 3.3.2)
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nContent-Length: 4\r\nContent-Length: 10\r\n\r\n"
+        )
+        == -1
+    )
+    # equal duplicates tolerated
+    h5 = native.parse_http_head(
+        b"POST /p HTTP/1.1\r\nContent-Length: 4\r\nContent-Length: 4\r\n\r\nbody"
+    )
+    assert h5.content_length == 4
+    # leading whitespace on a header line (obs-fold): MUST reject — a proxy
+    # trimming it would see " Transfer-Encoding: chunked" as TE while a
+    # lenient parse here would skip it
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\n Transfer-Encoding: chunked\r\n"
+            b"Content-Length: 4\r\n\r\nbody"
+        )
+        == -1
+    )
+    # bare LF inside a header line: reject — an LF-tolerant proxy would see
+    # the hidden Transfer-Encoding as its own header and frame by chunked
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nX-A: a\nTransfer-Encoding: chunked\r\n"
+            b"Content-Length: 4\r\n\r\nbody"
+        )
+        == -1
+    )
+    # bare CR likewise
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nX-A: a\rX-B: b\r\nContent-Length: 4\r\n\r\nbody"
+        )
+        == -1
+    )
 
 
 def test_http_parse_head_hardening():
@@ -251,11 +305,26 @@ def test_http_parse_head_hardening():
     )
     # request line without an HTTP version must not swallow header bytes
     assert native.parse_http_head(b"GET /p\r\nContent-Length: 5\r\n\r\nhello") == -1
-    # embedded NUL in a header name: parses without OOB, not treated as clen
-    h = native.parse_http_head(
-        b"GET /p HTTP/1.1\r\ncontent-length\x00x: 3\r\n\r\n"
+    # embedded NUL in a header name: non-token field-names are rejected
+    # outright (RFC 7230 3.2.6) — mis-filing them as "unknown header" left
+    # lenient-proxy smuggling variants open (code-review r4)
+    assert (
+        native.parse_http_head(b"GET /p HTTP/1.1\r\ncontent-length\x00x: 3\r\n\r\n")
+        == -1
     )
-    assert h is not None and h != -1 and not (h.flags & native.HDRF_HAS_CLEN)
+    # form-feed before the colon: same family, must reject not mis-file
+    assert (
+        native.parse_http_head(
+            b"POST /p HTTP/1.1\r\nTransfer-Encoding\x0c: chunked\r\n"
+            b"Content-Length: 4\r\n\r\nbody"
+        )
+        == -1
+    )
+    # equal-value duplicate CL with different spellings tolerated numerically
+    h6 = native.parse_http_head(
+        b"POST /p HTTP/1.1\r\nContent-Length: 4\r\nContent-Length: 04\r\n\r\nbody"
+    )
+    assert h6.content_length == 4
     # >4KB authorization: C path declines (None) so Python handles it uncapped
     big = b"Bearer " + b"a" * 5000
     req = b"GET /p HTTP/1.1\r\nAuthorization: " + big + b"\r\n\r\n"

@@ -1,0 +1,164 @@
+"""Third-party codegen interop for the shipped .proto contract files.
+
+The reference ships service blocks in its contract file
+(/root/reference/proto/prediction.proto:76-109) so that anyone can run
+protoc/grpc codegen and get working client stubs. These tests prove the same
+for the shipped `seldon_core_tpu/proto/prediction.proto`:
+
+1. protoc compiles the shipped file from a CLEAN directory (no repo on the
+   import path — exactly what a third party does) into a FileDescriptorSet.
+2. The compiled service surface matches the reference contract service by
+   service, method by method, including request/response types.
+3. The compiled surface matches the runtime registration table
+   (proto/services.py SERVICES) so dynamic handlers can never drift from the
+   shipped contract.
+4. A stub generated FROM THE DESCRIPTOR (the image has no grpc codegen
+   plugin, so we build the same method signatures message_factory-style that
+   `grpc_tools` would emit) drives a live server end-to-end.
+"""
+
+import shutil
+import subprocess
+
+import grpc
+import numpy as np
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from seldon_core_tpu.core.codec_proto import message_from_proto, message_to_proto
+from seldon_core_tpu.core.message import SeldonMessage
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.proto import PROTO_DIR
+from seldon_core_tpu.proto.services import SERVICES
+from seldon_core_tpu.serving.grpc_server import start_grpc_server
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.utils.env import default_predictor
+
+# the reference contract surface (prediction.proto:76-109), spelled out so a
+# drift in either the shipped file or services.py fails loudly
+REFERENCE_SERVICES = {
+    "Generic": {
+        "TransformInput": ("SeldonMessage", "SeldonMessage"),
+        "TransformOutput": ("SeldonMessage", "SeldonMessage"),
+        "Route": ("SeldonMessage", "SeldonMessage"),
+        "Aggregate": ("SeldonMessageList", "SeldonMessage"),
+        "SendFeedback": ("Feedback", "SeldonMessage"),
+    },
+    "Model": {"Predict": ("SeldonMessage", "SeldonMessage")},
+    "Router": {
+        "Route": ("SeldonMessage", "SeldonMessage"),
+        "SendFeedback": ("Feedback", "SeldonMessage"),
+    },
+    "Transformer": {"TransformInput": ("SeldonMessage", "SeldonMessage")},
+    "OutputTransformer": {"TransformOutput": ("SeldonMessage", "SeldonMessage")},
+    "Combiner": {"Aggregate": ("SeldonMessageList", "SeldonMessage")},
+    "Seldon": {
+        "Predict": ("SeldonMessage", "SeldonMessage"),
+        "SendFeedback": ("Feedback", "SeldonMessage"),
+    },
+}
+
+
+def _compile_shipped_proto(tmp_path) -> descriptor_pb2.FileDescriptorSet:
+    """protoc the shipped contract from a clean dir, like a third party."""
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not installed")
+    src = tmp_path / "prediction.proto"
+    shutil.copy(PROTO_DIR / "prediction.proto", src)
+    out = tmp_path / "fds.pb"
+    res = subprocess.run(
+        [
+            "protoc",
+            f"--proto_path={tmp_path}",
+            f"--descriptor_set_out={out}",
+            "--include_imports",
+            str(src),
+        ],
+        capture_output=True,
+        timeout=60,
+    )
+    assert res.returncode == 0, res.stderr.decode()
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(out.read_bytes())
+    return fds
+
+
+def test_shipped_proto_compiles_and_ships_reference_services(tmp_path):
+    fds = _compile_shipped_proto(tmp_path)
+    (main,) = [f for f in fds.file if f.name.endswith("prediction.proto")]
+    assert main.package == "seldon.tpu"
+    compiled = {
+        s.name: {
+            m.name: (
+                m.input_type.rsplit(".", 1)[-1],
+                m.output_type.rsplit(".", 1)[-1],
+            )
+            for m in s.method
+        }
+        for s in main.service
+    }
+    # every reference service, method-for-method with matching types
+    for svc, methods in REFERENCE_SERVICES.items():
+        assert svc in compiled, f"service {svc} missing from shipped .proto"
+        assert compiled[svc] == methods, f"{svc} methods drifted"
+    # and the runtime registration table serves exactly the same signatures
+    for svc, methods in compiled.items():
+        assert svc in SERVICES, f"{svc} shipped but not registered at runtime"
+        runtime = {
+            name: (req.DESCRIPTOR.name, resp.DESCRIPTOR.name)
+            for name, (req, resp) in SERVICES[svc].items()
+        }
+        assert runtime == methods, f"runtime registration for {svc} drifted"
+    # nothing registered at runtime that the contract file doesn't ship
+    assert set(SERVICES) == set(compiled)
+
+
+async def test_descriptor_generated_stub_drives_live_server(tmp_path):
+    """Build message classes + method paths purely from the protoc output (a
+    third party's codegen artifacts; the image lacks the grpc plugin, so the
+    stub wiring below is what generated *_pb2_grpc code does) and call a live
+    server with them."""
+    fds = _compile_shipped_proto(tmp_path)
+    pool = descriptor_pool.DescriptorPool()
+    # well-known imports first, exactly once
+    for f in fds.file:
+        try:
+            pool.Add(f)
+        except Exception:  # struct.proto may pre-exist in a default pool copy
+            pass
+    msg_cls = {
+        name: message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"seldon.tpu.{name}")
+        )
+        for name in ("SeldonMessage", "SeldonMessageList", "Feedback")
+    }
+    svc_desc = pool.FindServiceByName("seldon.tpu.Seldon")
+    predict = svc_desc.FindMethodByName("Predict")
+    assert predict.input_type.full_name == "seldon.tpu.SeldonMessage"
+
+    service = PredictionService(
+        build_executor(default_predictor()), deployment_name="d", predictor_name="p"
+    )
+    server = await start_grpc_server(service, "127.0.0.1", 50957)
+    try:
+        async with grpc.aio.insecure_channel("127.0.0.1:50957") as ch:
+            # what a generated SeldonStub.__init__ wires up, from descriptors
+            call = ch.unary_unary(
+                f"/{svc_desc.full_name}/Predict",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=msg_cls["SeldonMessage"].FromString,
+            )
+            req = msg_cls["SeldonMessage"].FromString(
+                message_to_proto(
+                    SeldonMessage.from_array(np.ones((2, 4), np.float32))
+                ).SerializeToString()
+            )
+            reply = await call(req)
+            assert reply.meta.puid
+            # re-parse with the repo's pb2 to check payload semantics
+            from seldon_core_tpu.proto import prediction_pb2 as pb
+
+            out = message_from_proto(pb.SeldonMessage.FromString(reply.SerializeToString()))
+            assert np.asarray(out.array).shape == (2, 3)
+    finally:
+        await server.stop(None)
