@@ -154,7 +154,7 @@ def measure_transfer_mb_s() -> float:
     return round(rates[len(rates) // 2], 1)
 
 
-def _deployment(graph_params: dict, tpu: dict) -> "object":
+def _graph_predictor(graph: dict, tpu: dict) -> "object":
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
     from seldon_core_tpu.graph.validation import validate_deployment
@@ -163,21 +163,7 @@ def _deployment(graph_params: dict, tpu: dict) -> "object":
         {
             "spec": {
                 "name": "bench",
-                "predictors": [
-                    {
-                        "name": "main",
-                        "graph": {
-                            "name": "model",
-                            "type": "MODEL",
-                            "implementation": "JAX_MODEL",
-                            "parameters": [
-                                {"name": k, "value": str(v), "type": "STRING"}
-                                for k, v in graph_params.items()
-                            ],
-                        },
-                        "tpu": tpu,
-                    }
-                ],
+                "predictors": [{"name": "main", "graph": graph, "tpu": tpu}],
             }
         }
     )
@@ -186,9 +172,34 @@ def _deployment(graph_params: dict, tpu: dict) -> "object":
     return dep.spec.predictors[0]
 
 
+def _deployment(graph_params: dict, tpu: dict) -> "object":
+    return _graph_predictor(
+        {
+            "name": "model",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": k, "value": str(v), "type": "STRING"}
+                for k, v in graph_params.items()
+            ],
+        },
+        tpu,
+    )
+
+
+def _jax_model(name: str, value: str, key: str = "model") -> dict:
+    return {
+        "name": name,
+        "type": "MODEL",
+        "implementation": "JAX_MODEL",
+        "parameters": [{"name": key, "value": value, "type": "STRING"}],
+    }
+
+
 async def _serve_gateway_and_load(
     predictor, *, users: int, batch: int, features, duration_s: float,
     static_payload: bool = False, payload_format: str = "json",
+    workers: int = 1,
 ) -> dict:
     """The TRUE external hot path (reference apife->engine,
     RestClientController.java:127): OAuth bearer auth -> principal ->
@@ -221,24 +232,47 @@ async def _serve_gateway_and_load(
     port = _free_port()
     fast_server = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
     try:
-        stats = await run_load(
-            f"http://127.0.0.1:{port}",
-            users=users,
-            duration_s=duration_s,
-            features=features,
-            batch=batch,
-            oauth_key="bench-key",
-            oauth_secret="bench-secret",
-            static_payload=static_payload,
-            payload_format=payload_format,
-        )
+        if workers > 1:
+            # loadgen in separate OS processes (locust master/slave
+            # equivalent): proves whether the measured ceiling is the
+            # server's or the in-process client's
+            from seldon_core_tpu.tools.loadtest import run_load_multiprocess
+
+            loop = asyncio.get_running_loop()
+            stats = await loop.run_in_executor(
+                None,
+                lambda: run_load_multiprocess(
+                    f"http://127.0.0.1:{port}",
+                    workers=workers,
+                    users=users,
+                    duration_s=duration_s,
+                    features=features,
+                    batch=batch,
+                    oauth_key="bench-key",
+                    oauth_secret="bench-secret",
+                    static_payload=static_payload,
+                    payload_format=payload_format,
+                ),
+            )
+        else:
+            stats = await run_load(
+                f"http://127.0.0.1:{port}",
+                users=users,
+                duration_s=duration_s,
+                features=features,
+                batch=batch,
+                oauth_key="bench-key",
+                oauth_secret="bench-secret",
+                static_payload=static_payload,
+                payload_format=payload_format,
+            )
     finally:
         fast_server.close()
         await fast_server.wait_closed()
         if server.batcher is not None:
             await server.batcher.close()
     s = stats.summary()
-    return {
+    out = {
         "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
         "p50_ms": s["p50_ms"],
         "p95_ms": s["p95_ms"],
@@ -248,6 +282,16 @@ async def _serve_gateway_and_load(
         "batch_per_request": batch,
         "users": users,
     }
+    if workers > 1:
+        out["loadgen_workers"] = workers
+    if server.batcher is not None:
+        b = server.batcher
+        if b.stat_batches:
+            out["mean_batch_rows"] = round(b.stat_rows / b.stat_batches, 1)
+            out["mean_queue_wait_ms"] = round(
+                b.stat_queue_wait_s / b.stat_batches * 1e3, 2
+            )
+    return out
 
 
 def serving_iris_gateway(
@@ -256,6 +300,7 @@ def serving_iris_gateway(
     bucket: int = 128,
     batch_timeout_ms: float = 2.0,
     static_payload: bool = True,
+    workers: int = 1,
 ) -> dict:
     """Iris through the OAuth gateway + fast ingress — the reference's
     external hot path (apife->engine, SURVEY §3.1). static_payload keeps the
@@ -277,7 +322,242 @@ def serving_iris_gateway(
             features=4,
             duration_s=duration_s,
             static_payload=static_payload,
+            workers=workers,
         )
+    )
+
+
+def serving_abtest_gateway(
+    duration_s: float = 8.0,
+    users: int = 32,
+    bucket: int = 128,
+    batch_timeout_ms: float = 2.0,
+) -> dict:
+    """BASELINE config 3: RandomABTest router over two iris variants — the
+    framework's split-batch routing under micro-batching (the executor walks
+    data nodes merged, regroups rows at the route node per request). The
+    reference walks this graph with a per-request Java engine fan-out
+    (PredictiveUnitBean.java:69-124). Ratio vs the single-model stack
+    ceiling IS the measured routing overhead."""
+    pred = _graph_predictor(
+        {
+            "name": "ab",
+            "type": "ROUTER",
+            "implementation": "RANDOM_ABTEST",
+            "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+            "children": [
+                _jax_model("iris-a", "iris_logistic"),
+                _jax_model("iris-b", "iris_mlp"),
+            ],
+        },
+        {
+            "max_batch": bucket,
+            "batch_buckets": [bucket],
+            "batch_timeout_ms": batch_timeout_ms,
+        },
+    )
+    return asyncio.run(
+        _serve_gateway_and_load(
+            pred,
+            users=users,
+            batch=4,
+            features=4,
+            duration_s=duration_s,
+            static_payload=True,
+        )
+    )
+
+
+def serving_combiner_chip(duration_s: float = 10.0, fused: bool = True) -> dict:
+    """BASELINE config 4: Average Combiner over 3x ResNet50. Fused
+    (engine/fused.py): the three applies + the average trace into ONE XLA
+    program, one dispatch, one host->device transfer of the image — vs the
+    reference's three parallel container RPCs + Java-side averaging
+    (AverageCombinerUnit). fused=False walks the same graph through the
+    executor (three sequential dispatches) so the fusion win is a measured
+    ratio on identical semantics."""
+    pred = _graph_predictor(
+        {
+            "name": "avg",
+            "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": [
+                _jax_model("rn-a", "zoo://resnet50?seed=0&space_to_depth=1", "model_uri"),
+                _jax_model("rn-b", "zoo://resnet50?seed=1&space_to_depth=1", "model_uri"),
+                _jax_model("rn-c", "zoo://resnet50?seed=2&space_to_depth=1", "model_uri"),
+            ],
+        },
+        {
+            "max_batch": 32,
+            "batch_buckets": [32],
+            "batch_timeout_ms": 20.0,
+            "dtype": "bfloat16",
+            "fuse_graph": fused,
+        },
+    )
+    return asyncio.run(
+        _serve_gateway_and_load(
+            pred,
+            users=32,
+            batch=1,
+            features=(224, 224, 3),
+            duration_s=duration_s,
+            static_payload=True,
+            payload_format="npy",
+        )
+    )
+
+
+def serving_full_dag_chip(duration_s: float = 10.0) -> dict:
+    """BASELINE config 5: input Transformer -> epsilon-greedy Router ->
+    BERT-base variants (examples/deployments/full_dag_bert.json shape). The
+    router never fuses, so this measures the executor's full walk — split
+    batches regrouped at the bandit node — around jitted BERT leaves. Ratio
+    vs serving.bert_base_chip is the DAG overhead."""
+    pred = _graph_predictor(
+        {
+            "name": "input-scaler",
+            "type": "TRANSFORMER",
+            "implementation": "MEAN_TRANSFORMER",
+            "parameters": [{"name": "means", "value": "0.0", "type": "STRING"}],
+            "children": [
+                {
+                    "name": "eg",
+                    "type": "ROUTER",
+                    "implementation": "EPSILON_GREEDY",
+                    "parameters": [
+                        {"name": "epsilon", "value": "0.1", "type": "FLOAT"}
+                    ],
+                    "children": [
+                        _jax_model("bert-a", "zoo://bert_base?seed=0", "model_uri"),
+                        _jax_model("bert-b", "zoo://bert_base?seed=1", "model_uri"),
+                    ],
+                }
+            ],
+        },
+        {
+            "max_batch": 32,
+            "batch_buckets": [32],
+            "batch_timeout_ms": 10.0,
+            "dtype": "bfloat16",
+            # a DAG walk is several tunnel dispatches (transformer ->
+            # route -> two sub-batches -> bert); on this harness's ~113 ms
+            # RTT the 2 s default queue timeout clips the startup window
+            "queue_timeout_ms": 8000.0,
+        },
+    )
+    return asyncio.run(
+        _serve_gateway_and_load(
+            pred,
+            users=16,
+            batch=1,
+            features=128,
+            duration_s=duration_s,
+            payload_format="npy",
+        )
+    )
+
+
+async def _grpc_gateway_load(
+    predictor, *, users: int, batch: int, features: int, duration_s: float
+) -> dict:
+    """External gRPC hot path (reference SeldonGrpcServer.java:114-132):
+    Seldon.Predict with oauth_token metadata through the gRPC gateway onto
+    the same in-process backend the REST numbers use. Static pre-built
+    proto request; one shared HTTP/2 channel multiplexing all users."""
+    import grpc
+
+    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
+    from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+    from seldon_core_tpu.gateway.oauth import OAuthProvider
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    server = PredictorServer(predictor, deployment_name="bench")
+    server.warmup()
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    backend = InProcessBackend()
+    gw = Gateway(store=store, oauth=oauth, backend=backend)
+    store.deployment_added(
+        DeploymentSpec(
+            name="bench", oauth_key="bench-key", oauth_secret="bench-secret",
+            predictors=[predictor],
+        )
+    )
+    backend.register("bench", server.service)
+    port = _free_port()
+    grpc_server = await start_gateway_grpc(gw, "127.0.0.1", port)
+    token = oauth.issue_token("bench-key", "bench-secret")["access_token"]
+    metadata = (("oauth_token", token),)
+
+    req = pb.SeldonMessage()
+    rng = np.random.default_rng(0)
+    req.data.tensor.shape.extend([batch, features])
+    req.data.tensor.values.extend(rng.random(batch * features).tolist())
+    raw = req.SerializeToString()
+
+    latencies: list[float] = []
+    errors = 0
+
+    async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+        call = ch.unary_unary(
+            "/seldon.tpu.Seldon/Predict",
+            request_serializer=lambda m: m,  # pre-serialized bytes
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        stop_at = time.perf_counter() + duration_s
+
+        async def user() -> None:
+            nonlocal errors
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    out = await call(raw, metadata=metadata)
+                    ok = out.status.status == pb.Status.SUCCESS
+                except Exception:  # noqa: BLE001
+                    ok = False
+                if ok:
+                    latencies.append(time.perf_counter() - t0)
+                else:
+                    errors += 1
+
+        t_start = time.perf_counter()
+        await asyncio.gather(*(user() for _ in range(users)))
+        wall = time.perf_counter() - t_start
+    await grpc_server.stop(None)
+    if server.batcher is not None:
+        await server.batcher.close()
+
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        return round(
+            latencies[min(len(latencies) - 1, int(q / 100 * len(latencies)))] * 1e3, 2
+        ) if latencies else 0.0
+
+    return {
+        "preds_per_sec": round(len(latencies) * batch / wall, 2),
+        "p50_ms": pct(50),
+        "p95_ms": pct(95),
+        "p99_ms": pct(99),
+        "requests": len(latencies),
+        "errors": errors,
+        "batch_per_request": batch,
+        "users": users,
+        "wire": "grpc+proto",
+    }
+
+
+def serving_grpc_gateway(duration_s: float = 8.0, users: int = 32) -> dict:
+    pred = _deployment(
+        {"model": "iris_mlp"},
+        {"max_batch": 128, "batch_buckets": [128], "batch_timeout_ms": 2.0},
+    )
+    return asyncio.run(
+        _grpc_gateway_load(pred, users=users, batch=4, features=4, duration_s=duration_s)
     )
 
 
@@ -291,7 +571,13 @@ def serving_iris_chip(duration_s: float = 10.0) -> dict:
     )
 
 
-async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int) -> dict:
+async def _multi_tenant_load(
+    duration_s: float,
+    n_tenants: int,
+    users_each: int,
+    tpu_overrides: dict | None = None,
+    models: list[str] | None = None,
+) -> dict:
     """The flagship multi-tenancy inversion measured (SURVEY §7: many
     deployments share one slice — a problem the reference's
     pod-per-deployment design never had): N deployments reconciled through
@@ -310,7 +596,7 @@ async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int)
     backend = InProcessBackend()
     gw = Gateway(store=store, oauth=oauth, backend=backend)
     manager = DeploymentManager(store=store, backend=backend)
-    models = ["iris_mlp", "iris_logistic", "mnist_mlp"]
+    models = models or ["iris_mlp", "iris_logistic", "mnist_mlp"]
     feature_dims = {"iris_mlp": 4, "iris_logistic": 4, "mnist_mlp": 784}
     tenants = []
     for i in range(n_tenants):
@@ -336,9 +622,14 @@ async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int)
                             ],
                         },
                         "tpu": {
+                            # bucket ladder, not a single 512/128 bucket:
+                            # a tenant's in-flight rows (~users*4) pick the
+                            # snug bucket instead of padding 4x (the r3
+                            # multi-tenant gap's largest attributed term)
                             "max_batch": 128,
-                            "batch_buckets": [128],
+                            "batch_buckets": [16, 32, 64, 128],
                             "batch_timeout_ms": 2.0,
+                            **(tpu_overrides or {}),
                         },
                     }
                 ],
@@ -350,8 +641,23 @@ async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int)
     for name, _ in tenants:
         manager.get(name).warmup()
 
+    # event-loop lag probe: the shared-core contention term — how late a
+    # 5 ms sleep fires while 3 tenants' ingress+batcher+model share the loop
+    lag_stats = {"max_ms": 0.0, "sum_ms": 0.0, "n": 0}
+    probe_stop = asyncio.Event()
+
+    async def _lag_probe() -> None:
+        while not probe_stop.is_set():
+            t0 = time.perf_counter()
+            await asyncio.sleep(0.005)
+            lag_ms = (time.perf_counter() - t0 - 0.005) * 1e3
+            lag_stats["max_ms"] = max(lag_stats["max_ms"], lag_ms)
+            lag_stats["sum_ms"] += lag_ms
+            lag_stats["n"] += 1
+
     port = _free_port()
     fast_server = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    probe_task = asyncio.ensure_future(_lag_probe())
     try:
         results = await asyncio.gather(
             *(
@@ -364,14 +670,25 @@ async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int)
                     oauth_key=f"{name}-key",
                     oauth_secret=f"{name}-secret",
                     static_payload=True,
+                    # wide-feature tenants ride the binary wire, per the
+                    # framework's own wire guidance (docs/reference/
+                    # external-api.md §4): 784 features is 784 bytes as npy
+                    # uint8 vs ~25 KB as JSON text per 4-row request
+                    payload_format="npy" if dim > 64 else "json",
                 )
                 for name, dim in tenants
             )
         )
     finally:
+        probe_stop.set()
+        probe_task.cancel()
         fast_server.close()
         await fast_server.wait_closed()
         hbm = manager.hbm_usage()
+        batchers = {
+            name: next(iter(manager.get(name).services.values())).batcher
+            for name, _ in tenants
+        }
         for name, _ in tenants:
             manager.delete(name)
     per_tenant = {}
@@ -379,18 +696,38 @@ async def _multi_tenant_load(duration_s: float, n_tenants: int, users_each: int)
     for (name, _), stats in zip(tenants, results):
         s = stats.summary()
         total += s["requests_per_sec"] * 4
-        per_tenant[name] = {
+        entry = {
             "preds_per_sec": round(s["requests_per_sec"] * 4, 2),
             "p99_ms": s["p99_ms"],
             "errors": s["errors"],
         }
+        b = batchers.get(name)
+        if b is not None and b.stat_batches:
+            # attribution: achieved batch size + queue wait per tenant
+            entry["mean_batch_rows"] = round(b.stat_rows / b.stat_batches, 1)
+            entry["mean_queue_wait_ms"] = round(
+                b.stat_queue_wait_s / b.stat_batches * 1e3, 2
+            )
+        per_tenant[name] = entry
     return {
         "aggregate_preds_per_sec": round(total, 2),
         "tenants": per_tenant,
         "hbm_param_bytes_total": hbm["total"],
         "n_tenants": n_tenants,
         "users_each": users_each,
+        "total_users": n_tenants * users_each,
+        "loop_lag_mean_ms": round(
+            lag_stats["sum_ms"] / lag_stats["n"], 3
+        ) if lag_stats["n"] else 0.0,
+        "loop_lag_max_ms": round(lag_stats["max_ms"], 2),
     }
+
+
+def multi_tenant_equal_users(duration_s: float = 6.0) -> dict:
+    """The r3 VERDICT comparison: 3 tenants at the SAME total closed-loop
+    users as the single-tenant ceiling (32 -> 11/11/10), so the aggregate is
+    an apples-to-apples fraction of the ceiling."""
+    return asyncio.run(_multi_tenant_load(duration_s, 3, 11))
 
 
 def multi_tenant_cpu(duration_s: float = 6.0, n_tenants: int = 3, users_each: int = 8) -> dict:
@@ -473,7 +810,7 @@ def stack_ceiling_subprocess() -> dict | None:
             [sys.executable, os.path.abspath(__file__), "--serving-stack-only"],
             capture_output=True,
             text=True,
-            timeout=300,
+            timeout=600,
             env=env,
         )
         if out.returncode == 0:
@@ -510,7 +847,24 @@ def main() -> None:
         # multi-tenancy inversion: N control-plane-applied deployments
         # serving concurrently through one gateway.
         out = serving_iris_gateway(duration_s=8.0, users=32, bucket=128)
+        # loadgen-bound check (VERDICT r3 Weak #4): same config with the
+        # load generator in 2 separate OS processes; if the ceiling were
+        # client-bound, workers would raise it
+        sweep = serving_iris_gateway(
+            duration_s=6.0, users=32, bucket=128, workers=2
+        )
+        out["loadgen_sweep"] = {
+            "workers_1_preds_per_sec": out["preds_per_sec"],
+            "workers_2_preds_per_sec": sweep["preds_per_sec"],
+            "workers_2_p99_ms": sweep["p99_ms"],
+            "host_cpu_count": os.cpu_count(),
+        }
+        # graph-shaped serving (VERDICT r3 Next #1): split-batch routing
+        out["abtest"] = serving_abtest_gateway(duration_s=6.0)
+        # external gRPC ingress (VERDICT r3 Next #6)
+        out["grpc"] = serving_grpc_gateway(duration_s=6.0)
         out["multi_tenant"] = multi_tenant_cpu()
+        out["multi_tenant_equal_users"] = multi_tenant_equal_users()
         print(json.dumps(out))
         return
 
@@ -527,9 +881,28 @@ def main() -> None:
         serving["iris_chip"] = {**serving_iris_chip(), "floor_rtt_ms": rtt_ms}
         serving["resnet50_chip"] = {**serving_resnet(), "floor_rtt_ms": rtt_ms}
         serving["bert_base_chip"] = {**serving_bert(), "floor_rtt_ms": rtt_ms}
+        # graph-shaped serving on the chip (VERDICT r3 Next #1): the
+        # BASELINE combiner + full-DAG configs — ratios vs the single-model
+        # rows above are the measured fusion win / executor-walk cost
+        fused = serving_combiner_chip(fused=True)
+        unfused = serving_combiner_chip(duration_s=8.0, fused=False)
+        fused["unfused_preds_per_sec"] = unfused["preds_per_sec"]
+        fused["unfused_p99_ms"] = unfused["p99_ms"]
+        if unfused["preds_per_sec"]:
+            fused["fusion_speedup"] = round(
+                fused["preds_per_sec"] / unfused["preds_per_sec"], 2
+            )
+        serving["combiner_fused"] = {**fused, "floor_rtt_ms": rtt_ms}
+        serving["full_dag"] = {**serving_full_dag_chip(), "floor_rtt_ms": rtt_ms}
         ceiling = stack_ceiling_subprocess()
         if ceiling is not None:
             serving["stack_ceiling_cpu"] = ceiling
+            # hoist the graph + grpc CPU legs to the serving section so the
+            # BENCH record carries serving.abtest / serving.grpc directly
+            if "abtest" in ceiling:
+                serving["abtest"] = ceiling.pop("abtest")
+            if "grpc" in ceiling:
+                serving["grpc"] = ceiling.pop("grpc")
         floors = {
             "dispatch_rtt_p50_ms": rtt_ms,
             "transfer_mb_s": measure_transfer_mb_s(),

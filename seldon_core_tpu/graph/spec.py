@@ -141,6 +141,10 @@ class TpuSpec(_Spec):
     batch_buckets: list[int] = Field(default_factory=list)  # [] -> derived from max_batch
     max_batch: int = 64
     batch_timeout_ms: float = 3.0
+    # how long a request may sit in the batch queue before REQUEST_TIMEOUT:
+    # deep DAGs (several device dispatches per walk) or high-RTT links need
+    # more than the 2 s default
+    queue_timeout_ms: float = 2000.0
     # False -> per-request isolation: a ROUTER decides per request exactly
     # like the reference engine, at the cost of per-request graph calls
     batch_across_requests: bool = True

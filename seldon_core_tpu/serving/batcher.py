@@ -56,6 +56,7 @@ def make_batcher(
         execute_many=execute_many,
         max_batch=tpu_spec.max_batch,
         batch_timeout_ms=tpu_spec.batch_timeout_ms,
+        queue_timeout_ms=getattr(tpu_spec, "queue_timeout_ms", 2000.0),
         metrics=metrics,
         deployment_name=deployment_name,
     )
@@ -100,6 +101,13 @@ class MicroBatcher:
         self._closed = False
         self._inflight: set[asyncio.Task] = set()
         self._loop: asyncio.AbstractEventLoop | None = None
+        # in-memory attribution counters (bench/diagnostics: what batch sizes
+        # the batcher actually achieves, and how long requests queued) — the
+        # prometheus histograms carry the same data for production scrapes
+        self.stat_batches = 0
+        self.stat_rows = 0
+        self.stat_queue_wait_s = 0.0
+        self.stat_passthrough = 0  # requests that bypassed coalescing
 
     async def submit(self, msg: SeldonMessage) -> SeldonMessage:
         """Submit one request; resolves with its own (row-sliced) response."""
@@ -121,6 +129,7 @@ class MicroBatcher:
             msg = msg.with_array(arr)
         rows = int(arr.shape[0])
         if rows >= self.max_batch:
+            self.stat_passthrough += 1
             return await self._execute(msg)
 
         key = (arr.shape[1:], str(arr.dtype))
@@ -163,6 +172,9 @@ class MicroBatcher:
     async def _run_batch(self, items: list[_Pending]) -> None:
         now = time.perf_counter()
         total_rows = sum(i.rows for i in items)
+        self.stat_batches += 1
+        self.stat_rows += total_rows
+        self.stat_queue_wait_s += now - items[0].enqueued_at
         self._metrics.batch(self._deployment, total_rows, now - items[0].enqueued_at)
         try:
             if len(items) > 1 and self._execute_many is not None:

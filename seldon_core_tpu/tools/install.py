@@ -137,11 +137,31 @@ def platform_deployment(
     tpu_chips: int = 1,
     pull_policy: str = "IfNotPresent",
     service_type: str = "",
+    storage: dict | None = None,
 ) -> list[dict]:
     """The platform pod hosts the engines, so IT is the pod that needs the
     chips: with tpu_chips > 0 it gets GKE TPU node selectors + a
-    google.com/tpu request (rounded up to a valid v5e slice)."""
+    google.com/tpu request (rounded up to a valid v5e slice). ``storage``
+    (when enabled) mounts the seldon-models PVC (storage_manifests) at its
+    mount_path so file:// checkpoint URIs resolve to durable volume paths."""
     pod_spec: dict = {"serviceAccountName": "seldon-core-tpu"}
+    volumes: list[dict] = []
+    volume_mounts: list[dict] = []
+    if storage and storage.get("enabled"):
+        volumes.append(
+            {
+                "name": "models",
+                "persistentVolumeClaim": {"claimName": "seldon-models"},
+            }
+        )
+        volume_mounts.append(
+            {
+                "name": "models",
+                "mountPath": storage.get("mount_path", "/var/seldon/models"),
+            }
+        )
+    if volumes:
+        pod_spec["volumes"] = volumes
     resources: dict = {}
     if tpu_chips > 0:
         from seldon_core_tpu.operator.resources import _tpu_slice
@@ -208,6 +228,11 @@ def platform_deployment(
                                     "initialDelaySeconds": 15,
                                 },
                                 **({"resources": resources} if resources else {}),
+                                **(
+                                    {"volumeMounts": volume_mounts}
+                                    if volume_mounts
+                                    else {}
+                                ),
                             }
                         ],
                     },
@@ -232,6 +257,49 @@ def platform_deployment(
             },
         },
     ]
+
+
+def storage_manifests(namespace: str, storage: dict) -> list[dict]:
+    """Model-artifact volume (reference `persistence/` host-volume /
+    glusterfs create scripts, modernized): a PersistentVolumeClaim the
+    platform and model microservices mount for checkpoints and model
+    artifacts (persistence/checkpoint.py file:// URIs resolve under
+    ``mount_path``). ``host_path`` set -> also emit a hostPath
+    PersistentVolume bound to the claim (single-node / dev clusters, the
+    reference's host-volume case); unset -> the cluster's default
+    StorageClass provisions (the modern glusterfs-create equivalent)."""
+    claim: dict = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "seldon-models", "namespace": namespace},
+        "spec": {
+            "accessModes": [storage.get("access_mode", "ReadWriteOnce")],
+            "resources": {"requests": {"storage": storage.get("size", "10Gi")}},
+        },
+    }
+    out: list[dict] = []
+    host_path = storage.get("host_path", "")
+    if host_path:
+        out.append(
+            {
+                "apiVersion": "v1",
+                "kind": "PersistentVolume",
+                "metadata": {"name": f"seldon-models-{namespace}"},
+                "spec": {
+                    "capacity": {"storage": storage.get("size", "10Gi")},
+                    "accessModes": [storage.get("access_mode", "ReadWriteOnce")],
+                    "hostPath": {"path": host_path},
+                    "persistentVolumeReclaimPolicy": "Retain",
+                    "claimRef": {
+                        "name": "seldon-models",
+                        "namespace": namespace,
+                    },
+                },
+            }
+        )
+        claim["spec"]["storageClassName"] = ""  # bind the static PV only
+    out.append(claim)
+    return out
 
 
 def redis_manifests(namespace: str) -> list[dict]:
@@ -753,6 +821,15 @@ DEFAULT_VALUES: dict = {
         "tpu_chips": 1,
     },
     "redis": {"enabled": False, "image": "redis:7-alpine"},  # redis.image.tag
+    # reference persistence/ (host-volume / glusterfs scripts) modernized:
+    # a PVC for model artifacts + checkpoints; host_path emits a static PV
+    "storage": {
+        "enabled": False,
+        "size": "10Gi",
+        "access_mode": "ReadWriteOnce",
+        "host_path": "",
+        "mount_path": "/var/seldon/models",
+    },
     # reference monitoring/ + seldon-core-analytics chart: prometheus +
     # alertmanager + grafana with the serving rules/dashboard wired in
     "monitoring": {
@@ -819,7 +896,10 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
         tpu_chips=p["tpu_chips"],
         pull_policy=p["pull_policy"],
         service_type=p["service_type"],
+        storage=v["storage"],
     )
+    if v["storage"]["enabled"]:
+        bundle += storage_manifests(namespace, v["storage"])
     if v["redis"]["enabled"]:
         bundle += redis_manifests(namespace)
     if v["monitoring"]["enabled"]:

@@ -8,11 +8,20 @@ reward probabilities are how an A/B or epsilon-greedy router is exercised
 under load). This asyncio implementation replaces the locust dependency and
 reports p50/90/95/99 like the reference's Grafana dashboard percentiles.
 
+Multi-process mode (reference parity: the locust harness runs master/slave
+across pods — util/loadtester/scripts/predict_rest_locust.py:17-30 reads
+master host/port from the environment): ``--workers N`` re-execs this module
+N times, splits the users across the worker processes, and merges exact
+latency distributions (each worker dumps raw float32 latencies to a temp
+.npy the parent reads back). One asyncio process tops out as a generator
+well below a multi-core server's ceiling; N workers prove whether a
+measured ceiling is the server's or the client's.
+
 CLI:
     python -m seldon_core_tpu.tools.loadtest http://HOST:PORT \
         [--users 10] [--duration 10] [--features 4] [--batch 1] \
-        [--oauth-key K --oauth-secret S] [--feedback-route-rewards 0.4,0.9] \
-        [--json]
+        [--workers 1] [--oauth-key K --oauth-secret S] \
+        [--feedback-route-rewards 0.4,0.9] [--json]
 """
 
 from __future__ import annotations
@@ -22,6 +31,9 @@ import asyncio
 import json
 import os
 import random
+import subprocess
+import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 
@@ -33,6 +45,10 @@ class LoadStats:
     feedback_sent: int = 0
     started: float = 0.0
     finished: float = 0.0
+    workers: int = 1
+    # multiprocess mode: per-worker request counts, in worker order — lets
+    # callers verify every worker's dump actually contributed to the merge
+    worker_requests: list[int] = field(default_factory=list)
 
     def percentile(self, q: float) -> float:
         if not self.latencies_s:
@@ -54,6 +70,7 @@ class LoadStats:
             "p90_ms": round(self.percentile(90) * 1e3, 2),
             "p95_ms": round(self.percentile(95) * 1e3, 2),
             "p99_ms": round(self.percentile(99) * 1e3, 2),
+            "workers": self.workers,
         }
 
 
@@ -320,13 +337,154 @@ async def run_load(
     return stats
 
 
+def run_load_multiprocess(
+    base: str,
+    *,
+    workers: int,
+    users: int = 10,
+    duration_s: float = 10.0,
+    features=4,
+    batch: int = 1,
+    oauth_key: str = "",
+    oauth_secret: str = "",
+    route_rewards: list[float] | None = None,
+    locust_pacing: bool = False,
+    seed: int = 0,
+    static_payload: bool = False,
+    payload_format: str = "json",
+    timeout_s: float | None = None,
+) -> LoadStats:
+    """Fan the load across ``workers`` OS processes and merge exact stats.
+
+    Each worker is a fresh `python -m seldon_core_tpu.tools.loadtest` with a
+    slice of the users; it prints its summary JSON on stdout and dumps raw
+    per-request latencies (float32 seconds) to a parent-owned .npy file, so
+    merged percentiles are computed over the union, not approximated.
+    """
+    import numpy as np
+
+    if workers < 2:
+        raise ValueError("run_load_multiprocess needs workers >= 2")
+    if users < workers:
+        workers = max(1, users)
+    per = users // workers
+    extras = users % workers
+
+    # workers must import this package regardless of the caller's cwd;
+    # PREPEND the repo root — wiping PYTHONPATH would drop sitecustomize
+    # entries the interpreter environment depends on
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    with tempfile.TemporaryDirectory(prefix="loadtest_") as tmp:
+        procs: list[tuple[subprocess.Popen, str]] = []
+        for w in range(workers):
+            w_users = per + (1 if w < extras else 0)
+            dump = os.path.join(tmp, f"lat_{w}.npy")
+            cmd = [
+                sys.executable, "-m", "seldon_core_tpu.tools.loadtest", base,
+                "--users", str(w_users),
+                "--duration", str(duration_s),
+                "--batch", str(batch),
+                "--seed", str(seed + w * 100003),
+                "--payload", payload_format,
+                "--latency-dump", dump,
+                "--json",
+            ]
+            if isinstance(features, int):
+                cmd += ["--features", str(features)]
+            else:
+                cmd += ["--shape", ",".join(str(d) for d in features)]
+            if oauth_key:
+                cmd += ["--oauth-key", oauth_key, "--oauth-secret", oauth_secret]
+            if route_rewards:
+                cmd += [
+                    "--feedback-route-rewards",
+                    ",".join(str(r) for r in route_rewards),
+                ]
+            if locust_pacing:
+                cmd += ["--locust-pacing"]
+            if static_payload:
+                cmd += ["--static-payload"]
+            procs.append(
+                (
+                    subprocess.Popen(
+                        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env
+                    ),
+                    dump,
+                )
+            )
+
+        merged = LoadStats(workers=workers)
+        walls: list[float] = []
+        deadline = duration_s + (timeout_s if timeout_s is not None else 120.0)
+        try:
+            for proc, dump in procs:
+                try:
+                    out, err = proc.communicate(timeout=deadline)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    out, err = proc.communicate()
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"loadtest worker failed rc={proc.returncode}: "
+                        f"{err.decode()[-500:]}"
+                    )
+                summary = json.loads(out.decode().strip().splitlines()[-1])
+                merged.errors += summary["errors"]
+                merged.feedback_sent += summary["feedback_sent"]
+                walls.append(summary["duration_s"])
+                n_before = len(merged.latencies_s)
+                if os.path.exists(dump):
+                    merged.latencies_s.extend(np.load(dump).tolist())
+                merged.worker_requests.append(len(merged.latencies_s) - n_before)
+        finally:
+            # one failed worker must not leave the rest hammering the target
+            # (and unreaped) for the remaining duration
+            for proc, _ in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+        # workers run concurrently: aggregate throughput is the union of
+        # requests over the LONGEST worker wall (start skew between worker
+        # process launches is excluded by each worker timing itself)
+        merged.started = 0.0
+        merged.finished = max(walls) if walls else 0.0
+        return merged
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("base", help="http://HOST:PORT")
     p.add_argument("--users", type=int, default=10)
     p.add_argument("--duration", type=float, default=10.0)
     p.add_argument("--features", type=int, default=4)
+    p.add_argument(
+        "--shape",
+        default="",
+        help="comma tensor shape per item (e.g. 224,224,3); overrides --features",
+    )
     p.add_argument("--batch", type=int, default=1)
+    p.add_argument(
+        "--workers",
+        type=int,
+        default=1,
+        help="fan load across N OS processes (locust master/slave equivalent)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--static-payload",
+        action="store_true",
+        help="encode the payload once per user and re-post the same bytes",
+    )
+    p.add_argument(
+        "--latency-dump",
+        default="",
+        help="write raw per-request latencies (float32 s) to this .npy path",
+    )
     # env fallbacks let a k8s Job inject credentials from a Secret instead
     # of exposing them in the pod spec's command args
     p.add_argument("--oauth-key", default=os.environ.get("LOADTEST_OAUTH_KEY", ""))
@@ -353,20 +511,32 @@ def main() -> None:
         if args.feedback_route_rewards
         else None
     )
-    stats = asyncio.run(
-        run_load(
-            args.base.rstrip("/"),
-            users=args.users,
-            duration_s=args.duration,
-            features=args.features,
-            batch=args.batch,
-            oauth_key=args.oauth_key,
-            oauth_secret=args.oauth_secret,
-            route_rewards=rewards,
-            locust_pacing=args.locust_pacing,
-            payload_format=args.payload_format,
-        )
+    features = (
+        tuple(int(d) for d in args.shape.split(",")) if args.shape else args.features
     )
+    common = dict(
+        users=args.users,
+        duration_s=args.duration,
+        features=features,
+        batch=args.batch,
+        oauth_key=args.oauth_key,
+        oauth_secret=args.oauth_secret,
+        route_rewards=rewards,
+        locust_pacing=args.locust_pacing,
+        seed=args.seed,
+        static_payload=args.static_payload,
+        payload_format=args.payload_format,
+    )
+    if args.workers > 1:
+        stats = run_load_multiprocess(
+            args.base.rstrip("/"), workers=args.workers, **common
+        )
+    else:
+        stats = asyncio.run(run_load(args.base.rstrip("/"), **common))
+    if args.latency_dump:
+        import numpy as np
+
+        np.save(args.latency_dump, np.asarray(stats.latencies_s, dtype=np.float32))
     out = stats.summary()
     print(json.dumps(out) if args.as_json else out)
 

@@ -1,0 +1,153 @@
+"""Docs may only quote performance numbers the driver artifacts contain.
+
+VERDICT r2 and r3 both flagged README/PARITY quoting session-run serving
+numbers that the driver's `BENCH_r*.json` artifact of record didn't
+reproduce. This test makes the discipline structural: every "<number>
+preds/s" (or predictions/sec) claim in README.md, PARITY.md and docs/ must
+
+1. sit in a paragraph that names a specific `BENCH_rN` artifact (or be an
+   explicitly-labeled target/north-star/baseline figure), and
+2. when it cites an artifact, the number must actually occur in that JSON
+   (exact, or the doc's rounding of it).
+
+A claim that fails either rule fails CI — drift between docs and the
+artifact of record is a process bug, not a typo (VERDICT r3 Next #2).
+"""
+
+import json
+import math
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+DOC_FILES = [REPO / "README.md", REPO / "PARITY.md", *sorted((REPO / "docs").rglob("*.md"))]
+
+# "12,888.09 preds/s", "10,000 predictions/sec", "~21,700 preds/s"; the
+# lookbehind keeps digits glued to words ("ResNet50 preds/s") from matching
+_CLAIM = re.compile(
+    r"(?<![A-Za-z\d,.])(?P<num>\d[\d,]*(?:\.\d+)?)\s*(?:aggregate\s+)?"
+    r"(?:preds|predictions)\s*(?:/|\s+per\s+)\s*s(?:ec)?",
+    re.IGNORECASE,
+)
+_BENCH_TAG = re.compile(r"BENCH_r(\d+)")
+# figures that are goals, not measurements, don't need an artifact
+_TARGET_WORDS = ("north star", "north-star", "target", "baseline", "goal")
+
+
+def _paragraphs(text: str):
+    for block in re.split(r"\n\s*\n", text):
+        yield block
+
+
+# a preds/s doc claim may only match THROUGHPUT-keyed artifact fields —
+# matching any scalar in the JSON (latencies, user counts, shapes) would let
+# fabricated claims ride coincidental numbers
+_THROUGHPUT_KEYS = re.compile(
+    r"(preds_per_sec|requests_per_sec|aggregate_preds_per_sec|^value$)"
+)
+
+
+def _json_numbers(obj, acc: set, key: str = ""):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _json_numbers(v, acc, k)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _json_numbers(v, acc, key)
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if _THROUGHPUT_KEYS.search(key):
+            acc.add(float(obj))
+
+
+def _artifact_numbers(round_no: int) -> set:
+    path = REPO / f"BENCH_r{round_no:02d}.json"
+    if not path.exists():
+        path = REPO / f"BENCH_r{round_no}.json"
+    if not path.exists():
+        return set()
+    raw = path.read_text()
+    acc: set = set()
+    # driver artifacts wrap the bench JSON line inside a "tail" string field
+    _json_numbers(json.loads(raw), acc)
+    for m in re.finditer(r'\\?"([a-z_0-9]+)\\?":\s*(-?\d[\d.]*)', raw):
+        if not _THROUGHPUT_KEYS.search(m.group(1)):
+            continue
+        try:
+            acc.add(float(m.group(2)))
+        except ValueError:
+            pass
+    return acc
+
+
+def _matches(claimed: float, artifact: set) -> bool:
+    for v in artifact:
+        if math.isclose(claimed, v, rel_tol=0, abs_tol=0.005):
+            return True
+        # docs may round ("12,349" for 12349.83): a whole-number claim must
+        # be the artifact value's own rounding, not merely within 1.0 of
+        # some scalar
+        if claimed == int(claimed) and round(v) == claimed:
+            return True
+    return False
+
+
+def test_every_preds_per_sec_claim_cites_a_real_artifact_number():
+    failures = []
+    for doc in DOC_FILES:
+        text = doc.read_text()
+        paras = list(_paragraphs(text))
+        for i, para in enumerate(paras):
+            for m in _CLAIM.finditer(para):
+                raw_num = m.group("num")
+                claimed = float(raw_num.replace(",", ""))
+                is_target = any(w in para.lower() for w in _TARGET_WORDS) and claimed in (
+                    10000.0,
+                    1250.0,
+                )
+                # citation context: this paragraph plus the one introducing
+                # the list it belongs to ("From BENCH_r03.json: - bullet")
+                tags = _BENCH_TAG.findall(para) + (
+                    _BENCH_TAG.findall(paras[i - 1]) if i else []
+                )
+                if not tags:
+                    if is_target:
+                        continue
+                    failures.append(
+                        f"{doc.name}: '{raw_num} preds/s' has no BENCH_rN citation "
+                        f"in its paragraph: ...{para.strip()[:120]}..."
+                    )
+                    continue
+                nums: set = set()
+                for t in tags:
+                    nums |= _artifact_numbers(int(t))
+                if not nums:
+                    # every cited artifact is absent from the repo (a bare
+                    # forward reference to a future round can't source a
+                    # number)
+                    failures.append(
+                        f"{doc.name}: '{raw_num} preds/s' cites BENCH_r{tags} "
+                        "but no such artifact exists in the repo"
+                    )
+                    continue
+                if not is_target and not _matches(claimed, nums):
+                    failures.append(
+                        f"{doc.name}: '{raw_num} preds/s' not found in cited "
+                        f"artifact(s) BENCH_r{tags}"
+                    )
+    assert not failures, "\n".join(failures)
+
+
+def test_doc_number_checker_catches_fabrication():
+    """The checker itself must flag a number the artifact doesn't contain."""
+    nums = _artifact_numbers(3)
+    assert nums, "BENCH_r03.json must exist and parse"
+    assert _matches(16258.12, nums)
+    assert not _matches(21700.0, nums)  # the r3 session number VERDICT flagged
+    # latency/count scalars must NOT validate throughput claims: r03 has
+    # p99_ms 12.71 and users 32/64 — neither may back a preds/s number
+    # (32.0 DOES match: tunnel_jitter_probe preds_per_sec is 31.92, a real
+    # throughput — so probe with values near latency/user fields only)
+    assert not _matches(13.0, nums)
+    assert not _matches(64.0, nums)
+    assert not _matches(113.0, nums)  # floor_rtt_ms
