@@ -76,6 +76,9 @@ class ModelRuntime:
         self.int_inputs = int_inputs
         self.class_names = tuple(class_names)
         self._host_backend = all(d.platform == "cpu" for d in jax.devices())
+        self._donate = donate  # donation invalidates caller-held input
+        # buffers, so the device-array fast path must not feed them through
+        self.stat_device_fastpath = 0
         self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
         if mesh is not None and data_axis in mesh.axis_names:
             # batch shards over the data axis, so every compiled bucket must
@@ -224,6 +227,12 @@ class ModelRuntime:
             self.params = jax.device_put(params)
             self._in_sharding = None
             self._jit = jax.jit(serving_fn, donate_argnums=(1,) if donate else ())
+        # where the params live — the device-array fast path must not feed
+        # a jit an input committed elsewhere (jax raises incompatible-devices
+        # where the old host round-trip re-placed it). Param-less models
+        # (test stubs) get None, which disables the unsharded fast path.
+        leaves = jax.tree.leaves(self.params)
+        self._param_devices = leaves[0].devices() if leaves else None
 
     def _param_dtype(self, a) -> Any:
         a = jnp.asarray(a)
@@ -238,6 +247,28 @@ class ModelRuntime:
     def predict_device(self, x: np.ndarray) -> jax.Array:
         """Like predict but leaves the result on device (graph-internal hops
         between JAX nodes never touch the host)."""
+        if (
+            isinstance(x, jax.Array)
+            and not self._host_backend
+            and not self._donate
+            # fast path only for signatures warmup compiled: dtype already
+            # the model's input dtype and the batch exactly a bucket —
+            # anything else falls through to the host normalization below
+            # (np.asarray on a device array is a READBACK; skipping it is
+            # the whole point of this branch)
+            and x.dtype
+            == (jnp.int32 if self.int_inputs == "ids" else jnp.dtype(self.dtype))
+            and bucket_for(int(x.shape[0]), self.buckets) == int(x.shape[0])
+            # placement: with a mesh, device_put below reshards any input;
+            # without one, only accept inputs already on the params' device
+            # (a different-device input would make the jit raise where the
+            # old host round-trip silently re-placed it)
+            and (self._in_sharding is not None or x.devices() == self._param_devices)
+        ):
+            self.stat_device_fastpath += 1
+            if self._in_sharding is not None:
+                x = jax.device_put(x, self._in_sharding)  # no-op if placed
+            return self._jit(self.params, x)
         x = np.asarray(x)
         # Dtype normalization: every wire form maps onto exactly the
         # signatures warmup compiled (a live request must never hit a fresh
@@ -340,7 +371,12 @@ class JaxModelUnit(Unit):
                 "binData/strData is not a tensor (use npy binData or the "
                 "data arm)",
             )
-        x = np.asarray(msg.array)
+        x = msg.array
+        if not isinstance(x, jax.Array):
+            # lists / numpy normalize on host; device arrays pass through so
+            # predict_device's fast path can keep graph-internal hops
+            # on-device (np.asarray here would force a readback)
+            x = np.asarray(x)
         y = self.runtime.predict_device(x)
         return msg.with_array(y, self.runtime.class_names or msg.names)
 

@@ -1,0 +1,109 @@
+"""Device-resident graph hops: predict_device must not round-trip a
+jax.Array input through the host when it already matches a compiled
+signature (dtype == model input dtype, batch == exact bucket). On a real
+TPU host the old np.asarray() was a device->host readback per graph-internal
+hop — the combiner/DAG walks pay it once per child."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.models.zoo import get_model
+from seldon_core_tpu.models.base import ModelRuntime
+
+
+def _runtime(donate: bool = False) -> ModelRuntime:
+    ms = get_model("iris_mlp")
+    rt = ModelRuntime(
+        ms.apply_fn,
+        ms.params,
+        buckets=(8,),
+        class_names=ms.class_names,
+        donate=donate,
+    )
+    rt.feature_shape = ms.feature_shape
+    return rt
+
+
+def test_device_array_exact_bucket_skips_host_roundtrip():
+    rt = _runtime()
+    rt.warmup()
+    x = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+    expect = np.asarray(rt.predict(x))
+    # simulate an accelerator backend: the fast path is gated off on host
+    # (numpy views are free there); on CPU the same code path still runs
+    rt._host_backend = False
+    assert rt.stat_device_fastpath == 0
+    y = rt.predict_device(jnp.asarray(x))
+    assert rt.stat_device_fastpath == 1
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
+
+
+def test_device_array_wrong_dtype_or_partial_batch_falls_back():
+    rt = _runtime()
+    rt.warmup()
+    rt._host_backend = False
+    # wrong dtype: int32 VALUES (not the model dtype; jnp would keep int32)
+    # must normalize on host, not crash (note float64 wouldn't probe this —
+    # jnp.asarray silently downcasts it to float32 under default x64-off)
+    y = rt.predict_device(jnp.asarray(np.ones((8, 4), np.int32)))
+    assert np.asarray(y).shape == (8, 3)
+    # partial batch: 3 rows != bucket 8 -> host pad path
+    y2 = rt.predict_device(jnp.asarray(np.ones((3, 4), np.float32)))
+    assert np.asarray(y2).shape == (3, 3)
+    assert rt.stat_device_fastpath == 0
+
+
+def test_input_on_other_device_falls_back_to_host_path():
+    """An exact-bucket device array committed to a DIFFERENT device must not
+    be fed straight to the jit (incompatible-devices error); the guard sends
+    it through the host normalization instead (code-review r4)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs >= 2 devices (virtual mesh)")
+    rt = _runtime()
+    rt.warmup()
+    rt._host_backend = False
+    other = jax.devices()[1]
+    x = jax.device_put(np.ones((8, 4), np.float32), other)
+    y = rt.predict_device(x)
+    assert rt.stat_device_fastpath == 0
+    assert np.asarray(y).shape == (8, 3)
+
+
+def test_donating_runtime_never_takes_fast_path():
+    rt = _runtime(donate=True)
+    rt.warmup()
+    rt._host_backend = False
+    x = jnp.asarray(np.ones((8, 4), np.float32))
+    y = rt.predict_device(x)
+    assert rt.stat_device_fastpath == 0
+    # the caller's buffer must still be readable (nothing donated it)
+    assert np.asarray(x).shape == (8, 4)
+    assert np.asarray(y).shape == (8, 3)
+
+
+def test_graph_chain_passes_device_arrays_between_units():
+    """A model unit receiving a jax.Array (e.g. from an upstream JAX node)
+    hands it to the runtime without np.asarray-ing it first."""
+    import asyncio
+
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.graph.spec import PredictiveUnit
+    from seldon_core_tpu.models.base import JaxModelUnit
+
+    rt = _runtime()
+    rt.warmup()
+    unit = JaxModelUnit(
+        PredictiveUnit.model_validate(
+            {"name": "m", "type": "MODEL", "implementation": "JAX_MODEL"}
+        ),
+        rt,
+    )
+    rt._host_backend = False
+    msg = SeldonMessage.from_array(jnp.asarray(np.ones((8, 4), np.float32)))
+    out = asyncio.run(unit.transform_input(msg))
+    assert rt.stat_device_fastpath == 1
+    assert np.asarray(out.array).shape == (8, 3)
