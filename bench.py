@@ -543,6 +543,7 @@ async def _grpc_gateway_load(
     raw = req.SerializeToString()
 
     latencies: list[float] = []
+    completions: list[float] = []
     errors = 0
 
     async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
@@ -562,18 +563,21 @@ async def _grpc_gateway_load(
                     ok = out.status.status == pb.Status.SUCCESS
                 except Exception:  # noqa: BLE001
                     ok = False
+                done = time.perf_counter()
                 if ok:
-                    latencies.append(time.perf_counter() - t0)
+                    latencies.append(done - t0)
+                    completions.append(done)
                 else:
                     errors += 1
 
-        t_start = time.perf_counter()
         await asyncio.gather(*(user() for _ in range(users)))
-        wall = time.perf_counter() - t_start
     await grpc_server.stop(None)
     if server.batcher is not None:
         await server.batcher.close()
 
+    # windowed rate, same policy as tools/loadtest.py LoadStats.summary:
+    # drain-tail completions keep their latencies but not the denominator
+    in_window = sum(1 for t in completions if t <= stop_at)
     latencies.sort()
 
     def pct(q: float) -> float:
@@ -582,7 +586,7 @@ async def _grpc_gateway_load(
         ) if latencies else 0.0
 
     return {
-        "preds_per_sec": round(len(latencies) * batch / wall, 2),
+        "preds_per_sec": round(in_window * batch / duration_s, 2),
         "p50_ms": pct(50),
         "p95_ms": pct(95),
         "p99_ms": pct(99),

@@ -152,9 +152,17 @@ class ModelRuntime:
         #   (measured ~5x the f32 readback on this harness). The cast runs
         #   inside jit, fused into the last op; integer outputs pass through.
         low_precision = jnp.dtype(self.dtype).itemsize < 4
+        self._low_precision = low_precision
 
         def serving_fn(p, x):
             if x.dtype == jnp.uint8:
+                x = x.astype(self.dtype)
+            elif low_precision and x.dtype == jnp.float32:
+                # graph-internal hops deliver float32 (outputs below are
+                # cast to f32 inside jit); low-precision models take them
+                # device-side and cast here, fused into the first op —
+                # otherwise every bf16 model->model hop would bounce
+                # through the host for a dtype normalization
                 x = x.astype(self.dtype)
             y = apply_fn(p, x)
             if low_precision:
@@ -251,13 +259,21 @@ class ModelRuntime:
             isinstance(x, jax.Array)
             and not self._host_backend
             and not self._donate
-            # fast path only for signatures warmup compiled: dtype already
-            # the model's input dtype and the batch exactly a bucket —
-            # anything else falls through to the host normalization below
-            # (np.asarray on a device array is a READBACK; skipping it is
-            # the whole point of this branch)
-            and x.dtype
-            == (jnp.int32 if self.int_inputs == "ids" else jnp.dtype(self.dtype))
+            # fast path only for signatures warmup compiled: the model's
+            # input dtype — or float32 for low-precision models, since
+            # graph-internal hops deliver f32 (serving_fn casts in-jit and
+            # warmup compiles that signature) — and the batch exactly a
+            # bucket. Anything else falls through to the host normalization
+            # below (np.asarray on a device array is a READBACK; skipping
+            # it is the whole point of this branch)
+            and (
+                x.dtype == jnp.int32
+                if self.int_inputs == "ids"
+                else (
+                    x.dtype == jnp.dtype(self.dtype)
+                    or (self._low_precision and x.dtype == jnp.float32)
+                )
+            )
             and bucket_for(int(x.shape[0]), self.buckets) == int(x.shape[0])
             # placement: with a mesh, device_put below reshards any input;
             # without one, only accept inputs already on the params' device
@@ -328,7 +344,9 @@ class ModelRuntime:
         exactly: ids models compile int32 only (every wire form maps to
         it); value models compile the model float dtype, plus uint8 for
         image-shaped inputs (rank >= 2 features — tabular payloads always
-        normalize to the float form)."""
+        normalize to the float form), plus float32 for low-precision
+        models (graph-internal hops deliver f32 device arrays; the fast
+        path feeds them to the f32-input program, cast in-jit)."""
         feat_shape = self._example_feature_shape()
         if self.int_inputs == "ids":
             wire_dtypes = [np.int32]
@@ -342,6 +360,19 @@ class ModelRuntime:
                 x = np.zeros((b, *feat_shape), dtype=dt)
                 _ = self.predict(x[:1]) if first else self.predict(x)
                 first = False
+            if (
+                self._low_precision
+                and self.int_inputs != "ids"
+                and not self._host_backend
+                and not self._donate
+            ):
+                # the f32 graph-hop signature must be warmed THROUGH the
+                # device fast path: the host path would normalize f32 to
+                # the model dtype and compile the wrong program
+                y = self.predict_device(
+                    jnp.asarray(np.zeros((b, *feat_shape), np.float32))
+                )
+                jax.block_until_ready(y)
 
     def _example_feature_shape(self) -> tuple[int, ...]:
         shape = getattr(self, "feature_shape", None)

@@ -46,9 +46,24 @@ class LoadStats:
     started: float = 0.0
     finished: float = 0.0
     workers: int = 1
+    # completion timestamps (same clock as started), parallel to
+    # latencies_s: lets the rate count only requests that finished inside
+    # the intended window. Closed-loop users drain their LAST in-flight
+    # request after the deadline; a single multi-second stall (network
+    # hiccup, device preemption) would otherwise stretch the measured wall
+    # and poison the throughput 10-100x while every percentile stays sane.
+    completions_s: list[float] = field(default_factory=list)
+    deadline: float = 0.0  # perf_counter timestamp of intended window end
     # multiprocess mode: per-worker request counts, in worker order — lets
     # callers verify every worker's dump actually contributed to the merge
     worker_requests: list[int] = field(default_factory=list)
+    # multiprocess mode: sum of the workers' windowed rates (each worker
+    # computes its own window; the merged latency list spans all of them)
+    rps_override: float | None = None
+    # multiprocess mode: summed drain_requests across workers — the tail
+    # signal must survive the merge (a huge p99 with no drain count would
+    # be indistinguishable from slow steady-state latency)
+    drain_override: int = 0
 
     def percentile(self, q: float) -> float:
         if not self.latencies_s:
@@ -60,18 +75,34 @@ class LoadStats:
     def summary(self) -> dict:
         n = len(self.latencies_s)
         wall = max(self.finished - self.started, 1e-9)
-        return {
+        drain = 0
+        if self.rps_override is not None:
+            rps = self.rps_override
+            drain = self.drain_override
+        elif self.deadline and self.completions_s:
+            in_window = sum(1 for t in self.completions_s if t <= self.deadline)
+            drain = n - in_window
+            window = max(self.deadline - self.started, 1e-9)
+            rps = in_window / window
+        else:
+            rps = n / wall
+        out = {
             "requests": n,
             "errors": self.errors,
             "feedback_sent": self.feedback_sent,
             "duration_s": round(wall, 3),
-            "requests_per_sec": round(n / wall, 2),
+            "requests_per_sec": round(rps, 2),
             "p50_ms": round(self.percentile(50) * 1e3, 2),
             "p90_ms": round(self.percentile(90) * 1e3, 2),
             "p95_ms": round(self.percentile(95) * 1e3, 2),
             "p99_ms": round(self.percentile(99) * 1e3, 2),
             "workers": self.workers,
         }
+        if drain:
+            # requests that completed after the window (their latencies ARE
+            # in the percentiles; they just don't inflate the denominator)
+            out["drain_requests"] = drain
+        return out
 
 
 async def _fetch_token(session, base: str, key: str, secret: str) -> str:
@@ -256,9 +287,11 @@ async def _user(
             except Exception:  # noqa: BLE001
                 ok = False
                 body = {}
-            dt = time.perf_counter() - t0
+            done_at = time.perf_counter()
+            dt = done_at - t0
             if ok:
                 stats.latencies_s.append(dt)
+                stats.completions_s.append(done_at)
             else:
                 stats.errors += 1
 
@@ -315,6 +348,7 @@ async def run_load(
         headers["Authorization"] = f"Bearer {token}"
     stats.started = time.perf_counter()
     stop_at = stats.started + duration_s
+    stats.deadline = stop_at
     await asyncio.gather(
         *(
             _user(
@@ -420,6 +454,7 @@ def run_load_multiprocess(
 
         merged = LoadStats(workers=workers)
         walls: list[float] = []
+        rps_sum = 0.0
         deadline = duration_s + (timeout_s if timeout_s is not None else 120.0)
         try:
             for proc, dump in procs:
@@ -437,10 +472,15 @@ def run_load_multiprocess(
                 merged.errors += summary["errors"]
                 merged.feedback_sent += summary["feedback_sent"]
                 walls.append(summary["duration_s"])
+                rps_sum += summary["requests_per_sec"]
+                merged.drain_override += summary.get("drain_requests", 0)
                 n_before = len(merged.latencies_s)
                 if os.path.exists(dump):
                     merged.latencies_s.extend(np.load(dump).tolist())
                 merged.worker_requests.append(len(merged.latencies_s) - n_before)
+            # each worker reports a windowed rate over its own timing; the
+            # aggregate is their sum (workers run concurrently)
+            merged.rps_override = round(rps_sum, 2)
         finally:
             # one failed worker must not leave the rest hammering the target
             # (and unreaped) for the remaining duration

@@ -85,6 +85,31 @@ def test_donating_runtime_never_takes_fast_path():
     assert np.asarray(y).shape == (8, 3)
 
 
+def test_bf16_model_takes_fast_path_for_f32_graph_hops():
+    """Graph-internal hops deliver float32 (serving outputs are cast to f32
+    in-jit), so a bfloat16 model must accept f32 device arrays on the fast
+    path — the in-jit cast replaces the old host normalization (code-review
+    r4: without this the fast path was inert for every bf16 graph)."""
+    ms = get_model("iris_mlp")
+    rt = ModelRuntime(
+        ms.apply_fn,
+        ms.params,
+        buckets=(8,),
+        class_names=ms.class_names,
+        donate=False,
+        dtype=jnp.bfloat16,
+    )
+    rt.feature_shape = ms.feature_shape
+    rt.warmup()
+    x = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+    expect = np.asarray(rt.predict(x))  # host path (f32 -> bf16 on host)
+    rt._host_backend = False
+    y = rt.predict_device(jnp.asarray(x))
+    assert rt.stat_device_fastpath == 1
+    assert np.asarray(y).dtype == np.float32  # outputs stay f32 wire dtype
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-2, atol=1e-2)
+
+
 def test_graph_chain_passes_device_arrays_between_units():
     """A model unit receiving a jax.Array (e.g. from an upstream JAX node)
     hands it to the runtime without np.asarray-ing it first."""

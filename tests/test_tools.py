@@ -221,6 +221,28 @@ async def test_loadtest_multiprocess_workers_merge_stats():
         await runner.cleanup()
 
 
+def test_loadstats_windowed_rate_survives_drain_stall():
+    """One multi-second stall at the end of a closed-loop run must not
+    poison throughput: the rate counts completions inside the intended
+    window; drain-tail requests keep their (real) latencies in the
+    percentiles but stay out of the denominator."""
+    s = LoadStats()
+    s.started = 100.0
+    s.deadline = 110.0  # 10 s window
+    # 1000 requests completed in-window, 32 held hostage by a 90 s stall
+    s.latencies_s = [0.01] * 1000 + [90.0] * 32
+    s.completions_s = [100.0 + i * 0.01 for i in range(1000)] + [200.0] * 32
+    s.finished = 200.0  # last drain completion
+    out = s.summary()
+    assert out["requests"] == 1032
+    assert out["drain_requests"] == 32
+    assert out["requests_per_sec"] == 100.0  # 1000 / 10 s, NOT 1032 / 100 s
+    assert out["p99_ms"] >= 10000  # the stall is still visible in the tail
+    # no deadline set (direct construction): legacy wall-clock behavior
+    legacy = LoadStats(latencies_s=[0.01] * 10, started=0.0, finished=1.0)
+    assert legacy.summary()["requests_per_sec"] == 10.0
+
+
 def test_wrap_model_bundle(tmp_path):
     model_dir = tmp_path / "MyModel"
     model_dir.mkdir()
