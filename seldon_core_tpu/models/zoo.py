@@ -12,7 +12,9 @@ are JAX builders that the JAX_MODEL graph unit loads straight into HBM.
 
 from __future__ import annotations
 
+import inspect
 import urllib.parse
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping, Sequence
 
@@ -56,11 +58,57 @@ def register_model(name: str):
     return deco
 
 
+# Heavy builds are memoized per (name, builder-relevant kwargs): same-seed
+# builds are deterministic, params are treated as immutable downstream
+# (ModelRuntime casts/quantizes into NEW arrays; online fine-tuning rebinds
+# runtime.params, never writes through), so sharing the pytree is safe — and
+# re-initializing a ResNet50/BERT for every deployment of the same spec
+# costs tens of seconds of device time (e.g. an ensemble CR + its bench
+# rerun). Bounded LRU: the admission estimator also builds via get_model,
+# and an unbounded cache would retain every rejected spec's params forever.
+_HEAVY_CACHE: OrderedDict[tuple, ModelSpec] = OrderedDict()
+_HEAVY_CACHE_MAX = 4
+_CACHEABLE = frozenset({"resnet50", "bert_base"})
+
+
+def _heavy_cache_key(name: str, kwargs: dict) -> tuple | None:
+    """(name, kwargs restricted to the builder's own parameters) — callers
+    forward EVERY unit parameter (finetune_lr etc.) as builder kwargs and
+    the builders swallow unknowns via **_, so keying on the full dict would
+    duplicate bit-identical builds. None when any relevant value is
+    unhashable (build uncached)."""
+    sig = inspect.signature(_REGISTRY[name])
+    relevant = {
+        k: v
+        for k, v in kwargs.items()
+        if k in sig.parameters
+        and sig.parameters[k].kind is not inspect.Parameter.VAR_KEYWORD
+    }
+    key = (name, tuple(sorted(relevant.items())))
+    try:
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
 def get_model(name: str, **kwargs) -> ModelSpec:
     if name not in _REGISTRY:
         _register_heavy_models()
     if name not in _REGISTRY:
         raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
+    if name in _CACHEABLE:
+        key = _heavy_cache_key(name, kwargs)
+        if key is None:
+            return _REGISTRY[name](**kwargs)
+        if key in _HEAVY_CACHE:
+            _HEAVY_CACHE.move_to_end(key)
+            return _HEAVY_CACHE[key]
+        spec = _REGISTRY[name](**kwargs)
+        _HEAVY_CACHE[key] = spec
+        while len(_HEAVY_CACHE) > _HEAVY_CACHE_MAX:
+            _HEAVY_CACHE.popitem(last=False)
+        return spec
     return _REGISTRY[name](**kwargs)
 
 

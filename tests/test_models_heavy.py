@@ -25,6 +25,44 @@ def test_resnet_tiny_forward_shapes_and_probs():
     np.testing.assert_allclose(y.sum(axis=-1), 1.0, rtol=1e-4)
 
 
+def test_heavy_model_memo_shares_builds_and_respects_kwargs():
+    """resnet50/bert_base builds are memoized per (name, kwargs): two
+    deployments of the same spec share the params pytree (tens of seconds
+    of device init saved), different kwargs stay distinct, and non-heavy
+    models are never cached."""
+    from seldon_core_tpu.models.zoo import get_model
+
+    a = get_model("resnet50", seed=0, depth=18, width=8, image_size=32)
+    b = get_model("resnet50", seed=0, depth=18, width=8, image_size=32)
+    assert a is b
+    c = get_model("resnet50", seed=1, depth=18, width=8, image_size=32)
+    assert c is not a
+    # kwargs the builder ignores via **_ must not split the cache key
+    # (callers forward every unit parameter, e.g. finetune_lr)
+    d = get_model("resnet50", seed=0, depth=18, width=8, image_size=32,
+                  finetune_lr=0.01)
+    assert d is a
+    # unhashable value for a REAL builder param: builds uncached instead of
+    # raising (checkpoint metadata can replay arbitrary JSON kwargs)
+    e = get_model("resnet50", seed=0, depth=18, width=8, image_size=32,
+                  fold_bn=[True])
+    assert e is not a
+    i1 = get_model("iris_mlp")
+    i2 = get_model("iris_mlp")
+    assert i1 is not i2
+
+
+def test_heavy_model_cache_is_bounded():
+    """Rejected/undeployed specs must not grow host memory forever: the
+    memo is a small LRU (code-review r4)."""
+    from seldon_core_tpu.models import zoo
+
+    zoo._HEAVY_CACHE.clear()
+    for seed in range(zoo._HEAVY_CACHE_MAX + 3):
+        zoo.get_model("resnet50", seed=seed, depth=18, width=8, image_size=32)
+    assert len(zoo._HEAVY_CACHE) == zoo._HEAVY_CACHE_MAX
+
+
 def test_resnet_tiny_deterministic_across_builds():
     a = get_model("resnet_tiny", seed=7)
     b = get_model("resnet_tiny", seed=7)
