@@ -505,7 +505,8 @@ def serving_full_dag_chip(duration_s: float = 10.0) -> dict:
 
 
 async def _grpc_gateway_load(
-    predictor, *, users: int, batch: int, features: int, duration_s: float
+    predictor, *, users: int, batch: int, features, duration_s: float,
+    payload: str = "tensor",
 ) -> dict:
     """External gRPC hot path (reference SeldonGrpcServer.java:114-132):
     Seldon.Predict with oauth_token metadata through the gRPC gateway onto
@@ -541,8 +542,18 @@ async def _grpc_gateway_load(
 
     req = pb.SeldonMessage()
     rng = np.random.default_rng(0)
-    req.data.tensor.shape.extend([batch, features])
-    req.data.tensor.values.extend(rng.random(batch * features).tolist())
+    if payload == "npy_bindata":
+        # binary tensor wire over gRPC: npy bytes in the binData arm (the
+        # transport-agnostic image fast path)
+        from seldon_core_tpu.core.codec_npy import npy_from_array
+
+        shape = (batch, *tuple(features))
+        req.binData = npy_from_array(
+            rng.integers(0, 256, shape, dtype=np.uint8)
+        )
+    else:
+        req.data.tensor.shape.extend([batch, int(features)])
+        req.data.tensor.values.extend(rng.random(batch * int(features)).tolist())
     raw = req.SerializeToString()
 
     latencies: list[float] = []
@@ -598,6 +609,51 @@ async def _grpc_gateway_load(
         "batch_per_request": batch,
         "users": users,
         "wire": "grpc+proto",
+    }
+
+
+def _resnet_tiny_pred():
+    return _deployment(
+        {"model_uri": "zoo://resnet_tiny?seed=0"},
+        {"max_batch": 16, "batch_buckets": [16], "batch_timeout_ms": 5.0},
+    )
+
+
+def wire_matrix_cpu(duration_s: float = 5.0) -> dict:
+    """Which wire wins for image-class tensors (VERDICT r3 Next #6): the
+    SAME resnet_tiny deployment served over REST+npy and over gRPC with npy
+    binData, equal load. Small-tensor REST-vs-gRPC is the main `grpc` leg;
+    this completes the per-tensor-class guidance in
+    docs/reference/external-api.md with measured numbers."""
+    rest = asyncio.run(
+        _serve_gateway_and_load(
+            _resnet_tiny_pred(),
+            users=16,
+            batch=1,
+            features=(32, 32, 3),
+            duration_s=duration_s,
+            static_payload=True,
+            payload_format="npy",
+        )
+    )
+    grpc_leg = asyncio.run(
+        _grpc_gateway_load(
+            _resnet_tiny_pred(),
+            users=16,
+            batch=1,
+            features=(32, 32, 3),
+            duration_s=duration_s,
+            payload="npy_bindata",
+        )
+    )
+    return {
+        "model": "resnet_tiny_32x32x3_uint8",
+        "rest_npy_preds_per_sec": rest["preds_per_sec"],
+        "rest_npy_p99_ms": rest["p99_ms"],
+        "grpc_bindata_preds_per_sec": grpc_leg["preds_per_sec"],
+        "grpc_bindata_p99_ms": grpc_leg["p99_ms"],
+        "rest_npy_errors": rest["errors"],
+        "grpc_bindata_errors": grpc_leg["errors"],
     }
 
 
@@ -931,6 +987,8 @@ def main() -> None:
             )
         # external gRPC ingress (VERDICT r3 Next #6)
         out["grpc"] = serving_grpc_gateway(duration_s=6.0)
+        # image-class wire comparison: REST+npy vs gRPC binData, same model
+        out["wire_matrix"] = wire_matrix_cpu()
         out["multi_tenant"] = multi_tenant_cpu()
         out["multi_tenant_equal_users"] = multi_tenant_equal_users()
         print(json.dumps(out))

@@ -84,7 +84,17 @@ def _heavy_cache_key(name: str, kwargs: dict) -> tuple | None:
         if k in sig.parameters
         and sig.parameters[k].kind is not inspect.Parameter.VAR_KEYWORD
     }
-    key = (name, tuple(sorted(relevant.items())))
+    # normalize defaults so zoo://resnet50?space_to_depth=1 and
+    # zoo://resnet50?seed=0&space_to_depth=1 (bit-identical builds) share a
+    # key instead of occupying two LRU slots
+    bound = sig.bind_partial(**relevant)
+    bound.apply_defaults()
+    args = {
+        k: v
+        for k, v in bound.arguments.items()
+        if sig.parameters[k].kind is not inspect.Parameter.VAR_KEYWORD
+    }
+    key = (name, tuple(sorted(args.items())))
     try:
         hash(key)
     except TypeError:

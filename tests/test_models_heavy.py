@@ -42,6 +42,10 @@ def test_heavy_model_memo_shares_builds_and_respects_kwargs():
     d = get_model("resnet50", seed=0, depth=18, width=8, image_size=32,
                   finetune_lr=0.01)
     assert d is a
+    # default normalization: omitting an explicitly-defaulted kwarg is the
+    # same build (seed defaults to 0)
+    d2 = get_model("resnet50", depth=18, width=8, image_size=32)
+    assert d2 is a
     # unhashable value for a REAL builder param: builds uncached instead of
     # raising (checkpoint metadata can replay arbitrary JSON kwargs)
     e = get_model("resnet50", seed=0, depth=18, width=8, image_size=32,
