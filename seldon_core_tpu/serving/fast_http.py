@@ -56,6 +56,95 @@ def _status_line(code: int) -> bytes:
     return _STATUS_LINES.get(code) or f"HTTP/1.1 {code} Status\r\n".encode()
 
 
+class PyHead:
+    """One accepted request head from the pure-Python fallback parser."""
+
+    __slots__ = ("method", "path", "headers", "clen", "body_start")
+
+    def __init__(self, method, path, headers, clen, body_start):
+        self.method = method
+        self.path = path
+        self.headers = headers
+        self.clen = clen
+        self.body_start = body_start
+
+
+def parse_head_py(raw: bytes) -> "PyHead | int | tuple[int, bytes]":
+    """The fallback head parse + framing policy, as a PURE function.
+
+    Returns a PyHead (request accepted; body may still be streaming in), 0
+    (head incomplete — read more), or ``(status, message)`` to reject. This
+    is the semantic reference the C fast path (native/fastcodec.cpp
+    http_parse_head + HttpProtocol._dispatch_parsed's policy) must agree
+    with — tests/test_fast_http.py fuzzes the two against each other."""
+    head_end = raw.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(raw) > _MAX_HEADER:
+            return (400, b"header too large")
+        return 0
+    lines = raw[:head_end].split(b"\r\n")
+    if any(b"\n" in ln or b"\r" in ln for ln in lines):
+        # bare LF/CR anywhere in the head (request line included): an
+        # LF-tolerant front proxy would see an extra line (e.g. a hidden
+        # Transfer-Encoding header) where we see one — reject, matching
+        # the C parser's whole-head CRLF discipline
+        return (400, b"bad line terminator")
+    try:
+        method, path, _ = lines[0].decode("latin-1").split(" ", 2)
+    except ValueError:
+        return (400, b"bad request line")
+    if not method or not path:
+        return (400, b"bad request line")
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        if line[:1] in (b" ", b"\t"):
+            # obs-fold continuation, colon or not — same rule as the C
+            # parser (a colon-less fold would silently skip below)
+            return (400, b"bad header name")
+        k, sep, v = line.decode("latin-1").partition(":")
+        if not sep:
+            continue
+        if not k or any(c not in _TCHAR for c in k):
+            # RFC 7230 3.2.4/3.2.6: field-name must be pure token chars —
+            # rejects "Transfer-Encoding : chunked" (space before colon)
+            # and form-feed/NBSP/NUL variants, same as the C path
+            return (400, b"bad header name")
+        key = k.lower()
+        # OWS is SP/HT ONLY (RFC 7230 3.2.3): str.strip()'s wider notion of
+        # whitespace (form feed, vertical tab, NEL) would accept
+        # "Content-Length:\x0c10" that the C parser rejects — divergence in
+        # the desync family the fuzz test exists to catch
+        v = v.strip(" \t")
+        if key == "content-length":
+            if not (v.isascii() and v.isdigit()):
+                # digits-only: bare int() would also accept '+4', '-4',
+                # '1_0' and unicode digits, and a negative value slips
+                # past every downstream bound check
+                return (400, b"bad content-length")
+            if key in headers and int(headers[key]) != int(v):
+                # RFC 7230 3.3.2: differing duplicate Content-Length
+                # values MUST be rejected (CL.CL desync); numeric
+                # comparison so '4' vs '04' tolerates, like the C path
+                return (400, b"conflicting content-length")
+        headers[key] = v
+    if "transfer-encoding" in headers:
+        # same rule as the C path: any TE (chunked, "gzip, chunked", …) is
+        # rejected outright — never frame a TE request by CL
+        return (400, b"Transfer-Encoding not supported")
+    if "content-length" in headers:
+        clen = int(headers["content-length"])
+    elif method in ("GET", "HEAD", "DELETE"):
+        clen = 0
+    else:
+        # POST/PUT without Content-Length (incl. chunked): out of this
+        # server's contract — guessing clen=0 would misparse the body
+        # bytes as the next request line
+        return (411, b"Content-Length required")
+    if clen > _MAX_BODY:
+        return (413, b"body too large")
+    return PyHead(method, path, headers, clen, head_end + 4)
+
+
 class HttpProtocol(asyncio.Protocol):
     """One connection. Requests are processed strictly in order (no
     pipelining concurrency): parse -> schedule handler task -> write
@@ -93,8 +182,12 @@ class HttpProtocol(asyncio.Protocol):
         from seldon_core_tpu import native
 
         if self._pending_head is not None:
-            # head already parsed — only waiting on body bytes
-            self._dispatch_parsed(self._pending_head)
+            # head already parsed — only waiting on body bytes (either
+            # parser's head object; both cache here)
+            if isinstance(self._pending_head, PyHead):
+                self._dispatch_py(self._pending_head)
+            else:
+                self._dispatch_parsed(self._pending_head)
             return
         # only the head region crosses into C: copying the whole buffer
         # would make chunked large-body uploads O(n^2) in memcpy
@@ -167,94 +260,33 @@ class HttpProtocol(asyncio.Protocol):
         task.add_done_callback(self._on_handler_done)
 
     def _try_dispatch_py(self) -> None:
+        # only the head region is copied/parsed — slicing the whole buffer
+        # would make large-body uploads O(n^2) in memcpy per TCP chunk
+        parsed = parse_head_py(bytes(self._buf[: _MAX_HEADER + 4]))
+        if parsed == 0:
+            return  # head incomplete; wait for more data
+        if isinstance(parsed, tuple):
+            status, text = parsed
+            self._respond_simple(status, text)
+            self._close()
+            return
+        self._dispatch_py(parsed)
+
+    def _dispatch_py(self, parsed: "PyHead") -> None:
         buf = self._buf
-        head_end = buf.find(b"\r\n\r\n")
-        if head_end < 0:
-            if len(buf) > _MAX_HEADER:
-                self._respond_simple(400, b"header too large")
-                self._close()
-            return
-        head = bytes(buf[:head_end])
-        lines = head.split(b"\r\n")
-        if any(b"\n" in ln or b"\r" in ln for ln in lines):
-            # bare LF/CR anywhere in the head (request line included): an
-            # LF-tolerant front proxy would see an extra line (e.g. a hidden
-            # Transfer-Encoding header) where we see one — reject, matching
-            # the C parser's whole-head CRLF discipline
-            self._respond_simple(400, b"bad line terminator")
-            self._close()
-            return
-        try:
-            method, path, _ = lines[0].decode("latin-1").split(" ", 2)
-        except ValueError:
-            self._respond_simple(400, b"bad request line")
-            self._close()
-            return
-        headers: dict[str, str] = {}
-        for line in lines[1:]:
-            if line[:1] in (b" ", b"\t"):
-                # obs-fold continuation, colon or not — same rule as the C
-                # parser (a colon-less fold would silently skip below)
-                self._respond_simple(400, b"bad header name")
-                self._close()
-                return
-            k, sep, v = line.decode("latin-1").partition(":")
-            if not sep:
-                continue
-            if not k or any(c not in _TCHAR for c in k):
-                # RFC 7230 3.2.4/3.2.6: field-name must be pure token chars
-                # — rejects "Transfer-Encoding : chunked" (space before
-                # colon) and form-feed/NBSP/NUL variants, same as the C path
-                self._respond_simple(400, b"bad header name")
-                self._close()
-                return
-            key = k.lower()
-            v = v.strip()
-            if key == "content-length":
-                if not (v.isascii() and v.isdigit()):
-                    self._respond_simple(400, b"bad content-length")
-                    self._close()
-                    return
-                if key in headers and int(headers[key]) != int(v):
-                    # RFC 7230 3.3.2: differing duplicate Content-Length
-                    # values MUST be rejected (CL.CL desync); numeric
-                    # comparison so '4' vs '04' tolerates, like the C path
-                    self._respond_simple(400, b"conflicting content-length")
-                    self._close()
-                    return
-            headers[key] = v
-        if "transfer-encoding" in headers:
-            # same rule as the C path: any TE (chunked, "gzip, chunked", …)
-            # is rejected outright — never frame a TE request by CL
-            self._respond_simple(400, b"Transfer-Encoding not supported")
-            self._close()
-            return
-        if "content-length" in headers:
-            cl_raw = headers["content-length"]
-            # digits-only, same rule as the C parser: bare int() would also
-            # accept '+4', '-4', '1_0' and unicode digits, and a negative
-            # value slips past every downstream bound check
-            if not (cl_raw.isascii() and cl_raw.isdigit()):
-                self._respond_simple(400, b"bad content-length")
-                self._close()
-                return
-            clen = int(cl_raw)
-        elif method in ("GET", "HEAD", "DELETE"):
-            clen = 0
-        else:
-            # POST/PUT without Content-Length (incl. chunked): out of this
-            # server's contract — guessing clen=0 would misparse the body
-            # bytes as the next request line
-            self._respond_simple(411, b"Content-Length required")
-            self._close()
-            return
-        if clen > _MAX_BODY:
-            self._respond_simple(413, b"body too large")
-            self._close()
-            return
-        body_start = head_end + 4
+        method, path, headers, clen, body_start = (
+            parsed.method,
+            parsed.path,
+            parsed.headers,
+            parsed.clen,
+            parsed.body_start,
+        )
         if len(buf) - body_start < clen:
-            return  # body incomplete; wait for more data
+            # wait for the body; cache the parse (mirrors the C path — a
+            # large upload must not re-copy + re-parse per TCP chunk)
+            self._pending_head = parsed
+            return
+        self._pending_head = None
         body = bytes(buf[body_start : body_start + clen])
         del buf[: body_start + clen]
 

@@ -417,6 +417,114 @@ async def test_transfer_encoding_with_content_length_rejected():
             native.parse_http_head = orig
 
 
+def test_c_and_python_parsers_agree_fuzz():
+    """The C fast-path parser and the pure-Python fallback are two
+    implementations of ONE wire contract (fast_http.parse_head_py is the
+    semantic reference). Fuzz thousands of randomized/mutated requests and
+    require the two to agree on the verdict — accept (with equal
+    method/path/clen/keep-alive) vs reject vs incomplete. Every smuggling
+    fix this round came from a divergence between the two; this pins the
+    lockstep invariant."""
+    import random
+
+    import pytest
+
+    from seldon_core_tpu import native
+    from seldon_core_tpu.serving.fast_http import _MAX_BODY, PyHead, parse_head_py
+
+    if not native.available():
+        pytest.skip("no native lib")
+
+    def c_verdict(raw: bytes):
+        h = native.parse_http_head(raw)
+        if h is None:
+            return None  # C declines (oversized auth/ctype): Python handles
+        if h == 0:
+            return ("incomplete",)
+        if h == -1:
+            return ("reject",)
+        # the dispatch policy applied to the parse (_dispatch_parsed)
+        if h.flags & native.HDRF_HAS_TE:
+            return ("reject",)
+        if h.flags & native.HDRF_HAS_CLEN:
+            clen = h.content_length
+        elif h.method in ("GET", "HEAD", "DELETE"):
+            clen = 0
+        else:
+            return ("reject",)
+        if clen > _MAX_BODY:
+            return ("reject",)
+        keep_alive = not (h.flags & native.HDRF_CONN_CLOSE)
+        return ("accept", h.method, h.path, clen, keep_alive)
+
+    def py_verdict(raw: bytes):
+        p = parse_head_py(raw)
+        if p == 0:
+            return ("incomplete",)
+        if isinstance(p, tuple):
+            return ("reject",)
+        assert isinstance(p, PyHead)
+        keep_alive = p.headers.get("connection", "").lower() != "close"
+        return ("accept", p.method, p.path, p.clen, keep_alive)
+
+    rng = random.Random(1337)
+    methods = ["GET", "POST", "PUT", "HEAD", "DELETE", "PATCH", "G\x00T", ""]
+    paths = ["/", "/api/v0.1/predictions", "/p?x=1", "/a b", ""]
+    versions = ["HTTP/1.1", "HTTP/1.0", "", "HTTP/9.9"]
+    header_pool = [
+        b"Host: t",
+        b"Content-Type: application/json",
+        b"Content-Length: 4",
+        b"Content-Length: 04",
+        b"Content-Length: 10",
+        b"Content-Length: -4",
+        b"Content-Length: +4",
+        b"Content-Length: 1_0",
+        b"Content-Length: 99999999999999999999",
+        b"Content-Length:\x0c10",  # form-feed "whitespace": str.strip()
+        b"Content-Length:\x0b4",  # would accept these; OWS (SP/HT) must not
+        b"content-LENGTH: 4",
+        b"Transfer-Encoding: chunked",
+        b"Transfer-Encoding: gzip, chunked",
+        b"transfer-encoding: IDENTITY",
+        b"Transfer-Encoding : chunked",
+        b"Transfer-Encoding\x0c: chunked",
+        b" Transfer-Encoding: chunked",
+        b"X-A: a\nTransfer-Encoding: chunked",
+        b"X-B: b\rX-C: c",
+        b"Connection: close",
+        b"Connection: keep-alive",
+        b"Authorization: Bearer tok",
+        b"colonless line",
+        b"Bad Name: v",
+        b"\x00: v",
+        b": empty-name",
+        b"X-Long: " + b"v" * 600,
+    ]
+    mismatches = []
+    for i in range(4000):
+        req_line = (
+            f"{rng.choice(methods)} {rng.choice(paths)} {rng.choice(versions)}"
+            .encode("latin-1")
+        )
+        n_headers = rng.randrange(0, 6)
+        lines = [req_line] + [rng.choice(header_pool) for _ in range(n_headers)]
+        raw = b"\r\n".join(lines) + b"\r\n\r\n" + b"body-bytes-here"
+        if rng.random() < 0.15:
+            raw = raw[: rng.randrange(0, len(raw))]  # truncation: incomplete
+        if rng.random() < 0.25 and raw:
+            # random single-byte mutation anywhere in the head
+            pos = rng.randrange(0, min(len(raw), 80))
+            raw = raw[:pos] + bytes([rng.randrange(0, 256)]) + raw[pos + 1 :]
+        c = c_verdict(raw)
+        if c is None:
+            continue
+        p = py_verdict(raw)
+        if c != p:
+            mismatches.append((raw[:120], c, p))
+    assert not mismatches, mismatches[:5]
+
+
 async def test_post_without_content_length_is_411():
     server, port = await _fast_engine()
     try:
