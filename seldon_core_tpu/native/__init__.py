@@ -244,6 +244,38 @@ class ParsedHead:
         self.authorization = authorization  # raw value or None
 
 
+# single source of truth for the head-parse out-buffer capacities: the
+# scratch allocation, the caps passed to C, and the truncation checks must
+# move together (a cap raised past the allocation would make the C memcpy a
+# heap overflow)
+_CTYPE_CAP = 512
+_AUTH_CAP = 4096
+
+_parse_tls = threading.local()
+
+
+def _parse_scratch():
+    """Per-thread reusable ctypes out-params for parse_http_head: the hot
+    path calls it once per request, and allocating two string buffers plus
+    eight ctypes scalars each time measured ~25 us/request of pure wrapper
+    overhead on the serving profile."""
+    s = getattr(_parse_tls, "scratch", None)
+    if s is None:
+        s = (
+            ctypes.c_long(),  # method_len
+            ctypes.c_long(),  # path_off
+            ctypes.c_long(),  # path_len
+            ctypes.c_longlong(),  # clen
+            ctypes.c_long(),  # flags
+            ctypes.create_string_buffer(_CTYPE_CAP),
+            ctypes.c_long(),  # ctype_len
+            ctypes.create_string_buffer(_AUTH_CAP),
+            ctypes.c_long(),  # auth_len
+        )
+        _parse_tls.scratch = s
+    return s
+
+
 def parse_http_head(buf) -> "ParsedHead | int | None":
     """Parse an HTTP/1.1 request head in one C pass.
 
@@ -254,27 +286,30 @@ def parse_http_head(buf) -> "ParsedHead | int | None":
     if lib is None:
         return None
     raw = bytes(buf)
-    method_len = ctypes.c_long()
-    path_off, path_len = ctypes.c_long(), ctypes.c_long()
-    clen = ctypes.c_longlong()
-    flags = ctypes.c_long()
-    ctype_buf = ctypes.create_string_buffer(512)
-    ctype_len = ctypes.c_long()
-    auth_buf = ctypes.create_string_buffer(4096)
-    auth_len = ctypes.c_long()
+    (
+        method_len,
+        path_off,
+        path_len,
+        clen,
+        flags,
+        ctype_buf,
+        ctype_len,
+        auth_buf,
+        auth_len,
+    ) = _parse_scratch()
     rc = lib.http_parse_head(
         raw, len(raw),
         ctypes.byref(method_len),
         ctypes.byref(path_off), ctypes.byref(path_len),
         ctypes.byref(clen), ctypes.byref(flags),
-        ctype_buf, 512, ctypes.byref(ctype_len),
-        auth_buf, 4096, ctypes.byref(auth_len),
+        ctype_buf, _CTYPE_CAP, ctypes.byref(ctype_len),
+        auth_buf, _AUTH_CAP, ctypes.byref(auth_len),
     )
     if rc == 0:
         return 0
     if rc < 0:
         return -1
-    if ctype_len.value >= 512 or auth_len.value >= 4096:
+    if ctype_len.value >= _CTYPE_CAP or auth_len.value >= _AUTH_CAP:
         # possible truncation (oversized JWTs etc.): a clipped credential
         # would 401 on this path but pass the Python parse — hand the
         # request to the uncapped Python parser instead
