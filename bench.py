@@ -836,6 +836,18 @@ def multi_tenant_equal_users(duration_s: float = 6.0) -> dict:
     return asyncio.run(_multi_tenant_load(duration_s, 3, 11))
 
 
+def multi_tenant_homogeneous(duration_s: float = 6.0) -> dict:
+    """Framework multi-tenancy overhead in isolation: 3 tenants of the SAME
+    iris-scale model at equal total users. The mixed config above carries a
+    784-feature tenant whose model compute shares the host core under the
+    CPU bench (on-device on a real TPU) — this leg removes that term, so
+    its aggregate/ceiling ratio is the per-deployment fixed cost itself
+    (PARITY.md multi-tenant attribution, term 3)."""
+    return asyncio.run(
+        _multi_tenant_load(duration_s, 3, 11, models=["iris_mlp"] * 3)
+    )
+
+
 def multi_tenant_cpu(duration_s: float = 6.0, n_tenants: int = 3, users_each: int = 8) -> dict:
     return asyncio.run(_multi_tenant_load(duration_s, n_tenants, users_each))
 
@@ -991,6 +1003,7 @@ def main() -> None:
         out["wire_matrix"] = wire_matrix_cpu()
         out["multi_tenant"] = multi_tenant_cpu()
         out["multi_tenant_equal_users"] = multi_tenant_equal_users()
+        out["multi_tenant_homogeneous"] = multi_tenant_homogeneous()
         print(json.dumps(out))
         return
 

@@ -313,8 +313,9 @@ def parse_http_head(buf) -> "ParsedHead | int | None":
         # possible truncation (oversized JWTs etc.): a clipped credential
         # would 401 on this path but pass the Python parse — hand the
         # request to the uncapped Python parser instead
+        ctypes.memset(auth_buf, 0, _AUTH_CAP)
         return None
-    return ParsedHead(
+    head = ParsedHead(
         body_start=int(rc),
         method=raw[: method_len.value].decode("latin-1"),
         path=raw[path_off.value : path_off.value + path_len.value].decode("latin-1"),
@@ -331,3 +332,9 @@ def parse_http_head(buf) -> "ParsedHead | int | None":
             else None
         ),
     )
+    if auth_len.value > 0:
+        # the reused scratch must not retain the client's credential past
+        # the request (a core dump would otherwise hold the latest JWT per
+        # thread at a stable address)
+        ctypes.memset(auth_buf, 0, auth_len.value)
+    return head
