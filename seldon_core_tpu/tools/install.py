@@ -138,6 +138,7 @@ def platform_deployment(
     pull_policy: str = "IfNotPresent",
     service_type: str = "",
     storage: dict | None = None,
+    autoscaling: dict | None = None,
 ) -> list[dict]:
     """The platform pod hosts the engines, so IT is the pod that needs the
     chips: with tpu_chips > 0 it gets GKE TPU node selectors + a
@@ -145,6 +146,7 @@ def platform_deployment(
     (when enabled) mounts the seldon-models PVC (storage_manifests) at its
     mount_path so file:// checkpoint URIs resolve to durable volume paths."""
     pod_spec: dict = {"serviceAccountName": "seldon-core-tpu"}
+    autoscaled = bool(autoscaling and autoscaling.get("enabled"))
     volumes: list[dict] = []
     volume_mounts: list[dict] = []
     if storage and storage.get("enabled"):
@@ -172,13 +174,23 @@ def platform_deployment(
             "cloud.google.com/gke-tpu-topology": topology,
         }
         resources = {"limits": {"google.com/tpu": str(chips)}}
+    if autoscaled:
+        # the HPA's cpu Utilization target is usage/REQUEST — without a cpu
+        # request the controller reports FailedGetResourceMetric and never
+        # scales
+        resources.setdefault("requests", {})["cpu"] = str(
+            autoscaling.get("cpu_request", "1")
+        )
     return [
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
             "metadata": {"name": "seldon-core-tpu-platform", "namespace": namespace},
             "spec": {
-                "replicas": 1,
+                # under an HPA, spec.replicas must be OMITTED: a bundle
+                # re-apply would otherwise snap a scaled-up platform back
+                # to 1 replica, killing serving pods mid-traffic
+                **({} if autoscaled else {"replicas": 1}),
                 "selector": {"matchLabels": {"app": "seldon-core-tpu-platform"}},
                 "template": {
                     "metadata": {
@@ -256,6 +268,48 @@ def platform_deployment(
                 **({"type": service_type} if service_type else {}),
             },
         },
+    ]
+
+
+def autoscaling_manifests(namespace: str, autoscaling: dict) -> list[dict]:
+    """HorizontalPodAutoscaler for the platform Deployment (the reference
+    scales by hand-set `replicas`; this is the modern automatic variant).
+    Multi-replica platform is coherent when shared state is externalized:
+    tokens in redis (`oauth.token_store: redis://...`), audit in kafka, and
+    every replica reconciles the same CRs from its own watch. Each replica
+    schedules onto its own TPU slice via the node selectors."""
+    return [
+        {
+            "apiVersion": "autoscaling/v2",
+            "kind": "HorizontalPodAutoscaler",
+            "metadata": {
+                "name": "seldon-core-tpu-platform",
+                "namespace": namespace,
+            },
+            "spec": {
+                "scaleTargetRef": {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "name": "seldon-core-tpu-platform",
+                },
+                "minReplicas": int(autoscaling.get("min_replicas", 1)),
+                "maxReplicas": int(autoscaling.get("max_replicas", 4)),
+                "metrics": [
+                    {
+                        "type": "Resource",
+                        "resource": {
+                            "name": "cpu",
+                            "target": {
+                                "type": "Utilization",
+                                "averageUtilization": int(
+                                    autoscaling.get("target_cpu_percent", 80)
+                                ),
+                            },
+                        },
+                    }
+                ],
+            },
+        }
     ]
 
 
@@ -830,6 +884,15 @@ DEFAULT_VALUES: dict = {
         "host_path": "",
         "mount_path": "/var/seldon/models",
     },
+    # reference goal "scale up/down" (docs/challenges.md): replicas by hand
+    # there; automatic here. Requires externalized shared state for >1
+    # replica (redis token store, kafka audit) — see autoscaling_manifests.
+    "autoscaling": {
+        "enabled": False,
+        "min_replicas": 1,
+        "max_replicas": 4,
+        "target_cpu_percent": 80,
+    },
     # reference monitoring/ + seldon-core-analytics chart: prometheus +
     # alertmanager + grafana with the serving rules/dashboard wired in
     "monitoring": {
@@ -890,6 +953,16 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
     if v["rbac"]:
         bundle += rbac(namespace)
     p = v["platform"]
+    if v["autoscaling"]["enabled"] and int(v["autoscaling"]["max_replicas"]) > 1:
+        # multi-replica platform needs externalized token state: replica B
+        # must accept tokens issued by replica A (same precedent as
+        # loadtest_job's half-configured-oauth rejection)
+        if not v["redis"]["enabled"]:
+            raise ValueError(
+                "autoscaling with max_replicas > 1 requires redis.enabled "
+                "(shared OAuth token store); in-memory tokens would be "
+                "rejected across replicas"
+            )
     bundle += platform_deployment(
         namespace,
         p["image"],
@@ -897,9 +970,12 @@ def build_bundle_from_values(values: dict | None = None) -> list[dict]:
         pull_policy=p["pull_policy"],
         service_type=p["service_type"],
         storage=v["storage"],
+        autoscaling=v["autoscaling"],
     )
     if v["storage"]["enabled"]:
         bundle += storage_manifests(namespace, v["storage"])
+    if v["autoscaling"]["enabled"]:
+        bundle += autoscaling_manifests(namespace, v["autoscaling"])
     if v["redis"]["enabled"]:
         bundle += redis_manifests(namespace)
     if v["monitoring"]["enabled"]:

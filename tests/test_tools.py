@@ -578,3 +578,46 @@ def test_install_storage_pvc_and_hostpath_pv():
         m for m in bundle if m["metadata"]["name"] == "seldon-core-tpu-platform"
     )
     assert "volumes" not in platform["spec"]["template"]["spec"]
+
+
+def test_install_autoscaling_hpa():
+    """Values-gated HPA targeting the platform Deployment (the reference's
+    hand-set replicas, automated). HPA-managed Deployments must omit
+    spec.replicas, carry a cpu request (utilization = usage/request), and
+    multi-replica requires the shared redis token store."""
+    import pytest
+
+    from seldon_core_tpu.tools.install import build_bundle_from_values
+
+    bundle = build_bundle_from_values(
+        {
+            "autoscaling": {"enabled": True, "min_replicas": 2, "max_replicas": 6},
+            "redis": {"enabled": True},
+        }
+    )
+    hpa = next(m for m in bundle if m["kind"] == "HorizontalPodAutoscaler")
+    assert hpa["spec"]["scaleTargetRef"]["name"] == "seldon-core-tpu-platform"
+    assert hpa["spec"]["minReplicas"] == 2
+    assert hpa["spec"]["maxReplicas"] == 6
+    assert (
+        hpa["spec"]["metrics"][0]["resource"]["target"]["averageUtilization"] == 80
+    )
+    platform = next(
+        m for m in bundle if m["metadata"]["name"] == "seldon-core-tpu-platform"
+    )
+    # replicas omitted (a re-apply must not snap the HPA's count back to 1)
+    assert "replicas" not in platform["spec"]
+    container = platform["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["requests"]["cpu"] == "1"
+
+    # in-memory tokens across replicas would be rejected: enforced
+    with pytest.raises(ValueError, match="redis.enabled"):
+        build_bundle_from_values({"autoscaling": {"enabled": True}})
+
+    # off by default, and the non-autoscaled Deployment keeps replicas: 1
+    bundle = build_bundle_from_values({})
+    assert not any(m["kind"] == "HorizontalPodAutoscaler" for m in bundle)
+    platform = next(
+        m for m in bundle if m["metadata"]["name"] == "seldon-core-tpu-platform"
+    )
+    assert platform["spec"]["replicas"] == 1
