@@ -58,6 +58,26 @@ _CRD_TEMPLATE = {
                     }
                 },
                 "subresources": {"status": {}},
+                # `kubectl get sdep` shows rollout state at a glance — the
+                # columns mirror the operator's status writeback fields
+                "additionalPrinterColumns": [
+                    {
+                        "name": "State",
+                        "type": "string",
+                        "jsonPath": ".status.state",
+                    },
+                    {
+                        "name": "Description",
+                        "type": "string",
+                        "priority": 1,
+                        "jsonPath": ".status.description",
+                    },
+                    {
+                        "name": "Age",
+                        "type": "date",
+                        "jsonPath": ".metadata.creationTimestamp",
+                    },
+                ],
             }
         ],
     },

@@ -74,6 +74,10 @@ def test_install_bundle_manifests():
     assert kinds.count("Deployment") == 2  # platform + redis
     crd = next(m for m in bundle if m["kind"] == "CustomResourceDefinition")
     assert crd["spec"]["names"]["shortNames"] == ["sdep"]  # reference parity
+    # `kubectl get sdep` columns mirror the status writeback fields
+    cols = crd["spec"]["versions"][0]["additionalPrinterColumns"]
+    assert [c["name"] for c in cols] == ["State", "Description", "Age"]
+    assert cols[0]["jsonPath"] == ".status.state"
     # the rendered YAML must round-trip
     docs = list(yaml.safe_load_all(to_yaml(bundle)))
     assert len(docs) == len(bundle)
