@@ -829,14 +829,14 @@ async def _multi_tenant_load(
     }
 
 
-def multi_tenant_equal_users(duration_s: float = 6.0) -> dict:
+def multi_tenant_equal_users(duration_s: float = 8.0) -> dict:
     """The r3 VERDICT comparison: 3 tenants at the SAME total closed-loop
     users as the single-tenant ceiling (32 -> 11/11/10), so the aggregate is
     an apples-to-apples fraction of the ceiling."""
     return asyncio.run(_multi_tenant_load(duration_s, 3, 11))
 
 
-def multi_tenant_homogeneous(duration_s: float = 6.0) -> dict:
+def multi_tenant_homogeneous(duration_s: float = 8.0) -> dict:
     """Framework multi-tenancy overhead in isolation: 3 tenants of the SAME
     iris-scale model at equal total users. The mixed config above carries a
     784-feature tenant whose model compute shares the host core under the
@@ -848,7 +848,7 @@ def multi_tenant_homogeneous(duration_s: float = 6.0) -> dict:
     )
 
 
-def multi_tenant_cpu(duration_s: float = 6.0, n_tenants: int = 3, users_each: int = 8) -> dict:
+def multi_tenant_cpu(duration_s: float = 8.0, n_tenants: int = 3, users_each: int = 8) -> dict:
     return asyncio.run(_multi_tenant_load(duration_s, n_tenants, users_each))
 
 
