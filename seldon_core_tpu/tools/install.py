@@ -298,7 +298,28 @@ def autoscaling_manifests(namespace: str, autoscaling: dict) -> list[dict]:
     tokens in redis (`oauth.token_store: redis://...`), audit in kafka, and
     every replica reconciles the same CRs from its own watch. Each replica
     schedules onto its own TPU slice via the node selectors."""
-    return [
+    out: list[dict] = []
+    if int(autoscaling.get("max_replicas", 4)) > 1:
+        # the multi-replica envelope is max_replicas (the HPA can be scaled
+        # up from min=1): the PDB keeps voluntary evictions (node drain,
+        # cluster upgrade) from taking every serving pod at once
+        out.append(
+            {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {
+                    "name": "seldon-core-tpu-platform",
+                    "namespace": namespace,
+                },
+                "spec": {
+                    "minAvailable": 1,
+                    "selector": {
+                        "matchLabels": {"app": "seldon-core-tpu-platform"}
+                    },
+                },
+            }
+        )
+    return out + [
         {
             "apiVersion": "autoscaling/v2",
             "kind": "HorizontalPodAutoscaler",

@@ -614,10 +614,37 @@ def test_install_autoscaling_hpa():
     with pytest.raises(ValueError, match="redis.enabled"):
         build_bundle_from_values({"autoscaling": {"enabled": True}})
 
+    # any multi-replica envelope (max_replicas > 1) renders a PDB so
+    # voluntary evictions can't take every serving pod at once
+    assert any(m["kind"] == "PodDisruptionBudget" for m in bundle)
+    # the gate boundary: max_replicas == 1 means no PDB (minAvailable 1
+    # would block drains of the only pod)
+    single = build_bundle_from_values(
+        {"autoscaling": {"enabled": True, "max_replicas": 1}}
+    )
+    assert not any(m["kind"] == "PodDisruptionBudget" for m in single)
+
     # off by default, and the non-autoscaled Deployment keeps replicas: 1
     bundle = build_bundle_from_values({})
     assert not any(m["kind"] == "HorizontalPodAutoscaler" for m in bundle)
+    assert not any(m["kind"] == "PodDisruptionBudget" for m in bundle)
     platform = next(
         m for m in bundle if m["metadata"]["name"] == "seldon-core-tpu-platform"
     )
     assert platform["spec"]["replicas"] == 1
+
+    # the shipped production values example renders everything cleanly
+    import yaml as _yaml
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "..", "deploy",
+                     "values-production.yaml")
+    ) as f:
+        prod = _yaml.safe_load(f)
+    bundle = build_bundle_from_values(prod)
+    kinds = {m["kind"] for m in bundle}
+    for expected in (
+        "HorizontalPodAutoscaler", "PodDisruptionBudget",
+        "PersistentVolumeClaim", "CustomResourceDefinition",
+    ):
+        assert expected in kinds, expected
