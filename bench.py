@@ -1,9 +1,12 @@
 """Benchmark: kernel + serving-path throughput/latency on the accelerator.
 
-Prints ONE JSON line. Primary fields keep the driver contract
-({"metric", "value", "unit", "vs_baseline"}); the additional "serving" and
-"floors" objects carry the platform measurements BASELINE.md asks for
-(SeldonDeployment preds/s AND p99, loadtest-style).
+Prints ONE JSON line — COMPACT (< ~1800 bytes, unit-tested in
+tests/test_bench_record.py): the driver records only the last 2,000 bytes of
+stdout, and rounds 3-4 lost most of their headline numbers to that cap
+(BENCH_r04.json `parsed: null`, tail truncated). The final stdout line keeps
+the driver contract ({"metric", "value", "unit", "vs_baseline"}) and carries
+every headline figure in abbreviated form (see compact_record); the FULL
+record goes to stderr and to BENCH_DETAIL.json next to this file.
 
 Baseline: the north-star target is 10,000 predictions/sec at p99 < 50 ms on
 a v5e-8 (BASELINE.md:29-33). This harness has ONE chip, so vs_baseline
@@ -943,6 +946,135 @@ def stack_ceiling_subprocess() -> dict | None:
     return None
 
 
+def _row(leg) -> list | None:
+    """[preds/s, p50_ms, p99_ms, errors] — the per-leg headline quartet."""
+    if not isinstance(leg, dict) or "preds_per_sec" not in leg:
+        return None
+    return [
+        leg.get("preds_per_sec"),
+        leg.get("p50_ms"),
+        leg.get("p99_ms"),
+        leg.get("errors"),
+    ]
+
+
+def compact_record(full: dict) -> dict:
+    """Compress the full bench record to the one-line driver artifact.
+
+    The driver keeps only the LAST 2,000 bytes of stdout; rounds 3-4 lost
+    their headline numbers to that cap (BENCH_r04.json parsed:null). This
+    mapping is pure and unit-tested against a worst-case record
+    (tests/test_bench_record.py) to stay under 1,800 serialized bytes while
+    carrying EVERY figure README/PARITY cite: kernel, stack ceiling, abtest,
+    grpc, fused/unfused combiner + fusion_speedup, full DAG, wire matrix,
+    multi-tenant aggregates (hetero + homo) + loop lag, loadgen sweep,
+    pallas-vs-blockwise, MoE, BERT MFU, floors."""
+    c = {k: full[k] for k in ("metric", "value", "unit", "vs_baseline") if k in full}
+    c["legend"] = "[preds/s,p50_ms,p99_ms,errs]"
+    srv = full.get("serving") or {}
+    s: dict = {}
+    for key, short in (
+        ("iris_chip", "iris"),
+        ("resnet50_chip", "rn50"),
+        ("bert_base_chip", "bert"),
+        ("combiner_fused", "comb_fused"),
+        ("full_dag", "full_dag"),
+        ("abtest", "abtest"),
+        ("grpc", "grpc"),
+        ("moe_cpu", "moe"),
+    ):
+        row = _row(srv.get(key))
+        if row is not None:
+            s[short] = row
+    comb = srv.get("combiner_fused") or {}
+    if "unfused_preds_per_sec" in comb:
+        # same 4-slot legend as every row; the chip leg records no unfused
+        # p50, so that slot is null rather than shifting p99 into it
+        s["comb_unfused"] = [
+            comb["unfused_preds_per_sec"],
+            comb.get("unfused_p50_ms"),
+            comb.get("unfused_p99_ms"),
+            comb.get("unfused_errors"),
+        ]
+    bert = srv.get("bert_base_chip") or {}
+    for k in ("tflops", "mfu_pct"):
+        if k in bert:
+            c[f"bert_{k}"] = bert[k]
+    ceiling = srv.get("stack_ceiling_cpu") or {}
+    row = _row(ceiling)
+    if row is not None:
+        s["ceiling"] = row
+    sweep = ceiling.get("loadgen_sweep") or {}
+    if sweep:
+        c["sweep_w1_w2"] = [
+            sweep.get("workers_1_preds_per_sec"),
+            sweep.get("workers_2_preds_per_sec"),
+        ]
+    fusion = ceiling.get("combiner_ratio_cpu") or {}
+    if fusion:
+        c["fusion_cpu"] = {
+            "fused": fusion.get("fused_preds_per_sec"),
+            "unfused": fusion.get("unfused_preds_per_sec"),
+            "speedup": fusion.get("fusion_speedup"),
+        }
+    wire = ceiling.get("wire_matrix") or {}
+    if wire:
+        c["wire"] = {
+            "rest_npy": wire.get("rest_npy_preds_per_sec"),
+            "grpc_bin": wire.get("grpc_bindata_preds_per_sec"),
+        }
+    mt = ceiling.get("multi_tenant_equal_users") or {}
+    homo = ceiling.get("multi_tenant_homogeneous") or {}
+    if mt or homo:
+        def _tenant_p99s(leg: dict) -> list:
+            # per-tenant isolation figures the docs cite, in tenant order
+            tenants = leg.get("tenants") or {}
+            return [tenants[k].get("p99_ms") for k in sorted(tenants)]
+
+        c["mt"] = {
+            "agg": mt.get("aggregate_preds_per_sec"),
+            "homo_agg": homo.get("aggregate_preds_per_sec"),
+            "p99s": _tenant_p99s(mt),
+            "homo_p99s": _tenant_p99s(homo),
+            "lag_max_ms": [mt.get("loop_lag_max_ms"), homo.get("loop_lag_max_ms")],
+        }
+    pallas = srv.get("pallas_long_seq") or {}
+    if pallas:
+        # named scalars only (a verbatim passthrough could silently eat the
+        # byte budget if the producer grows per-seq rows later)
+        c["pallas"] = {
+            k: pallas.get(k)
+            for k in ("seq", "pallas_ms", "blockwise_ms", "speedup")
+            if k in pallas
+        }
+    if s:
+        c["s"] = s
+    fl = full.get("floors") or {}
+    if fl:
+        jp = fl.get("tunnel_jitter_probe") or {}
+        c["floors"] = {
+            "rtt_ms": fl.get("dispatch_rtt_p50_ms"),
+            "mb_s": fl.get("transfer_mb_s"),
+            "jit_p50": jp.get("p50_ms"),
+            "jit_p99": jp.get("p99_ms"),
+        }
+    return c
+
+
+def emit(full: dict) -> None:
+    """Full record -> stderr + BENCH_DETAIL.json; compact line -> stdout
+    (the driver's artifact of record, LAST line, < 2,000-byte tail)."""
+    detail = json.dumps(full)
+    print(detail, file=sys.stderr)
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_DETAIL.json"), "w") as f:
+            f.write(detail + "\n")
+    except OSError as e:  # diagnostic only — the stdout line is the record
+        print(f"BENCH_DETAIL.json write failed: {e}", file=sys.stderr)
+    print(json.dumps(compact_record(full), separators=(",", ":")))
+
+
 def main() -> None:
     if "--serving-stack-only" in sys.argv:
         # This environment pre-wires a TPU plugin via sitecustomize, so the
@@ -1078,7 +1210,7 @@ def main() -> None:
         out["serving"] = serving
     if floors:
         out["floors"] = floors
-    print(json.dumps(out))
+    emit(out)
 
 
 if __name__ == "__main__":

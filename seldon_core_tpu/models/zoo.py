@@ -13,6 +13,7 @@ are JAX builders that the JAX_MODEL graph unit loads straight into HBM.
 from __future__ import annotations
 
 import inspect
+import threading
 import urllib.parse
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -69,6 +70,14 @@ def register_model(name: str):
 _HEAVY_CACHE: OrderedDict[tuple, ModelSpec] = OrderedDict()
 _HEAVY_CACHE_MAX = 4
 _CACHEABLE = frozenset({"resnet50", "bert_base"})
+# the admission estimator and operator reconcile both build via get_model
+# from different threads: the lock serializes the OrderedDict check/insert/
+# evict (a concurrent popitem interleaving could KeyError), and the
+# in-flight table de-dups concurrent FIRST builds of the same key —
+# a duplicated resnet50/bert build costs tens of seconds of device time and
+# 2x peak params memory. Builds themselves run OUTSIDE the lock.
+_HEAVY_CACHE_LOCK = threading.Lock()
+_HEAVY_BUILDING: dict[tuple, threading.Event] = {}
 
 
 def _heavy_cache_key(name: str, kwargs: dict) -> tuple | None:
@@ -111,14 +120,37 @@ def get_model(name: str, **kwargs) -> ModelSpec:
         key = _heavy_cache_key(name, kwargs)
         if key is None:
             return _REGISTRY[name](**kwargs)
-        if key in _HEAVY_CACHE:
-            _HEAVY_CACHE.move_to_end(key)
-            return _HEAVY_CACHE[key]
-        spec = _REGISTRY[name](**kwargs)
-        _HEAVY_CACHE[key] = spec
-        while len(_HEAVY_CACHE) > _HEAVY_CACHE_MAX:
-            _HEAVY_CACHE.popitem(last=False)
-        return spec
+        with _HEAVY_CACHE_LOCK:
+            if key in _HEAVY_CACHE:
+                _HEAVY_CACHE.move_to_end(key)
+                return _HEAVY_CACHE[key]
+            in_flight = _HEAVY_BUILDING.get(key)
+            if in_flight is None:
+                in_flight = threading.Event()
+                _HEAVY_BUILDING[key] = in_flight
+                am_builder = True
+            else:
+                am_builder = False
+        if not am_builder:
+            in_flight.wait()
+            with _HEAVY_CACHE_LOCK:
+                if key in _HEAVY_CACHE:
+                    _HEAVY_CACHE.move_to_end(key)
+                    return _HEAVY_CACHE[key]
+            # the builder raised — build for ourselves (uncached; a broken
+            # spec must not poison the cache for later callers)
+            return _REGISTRY[name](**kwargs)
+        try:
+            spec = _REGISTRY[name](**kwargs)
+            with _HEAVY_CACHE_LOCK:
+                _HEAVY_CACHE[key] = spec
+                while len(_HEAVY_CACHE) > _HEAVY_CACHE_MAX:
+                    _HEAVY_CACHE.popitem(last=False)
+            return spec
+        finally:
+            with _HEAVY_CACHE_LOCK:
+                _HEAVY_BUILDING.pop(key, None)
+            in_flight.set()
     return _REGISTRY[name](**kwargs)
 
 

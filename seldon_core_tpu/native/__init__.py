@@ -305,10 +305,13 @@ def parse_http_head(buf) -> "ParsedHead | int | None":
         ctype_buf, _CTYPE_CAP, ctypes.byref(ctype_len),
         auth_buf, _AUTH_CAP, ctypes.byref(auth_len),
     )
-    if rc == 0:
-        return 0
-    if rc < 0:
-        return -1
+    if rc <= 0:
+        # incomplete/malformed heads can still have memcpy'd an
+        # Authorization value before the parse stopped (e.g. auth header
+        # followed by a bad Content-Length) — the reused per-thread scratch
+        # must not retain it on ANY exit path, same invariant as below
+        ctypes.memset(auth_buf, 0, _AUTH_CAP)
+        return 0 if rc == 0 else -1
     if ctype_len.value >= _CTYPE_CAP or auth_len.value >= _AUTH_CAP:
         # possible truncation (oversized JWTs etc.): a clipped credential
         # would 401 on this path but pass the Python parse — hand the

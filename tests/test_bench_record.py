@@ -1,0 +1,203 @@
+"""The bench artifact-of-record contract (VERDICT r4 Next #1).
+
+The driver records only the LAST 2,000 bytes of bench.py's stdout; rounds
+3-4 produced records larger than that, so BENCH_r0{3,4}.json carry
+`parsed: null` and most headline numbers were lost. These tests pin the fix:
+compact_record() must stay comfortably under the cap on a WORST-CASE fully
+populated record, and must carry every figure the docs cite.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _leg(pps: float, p50: float, p99: float, errors: int = 0) -> dict:
+    # the full per-leg dicts carry far more (users, batch, mean_batch_rows,
+    # floor_rtt_ms...) — compact_record must take only the quartet
+    return {
+        "preds_per_sec": pps,
+        "p50_ms": p50,
+        "p95_ms": p99 * 0.9,
+        "p99_ms": p99,
+        "requests": 123456,
+        "errors": errors,
+        "batch_per_request": 4,
+        "users": 64,
+        "mean_batch_rows": 127.9,
+        "mean_queue_wait_ms": 12.34,
+        "floor_rtt_ms": 113.4,
+    }
+
+
+def _tenants(n: int) -> dict:
+    return {
+        f"tenant{i}": {
+            "preds_per_sec": 7051.09,
+            "p99_ms": 88.16,
+            "errors": 0,
+            "mean_batch_rows": 44.0,
+            "mean_queue_wait_ms": 2.95,
+        }
+        for i in range(n)
+    }
+
+
+def worst_case_full_record() -> dict:
+    """Every section populated, numbers at realistic-max digit widths."""
+    mt = lambda agg, lag: {  # noqa: E731
+        "aggregate_preds_per_sec": agg,
+        "tenants": _tenants(3),
+        "hbm_param_bytes_total": 26799200123,
+        "n_tenants": 3,
+        "users_each": 11,
+        "total_users": 33,
+        "loop_lag_mean_ms": 2.564,
+        "loop_lag_max_ms": lag,
+    }
+    ceiling = _leg(24141.53, 5.55, 10.85)
+    ceiling["loadgen_sweep"] = {
+        "workers_1_preds_per_sec": 24141.53,
+        "workers_2_preds_per_sec": 23987.11,
+        "workers_2_p99_ms": 11.92,
+        "host_cpu_count": 1,
+    }
+    ceiling["combiner_ratio_cpu"] = {
+        "fused_preds_per_sec": 1234.56,
+        "fused_p99_ms": 25.01,
+        "unfused_preds_per_sec": 592.81,
+        "unfused_p99_ms": 55.02,
+        "fused_errors": 0,
+        "unfused_errors": 0,
+        "fusion_speedup": 2.08,
+    }
+    ceiling["wire_matrix"] = {
+        "model": "resnet_tiny_32x32x3_uint8",
+        "rest_npy_preds_per_sec": 2241.15,
+        "rest_npy_p99_ms": 18.41,
+        "grpc_bindata_preds_per_sec": 1120.57,
+        "grpc_bindata_p99_ms": 30.88,
+        "rest_npy_errors": 0,
+        "grpc_bindata_errors": 0,
+    }
+    ceiling["multi_tenant"] = mt(18233.19, 73.61)
+    ceiling["multi_tenant_equal_users"] = mt(18233.19, 73.61)
+    ceiling["multi_tenant_homogeneous"] = mt(21142.04, 3.14)
+    fused = _leg(68.21, 466.01, 2870.99)
+    fused.update(
+        unfused_preds_per_sec=33.42,
+        unfused_p99_ms=3870.22,
+        unfused_errors=0,
+        unfused_users=8,
+    )
+    bert = _leg(1234.56, 105.5, 871.2)
+    bert.update(tflops=35.21, mfu_pct=61.77)
+    return {
+        "metric": "resnet50_predictions_per_sec",
+        "value": 12833.61,
+        "unit": "preds/s",
+        "vs_baseline": 10.2669,
+        "serving": {
+            "iris_chip": _leg(2950.44, 85.2, 870.13),
+            "resnet50_chip": _leg(65.83, 453.11, 1870.42),
+            "bert_base_chip": bert,
+            "combiner_fused": fused,
+            "full_dag": _leg(78.42, 190.7, 1234.56),
+            "abtest": _leg(20885.97, 5.52, 8.54),
+            "grpc": _leg(5831.07, 21.61, 35.92),
+            "moe_cpu": _leg(9123.45, 6.78, 14.31),
+            "pallas_long_seq": {
+                "seq": 2048,
+                "pallas_ms": 123.45,
+                "blockwise_ms": 256.78,
+                "speedup": 2.08,
+            },
+            "stack_ceiling_cpu": ceiling,
+        },
+        "floors": {
+            "dispatch_rtt_p50_ms": 113.4,
+            "transfer_mb_s": 8.3,
+            "tunnel_jitter_probe": _leg(39.11, 101.99, 871.53),
+            "note": "x" * 600,
+        },
+    }
+
+
+def test_compact_record_fits_driver_tail():
+    bench = _load_bench()
+    full = worst_case_full_record()
+    line = json.dumps(bench.compact_record(full), separators=(",", ":"))
+    # driver cap is 2,000 bytes of tail; require headroom (newline, rc
+    # prefix variations, wider numbers on a different run)
+    assert len(line) < 1800, f"compact record is {len(line)} bytes:\n{line}"
+    # and it must round-trip as the driver parses it
+    assert json.loads(line)["value"] == 12833.61
+
+
+def test_compact_record_carries_every_headline():
+    bench = _load_bench()
+    c = bench.compact_record(worst_case_full_record())
+    # driver contract
+    assert c["metric"] == "resnet50_predictions_per_sec"
+    assert c["unit"] == "preds/s"
+    assert c["vs_baseline"] == 10.2669
+    s = c["s"]
+    # per-leg quartets [pps, p50, p99, errors]
+    assert s["iris"] == [2950.44, 85.2, 870.13, 0]
+    assert s["rn50"][0] == 65.83
+    assert s["bert"][0] == 1234.56
+    assert s["comb_fused"][0] == 68.21
+    # 4-slot row like every other; the chip leg records no unfused p50
+    assert s["comb_unfused"] == [33.42, None, 3870.22, 0]
+    assert s["full_dag"][0] == 78.42
+    assert s["abtest"][0] == 20885.97
+    assert s["grpc"][0] == 5831.07
+    assert s["moe"][0] == 9123.45
+    assert s["ceiling"] == [24141.53, 5.55, 10.85, 0]
+    # cross-leg ratios and aggregates
+    assert c["sweep_w1_w2"] == [24141.53, 23987.11]
+    assert c["fusion_cpu"] == {"fused": 1234.56, "unfused": 592.81, "speedup": 2.08}
+    assert c["wire"] == {"rest_npy": 2241.15, "grpc_bin": 1120.57}
+    assert c["mt"]["agg"] == 18233.19
+    assert c["mt"]["homo_agg"] == 21142.04
+    assert c["mt"]["lag_max_ms"] == [73.61, 3.14]
+    # per-tenant p99s (cited by README/PARITY) survive into the record
+    assert c["mt"]["p99s"] == [88.16, 88.16, 88.16]
+    assert c["mt"]["homo_p99s"] == [88.16, 88.16, 88.16]
+    assert c["pallas"]["speedup"] == 2.08
+    assert c["bert_tflops"] == 35.21
+    assert c["bert_mfu_pct"] == 61.77
+    assert c["floors"] == {
+        "rtt_ms": 113.4,
+        "mb_s": 8.3,
+        "jit_p50": 101.99,
+        "jit_p99": 871.53,
+    }
+
+
+def test_compact_record_smoke_run_shape():
+    """Driver smoke-run without a chip: only the kernel quartet exists."""
+    bench = _load_bench()
+    c = bench.compact_record(
+        {
+            "metric": "resnet_tiny_predictions_per_sec",
+            "value": 123.4,
+            "unit": "preds/s",
+            "vs_baseline": 0.1,
+        }
+    )
+    assert "s" not in c and "floors" not in c
+    assert json.loads(json.dumps(c))["value"] == 123.4

@@ -67,6 +67,76 @@ def test_heavy_model_cache_is_bounded():
     assert len(zoo._HEAVY_CACHE) == zoo._HEAVY_CACHE_MAX
 
 
+def test_heavy_model_cache_concurrent_first_build_dedup():
+    """ADVICE r4: the admission estimator and operator reconcile can race on
+    a cold cache — concurrent same-key callers must share ONE build (no
+    duplicated tens-of-seconds init, no KeyError from concurrent eviction),
+    and a raising builder must not poison or deadlock the waiters."""
+    import threading
+
+    from seldon_core_tpu.models import zoo
+
+    slow_calls = []
+
+    def slow_builder(seed: int = 0, **_):
+        slow_calls.append(seed)
+        time_mod.sleep(0.15)
+        return zoo.ModelSpec(lambda p, x: x, {}, (4,))
+
+    import time as time_mod
+
+    orig = zoo._REGISTRY["resnet50"]
+    zoo._HEAVY_CACHE.clear()
+    zoo._REGISTRY["resnet50"] = slow_builder
+    try:
+        specs = [None] * 6
+        threads = [
+            threading.Thread(
+                target=lambda i=i: specs.__setitem__(
+                    i, zoo.get_model("resnet50", seed=42)
+                )
+            )
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(s is specs[0] for s in specs)
+        assert len(slow_calls) == 1, f"duplicate concurrent builds: {slow_calls}"
+
+        # raising builder: waiters fall back to their own build, nothing leaks
+        zoo._HEAVY_CACHE.clear()
+        state = {"n": 0}
+
+        def flaky(seed: int = 0, **_):
+            state["n"] += 1
+            time_mod.sleep(0.05)
+            if state["n"] == 1:
+                raise RuntimeError("boom")
+            return zoo.ModelSpec(lambda p, x: x, {}, (4,))
+
+        zoo._REGISTRY["resnet50"] = flaky
+        results = [None] * 3
+
+        def work(i):
+            try:
+                results[i] = zoo.get_model("resnet50", seed=7)
+            except RuntimeError:
+                results[i] = "raised"
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert "raised" in results and all(r is not None for r in results)
+        assert not zoo._HEAVY_BUILDING
+    finally:
+        zoo._REGISTRY["resnet50"] = orig
+        zoo._HEAVY_CACHE.clear()
+
+
 def test_resnet_tiny_deterministic_across_builds():
     a = get_model("resnet_tiny", seed=7)
     b = get_model("resnet_tiny", seed=7)
