@@ -59,19 +59,79 @@ def _layer_init(rng, hidden, ffn):
     }
 
 
+def _pallas_eligible(k: jax.Array) -> bool:
+    """The hand-tiled kernel needs the KV axis to divide its 128 block (no
+    in-kernel masking) and a jax build with pltpu types (interpret mode
+    included). Shapes are static at trace time so this resolves during
+    compilation, never per request."""
+    from seldon_core_tpu.ops.pallas_flash import pallas_available
+
+    return pallas_available() and k.shape[2] % 128 == 0
+
+
 def _default_attention(q, k, v):
-    """seq-length-adaptive: dense einsum below FLASH_MIN_SEQ, blockwise
-    (flash-style, O(block) memory) above — the shared policy constant lives
-    in ops/attention so seq-parallel local bodies can't drift from it."""
-    from seldon_core_tpu.ops.attention import FLASH_MIN_SEQ
+    """seq-length-adaptive: dense einsum below FLASH_MIN_SEQ; above it, the
+    Pallas flash kernel (ops/pallas_flash — VMEM-streamed online softmax on
+    the MXU) on the TPU backend, pure-JAX blockwise elsewhere. The length
+    policy constant lives in ops/attention so seq-parallel local bodies
+    can't drift from it."""
+    from seldon_core_tpu.ops.attention import FLASH_MIN_SEQ, PALLAS_MIN_SEQ
 
     if q.shape[2] >= FLASH_MIN_SEQ:
+        if (
+            q.shape[2] >= PALLAS_MIN_SEQ
+            and jax.default_backend() == "tpu"
+            and _pallas_eligible(k)
+        ):
+            from seldon_core_tpu.ops.pallas_flash import flash_attention
+
+            return flash_attention(q, k, v)
         from seldon_core_tpu.ops.attention import blockwise_attention
 
         return blockwise_attention(q, k, v, block_size=512)
     from seldon_core_tpu.ops.attention import naive_attention
 
     return naive_attention(q, k, v)
+
+
+def _pallas_attention(q, k, v):
+    """Forced-Pallas impl (attn_kernel=pallas): interpret mode off-TPU, so a
+    CI deployment on the CPU mesh exercises the same kernel code path the
+    chip compiles with Mosaic. Falls back to blockwise only when the kernel
+    is not viable (pltpu-less build, or a static KV length its block sizes
+    can't tile), mirroring _default_attention. Short sequences (<= one KV
+    block) tile trivially — _kv_block caps the block at the sequence."""
+    from seldon_core_tpu.ops.pallas_flash import pallas_available
+
+    sk = k.shape[2]
+    # sublane alignment (16 for bf16) + either the 128-lane tiling or a
+    # single-block fit
+    if pallas_available() and sk % 16 == 0 and (sk % 128 == 0 or sk <= 1024):
+        from seldon_core_tpu.ops.pallas_flash import flash_attention
+
+        return flash_attention(q, k, v)
+    from seldon_core_tpu.ops.attention import blockwise_attention
+
+    return blockwise_attention(q, k, v, block_size=512)
+
+
+def _blockwise_only_attention(q, k, v):
+    """attn_kernel=blockwise: the pure-JAX path at any length — the control
+    leg the bench compares the Pallas kernel against."""
+    from seldon_core_tpu.ops.attention import blockwise_attention
+
+    return blockwise_attention(q, k, v, block_size=512)
+
+
+# attn_kernel knob -> attention impl for the NON-seq-parallel path. Values
+# are module-level functions (not per-build closures) so two builds of the
+# same config share apply-fn identity — what lets engine/fused.py stack a
+# homogeneous ensemble and vmap once.
+_KERNEL_IMPLS = {
+    "auto": None,  # _default_attention policy
+    "pallas": _pallas_attention,
+    "blockwise": _blockwise_only_attention,
+}
 
 
 def make_ring_attention(mesh, seq_axis: str = "seq"):
@@ -231,13 +291,35 @@ def _infer_heads(params: dict) -> int:
     return max(1, hidden // 64)
 
 
-# memoized per (mesh, strategy): fused.py detects homogeneous ensembles by
-# apply-fn IDENTITY, so two builds on the same mesh must get the same
-# function object
+# memoized per (mesh, strategy) / per kernel: fused.py detects homogeneous
+# ensembles by apply-fn IDENTITY, so two builds of the same config must get
+# the same function object
 _RING_APPLY_CACHE: dict = {}
+_KERNEL_APPLY_CACHE: dict = {}
 
 
-def _bert_apply_factory(mesh, seq_parallel: str = "ring", num_heads: int | None = None):
+def _apply_for_kernel(attn_kernel: str):
+    """Single-device/no-seq-mesh apply for an attn_kernel knob value."""
+    if attn_kernel not in _KERNEL_IMPLS:
+        raise ValueError(
+            f"attn_kernel must be one of {sorted(_KERNEL_IMPLS)}, got "
+            f"{attn_kernel!r}"
+        )
+    if attn_kernel == "auto":
+        return apply_bert
+    fn = _KERNEL_APPLY_CACHE.get(attn_kernel)
+    if fn is None:
+        fn = make_apply_bert(_KERNEL_IMPLS[attn_kernel])
+        _KERNEL_APPLY_CACHE[attn_kernel] = fn
+    return fn
+
+
+def _bert_apply_factory(
+    mesh,
+    seq_parallel: str = "ring",
+    num_heads: int | None = None,
+    attn_kernel: str = "auto",
+):
     """Mesh-aware serving apply: a mesh with a "seq" axis turns on sequence
     parallelism automatically — ring attention by default, or the
     all-to-all (Ulysses) strategy when the deployment asks for it
@@ -274,7 +356,7 @@ def _bert_apply_factory(mesh, seq_parallel: str = "ring", num_heads: int | None 
             fn = make_apply_bert(impl)
             _RING_APPLY_CACHE[key] = fn
         return fn
-    return apply_bert
+    return _apply_for_kernel(attn_kernel)
 
 
 @register_model("bert_base")
@@ -282,16 +364,27 @@ def build_bert_base(
     seed: int = 0,
     num_classes: int = 2,
     max_len: int = 512,
+    seq: int = 128,
     seq_parallel: str = "ring",
+    attn_kernel: str = "auto",
     **_,
 ) -> ModelSpec:
     from functools import partial
 
+    if seq > max_len:
+        raise ValueError(
+            f"seq={seq} exceeds max_len={max_len} (position table size) — "
+            "raise max_len for long-context deployments"
+        )
     params = init_bert(seed, num_classes=num_classes, max_len=max_len)
     return ModelSpec(
-        apply_bert,
+        # attn_kernel is a deployment knob (auto|pallas|blockwise): auto
+        # routes long sequences to the Pallas flash kernel on the TPU
+        # backend and blockwise elsewhere; pallas forces the kernel
+        # (interpret mode off-TPU) so CI serving configs reach it
+        _apply_for_kernel(attn_kernel),
         params,
-        (128,),  # default serving seq length; buckets handle the batch axis
+        (seq,),  # serving seq length (buckets handle the batch axis)
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         # seq-parallel strategy is a deployment knob: a "seq" mesh axis plus
@@ -299,7 +392,10 @@ def build_bert_base(
         # num_heads lets ulysses reject undivisible meshes at BUILD time
         # (derived by the SAME rule attention itself uses)
         apply_factory=partial(
-            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=_infer_heads(params)
+            _bert_apply_factory,
+            seq_parallel=seq_parallel,
+            num_heads=_infer_heads(params),
+            attn_kernel=attn_kernel,
         ),
         int_inputs="ids",
     )
@@ -314,12 +410,16 @@ def build_bert_tiny(
     ffn: int = 256,
     max_len: int = 128,
     num_classes: int = 2,
+    seq: int = 16,
     seq_parallel: str = "ring",
+    attn_kernel: str = "auto",
     **_,
 ) -> ModelSpec:
     """Shrunk config for tests / virtual-mesh dryruns."""
     from functools import partial
 
+    if seq > max_len:
+        raise ValueError(f"seq={seq} exceeds max_len={max_len}")
     params = init_bert(
         seed,
         vocab=vocab,
@@ -330,13 +430,16 @@ def build_bert_tiny(
         num_classes=num_classes,
     )
     return ModelSpec(
-        apply_bert,
+        _apply_for_kernel(attn_kernel),
         params,
-        (16,),
+        (seq,),
         tuple(f"class_{i}" for i in range(num_classes)),
         param_pspecs=bert_pspecs(params),
         apply_factory=partial(
-            _bert_apply_factory, seq_parallel=seq_parallel, num_heads=_infer_heads(params)
+            _bert_apply_factory,
+            seq_parallel=seq_parallel,
+            num_heads=_infer_heads(params),
+            attn_kernel=attn_kernel,
         ),
         int_inputs="ids",
     )

@@ -54,6 +54,14 @@ def combine_stats(m1, l1, o1, m2, l2, o2):
 # default (models/bert.py) and the seq-parallel local bodies (ops/ulysses.py)
 FLASH_MIN_SEQ = 1024
 
+# seq length from which the TPU backend routes to the hand-tiled Pallas
+# kernel (ops/pallas_flash) instead of this pure-JAX blockwise path.
+# Measured on the v5e harness (bf16, 12 heads, d=64, RTT-differenced):
+# parity at 2k/4k, 2.2x at 8k, 2.4x at 16k — blockwise's per-step
+# [.., sq, block] score tensors go HBM-bound while the kernel keeps the
+# working set in VMEM. 4096 is the conservative crossover (>= parity).
+PALLAS_MIN_SEQ = 4096
+
 
 def blockwise_attention(
     q: jax.Array,

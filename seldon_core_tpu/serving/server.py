@@ -196,6 +196,15 @@ def load_predictor_from_args(args) -> tuple[PredictorSpec, str]:
 
 
 async def _amain(args):
+    # multi-host boot: when the operator injects JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES / JAX_PROCESS_ID (the way the reference injects
+    # ENGINE_* env — SeldonDeploymentOperatorImpl.java:100-103), wire
+    # jax.distributed BEFORE any backend/model init so the mesh spans all
+    # processes of the slice. No-ops single-host. Executed end-to-end by
+    # tests/test_multihost.py on two OS processes.
+    from seldon_core_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()
     predictor, dep_name = load_predictor_from_args(args)
     server = PredictorServer(
         predictor,
