@@ -161,6 +161,13 @@ class TpuSpec(_Spec):
     # donation only pays when output aliases input shape (e.g. transformers);
     # classifier heads change shape, so default off
     donate_input: bool = False
+    # Host-compute offload policy for MODEL nodes: "auto" (default) times
+    # each model's forward at warmup and, on the host CPU backend, moves
+    # slow forwards (>= ~3 ms) off the event loop onto a worker thread so
+    # one wide tenant cannot stall every other tenant's ingress (XLA
+    # releases the GIL during execution, so the overlap is real);
+    # "always"/"never" force the decision
+    offload_compute: str = "auto"
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

@@ -402,6 +402,7 @@ def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
         donate=getattr(tpu_cfg, "donate_input", True),
         int_inputs=ms.int_inputs,
         weight_quant=getattr(tpu_cfg, "weight_quant", ""),
+        offload_compute=getattr(tpu_cfg, "offload_compute", "auto"),
     )
     rt.feature_shape = ms.feature_shape
     return rt

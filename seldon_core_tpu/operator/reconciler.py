@@ -108,6 +108,14 @@ class RunningDeployment:
                 runtime = getattr(unit, "runtime", None)
                 if runtime is not None and getattr(runtime, "feature_shape", None) is not None:
                     runtime.warmup()
+        # NOTE: the serving GC policy (gc_policy.py) is deliberately NOT
+        # applied here. warmup() can run while the same loop is serving
+        # other tenants, and gc.freeze() would permanently pin whatever
+        # request state is in flight (plus pay a full gc.collect() stall
+        # mid-traffic). Boot paths (PredictorServer.start, platform.serve)
+        # apply it before traffic; for tenants applied at runtime,
+        # re-freeze from a quiesced moment (platform admin
+        # POST /v1/gc-policy).
 
     def close(self) -> None:
         self.close_batchers()

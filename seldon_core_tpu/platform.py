@@ -76,6 +76,16 @@ class Platform:
     def build_app(self) -> web.Application:
         app = build_gateway_app(self.gateway)
         add_operator_routes(app, self.manager)
+
+        async def _gc_policy(request: web.Request) -> web.Response:
+            # operator-invoked re-freeze for tenants applied at runtime
+            # (gc_policy.py): call during a quiet window — freeze pins any
+            # in-flight request state permanently
+            from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
+
+            return web.json_response({"frozen": apply_serving_gc_policy()})
+
+        app.router.add_post("/v1/gc-policy", _gc_policy)
         return app
 
     async def serve(
@@ -89,6 +99,7 @@ class Platform:
         k8s_namespace: str = "default",
         fast_ingress: bool = False,
         admin_port: int = 8082,
+        grpc_mode: str = "aio",
     ):
         self._fast_server = None
         if fast_ingress:
@@ -121,8 +132,22 @@ class Platform:
         if grpc_port:
             from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
 
-            grpc_server = await start_gateway_grpc(self.gateway, host=host, port=grpc_port)
-            log.info("platform gRPC on %s:%s", host, grpc_port)
+            grpc_server = await start_gateway_grpc(
+                self.gateway, host=host, port=grpc_port, mode=grpc_mode
+            )
+            log.info("platform gRPC on %s:%s (%s)", host, grpc_port, grpc_mode)
+
+        # event-loop health probe: one tenant's host-side compute stalling
+        # the shared loop is visible as seldon_tpu_event_loop_lag_ms before
+        # it becomes cross-tenant p99 (alert rule in deploy/monitoring)
+        from seldon_core_tpu.metrics.registry import run_loop_lag_probe
+
+        self._lag_probe = asyncio.create_task(run_loop_lag_probe(self.metrics))
+        # gen-2 GC pauses are the measured multi-tenant tail-lag source —
+        # freeze boot/warmup survivors out of the scan set (gc_policy.py)
+        from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
+
+        apply_serving_gc_policy()
 
         watch_task = None
         if watch_dir:
@@ -166,6 +191,7 @@ async def _amain(args) -> None:
         k8s_namespace=args.k8s_namespace,
         fast_ingress=args.fast_ingress,
         admin_port=args.admin_port,
+        grpc_mode=args.grpc_mode,
     )
 
     stop = asyncio.Event()
@@ -174,6 +200,9 @@ async def _amain(args) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
 
+    lag_probe = getattr(platform, "_lag_probe", None)
+    if lag_probe is not None:
+        lag_probe.cancel()
     if watch_task is not None:
         watch_task.cancel()
     if grpc_server is not None:
@@ -210,11 +239,20 @@ def main() -> None:
     )
     parser.add_argument("--no-grpc", action="store_true")
     parser.add_argument(
+        "--grpc-mode",
+        choices=("aio", "sync"),
+        default="aio",
+        help="gRPC ingress implementation: 'aio' (pure grpc.aio — fastest "
+        "when the backend shares the core with the event loop) or 'sync' "
+        "(C-core server + one loop bridge per RPC — the pick for "
+        "multi-core hosts; see docs/reference/external-api.md section 5)",
+    )
+    parser.add_argument(
         "--fast-ingress",
         action="store_true",
         help="serve the data plane on the purpose-built HTTP ingress "
-        "(serving/fast_http.py, ~2x request throughput) and move the full "
-        "REST app incl. the control-plane API to --admin-port",
+        "(serving/fast_http.py, lower per-request overhead) and move the "
+        "full REST app incl. the control-plane API to --admin-port",
     )
     parser.add_argument(
         "--admin-port",

@@ -128,6 +128,18 @@ class PredictorServer:
             await self._runner.setup()
             site = web.TCPSite(self._runner, host, port)
             await site.start()
+        # event-loop health probe (seldon_tpu_event_loop_lag_ms): anything
+        # stalling the loop is visible here before it becomes cross-request
+        # p99
+        from seldon_core_tpu.metrics.registry import run_loop_lag_probe
+
+        self._lag_probe = asyncio.create_task(run_loop_lag_probe(self.metrics))
+        # gen-2 GC pauses were the measured multi-tenant tail-lag source
+        # (70-100 ms with 10^5 live objects) — freeze warmup survivors out
+        # of the scan set before taking traffic
+        from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
+
+        apply_serving_gc_policy()
         if grpc_port:
             try:
                 from seldon_core_tpu.serving.grpc_server import start_grpc_server
@@ -139,6 +151,9 @@ class PredictorServer:
     async def stop(self):
         self.state["paused"] = True  # readiness false -> LB drains
         await asyncio.sleep(0)
+        probe = getattr(self, "_lag_probe", None)
+        if probe is not None:
+            probe.cancel()
         if self.batcher is not None:
             await self.batcher.close()
         # let in-flight SHADOW mirror walks finish BEFORE closing the remote

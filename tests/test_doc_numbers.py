@@ -30,7 +30,21 @@ _CLAIM = re.compile(
     r"(?:preds|predictions)\s*(?:/|\s+per\s+)\s*s(?:ec)?",
     re.IGNORECASE,
 )
-_BENCH_TAG = re.compile(r"BENCH_r(\d+)")
+_BENCH_TAG = re.compile(r"BENCH_(LOCAL_)?r(\d+)")
+
+# ratio-shaped perf claims (VERDICT r4 Next #6): "2.08x", "10.3×", "~2x",
+# and prose ratios like "roughly the throughput of one". Word-boundary
+# design: the x/× must NOT be followed by a digit (that's a shape like
+# 224x224) or a letter (that's a count like 3×ResNet50).
+_RATIO_CLAIM = re.compile(
+    r"(?<![\dx×.])(?:~\s*)?\d+(?:\.\d+)?\s*[x×](?![\dx×A-Za-z])"
+)
+_RATIO_PHRASES = (
+    "roughly the throughput of",
+    "at the throughput of",
+    "for the price of one",
+    "models for the price",
+)
 # figures that are goals, not measurements, don't need an artifact
 _TARGET_WORDS = ("north star", "north-star", "target", "baseline", "goal")
 
@@ -60,10 +74,19 @@ def _json_numbers(obj, acc: set, key: str = ""):
             acc.add(float(obj))
 
 
-def _artifact_numbers(round_no: int) -> set:
-    path = REPO / f"BENCH_r{round_no:02d}.json"
+def _artifact_path(round_no: int, local: bool = False) -> Path:
+    """BENCH_rNN.json (driver record) or BENCH_LOCAL_rNN.json (a committed
+    full session record — the current round's numbers are citable before
+    the driver's post-round artifact exists)."""
+    prefix = "BENCH_LOCAL_r" if local else "BENCH_r"
+    path = REPO / f"{prefix}{round_no:02d}.json"
     if not path.exists():
-        path = REPO / f"BENCH_r{round_no}.json"
+        path = REPO / f"{prefix}{round_no}.json"
+    return path
+
+
+def _artifact_numbers(round_no: int, local: bool = False) -> set:
+    path = _artifact_path(round_no, local)
     if not path.exists():
         return set()
     raw = path.read_text()
@@ -118,24 +141,79 @@ def test_every_preds_per_sec_claim_cites_a_real_artifact_number():
                         f"in its paragraph: ...{para.strip()[:120]}..."
                     )
                     continue
+                tag_names = [
+                    f"BENCH_{local}r{t}" for local, t in tags
+                ]
                 nums: set = set()
-                for t in tags:
-                    nums |= _artifact_numbers(int(t))
+                for local, t in tags:
+                    nums |= _artifact_numbers(int(t), local=bool(local))
                 if not nums:
                     # every cited artifact is absent from the repo (a bare
                     # forward reference to a future round can't source a
                     # number)
                     failures.append(
-                        f"{doc.name}: '{raw_num} preds/s' cites BENCH_r{tags} "
+                        f"{doc.name}: '{raw_num} preds/s' cites {tag_names} "
                         "but no such artifact exists in the repo"
                     )
                     continue
                 if not is_target and not _matches(claimed, nums):
                     failures.append(
                         f"{doc.name}: '{raw_num} preds/s' not found in cited "
-                        f"artifact(s) BENCH_r{tags}"
+                        f"artifact(s) {tag_names}"
                     )
     assert not failures, "\n".join(failures)
+
+
+def test_every_ratio_perf_claim_cites_an_artifact():
+    """VERDICT r4 Next #6: a number-free or ratio-shaped perf superlative
+    ("2.08x", "~2x", "roughly the throughput of one") must not dodge the
+    citation discipline — any paragraph making one needs a BENCH_rN /
+    BENCH_LOCAL_rN citation in context, and every cited artifact must
+    exist in the repo."""
+    failures = []
+    for doc in DOC_FILES:
+        paras = list(_paragraphs(doc.read_text()))
+        for i, para in enumerate(paras):
+            low = para.lower()
+            has_ratio = bool(_RATIO_CLAIM.search(para)) or any(
+                p in low for p in _RATIO_PHRASES
+            )
+            if not has_ratio:
+                continue
+            tags = _BENCH_TAG.findall(para) + (
+                _BENCH_TAG.findall(paras[i - 1]) if i else []
+            )
+            if not tags:
+                snippet = (
+                    _RATIO_CLAIM.search(para).group(0)
+                    if _RATIO_CLAIM.search(para)
+                    else next(p for p in _RATIO_PHRASES if p in low)
+                )
+                failures.append(
+                    f"{doc.name}: ratio claim '{snippet}' has no BENCH citation "
+                    f"in context: ...{para.strip()[:140]}..."
+                )
+            elif not any(
+                _artifact_path(int(t), local=bool(local)).exists()
+                for local, t in tags
+            ):
+                names = [f"BENCH_{local}r{t}" for local, t in tags]
+                failures.append(
+                    f"{doc.name}: ratio claim cites {names} but no such artifact "
+                    "exists in the repo"
+                )
+    assert not failures, "\n".join(failures)
+
+
+def test_ratio_claim_regex_shapes():
+    """The ratio matcher must hit perf ratios and skip tensor shapes,
+    model counts and hex-ish tokens."""
+    hits = ["2.08x", "10.3× the per-chip share", "~2x faster", "speedup 1.39x"]
+    misses = ["224x224x3 image", "3×ResNet50 combiner", "8x128 tile", "0x1f", "x-npy"]
+    for s in hits:
+        assert _RATIO_CLAIM.search(s), f"should match: {s}"
+    for s in misses:
+        assert not _RATIO_CLAIM.search(s), f"should NOT match: {s}"
 
 
 def test_doc_number_checker_catches_fabrication():

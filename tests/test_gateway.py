@@ -149,7 +149,11 @@ async def test_file_token_store_survives_restart(tmp_path):
     assert oauth2.principal(token) == "c1"
 
 
-async def test_grpc_gateway_auth_and_predict():
+@pytest.mark.parametrize("mode", ["aio", "sync"])
+async def test_grpc_gateway_auth_and_predict(mode):
+    """Both ingress modes (grpc.aio and the C-core sync server with the
+    loop bridge — see grpc_gateway module docstring) serve the same auth +
+    predict contract."""
     import grpc
 
     from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
@@ -158,9 +162,10 @@ async def test_grpc_gateway_auth_and_predict():
 
     gw = _gateway()
     token = gw.oauth.issue_token("oauth-key-1", "oauth-secret-1")["access_token"]
-    server = await start_gateway_grpc(gw, host="127.0.0.1", port=50910)
+    port = 50910 if mode == "aio" else 50911
+    server = await start_gateway_grpc(gw, host="127.0.0.1", port=port, mode=mode)
     try:
-        async with grpc.aio.insecure_channel("127.0.0.1:50910") as channel:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
             stub = ServiceStub(channel, "Seldon")
             req = pb.SeldonMessage()
             req.data.ndarray.values.add().list_value.values.add().number_value = 1.0

@@ -201,6 +201,12 @@ async def test_platform_end_to_end():
         body = await resp.json()
         assert len(body["data"]["ndarray"][0]) == 3
 
+        # operator-invoked GC re-freeze (serving/gc_policy.py): the admin
+        # path for tenants applied at runtime
+        resp = await client.post("/v1/gc-policy")
+        assert resp.status == 200
+        assert (await resp.json())["frozen"] > 0
+
         # delete, then the deployment is gone
         resp = await client.delete(
             "/apis/machinelearning.seldon.io/v1alpha1/seldondeployments/irisdep"
