@@ -39,15 +39,24 @@ PLURAL = "seldondeployments"
 def _real_api():
     """Build a CustomObjectsApi against the cluster config (in-cluster when
     available, else local kubeconfig). Gated: only called when no fake api
-    is injected."""
+    is injected. Without the ``kubernetes`` package, fall back to the
+    stdlib HTTP client (operator/k8s_http.py) — serviceaccount in-cluster
+    config or SELDON_TPU_K8S_API (kubectl proxy) — so k8s mode does not
+    require the dependency at all."""
     try:
         import kubernetes  # type: ignore[import-not-found]
-    except ImportError as e:  # pragma: no cover - depends on environment
-        raise RuntimeError(
-            "KubernetesWatcher needs the 'kubernetes' package (or an "
-            "injected api object); pip install kubernetes, or use the "
-            "directory watcher / control REST API instead"
-        ) from e
+    except ImportError:
+        from seldon_core_tpu.operator.k8s_http import HttpK8sApi
+
+        try:
+            return HttpK8sApi.from_env()
+        except RuntimeError as e:  # pragma: no cover - env dependent
+            raise RuntimeError(
+                "KubernetesWatcher needs the 'kubernetes' package, an "
+                "in-cluster serviceaccount, SELDON_TPU_K8S_API (kubectl "
+                "proxy), or an injected api object; alternatively use the "
+                "directory watcher / control REST API"
+            ) from e
     try:
         kubernetes.config.load_incluster_config()
     except Exception:  # noqa: BLE001 - fall back to kubeconfig
@@ -104,6 +113,13 @@ class KubernetesWatcher:
         self.manager = manager
         self.namespace = namespace
         self.api = api if api is not None else _real_api()
+        if stream_fn is None:
+            from seldon_core_tpu.operator.k8s_http import HttpK8sApi
+
+            if isinstance(self.api, HttpK8sApi):
+                # stdlib HTTP path: the api object provides its own chunked
+                # watch stream (no kubernetes.watch import)
+                stream_fn = self.api.watch_stream_fn(namespace)
         self._stream = stream_fn or _real_stream(self.api, namespace)
         # resourceVersion high-water mark (reference resourceVersionProcessed)
         self.resource_version_processed = 0

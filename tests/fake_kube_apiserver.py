@@ -1,0 +1,179 @@
+"""Wire-level Kubernetes API server emulator for the operator e2e test.
+
+The harness has no cluster tooling (no kind/minikube/kubectl/docker —
+documented in PARITY.md), so this implements the API-server subset the
+control plane actually touches, over REAL HTTP with real chunked watch
+streams, matching the semantics the reference's watch loop was built
+against (SeldonDeploymentWatcher.java:93-141):
+
+- CRD CRUD at /apis/machinelearning.seldon.io/v1alpha1/namespaces/{ns}/
+  seldondeployments[/name] with a monotonically increasing global
+  resourceVersion stamped on every write;
+- list?watch=true&resourceVersion=N&timeoutSeconds=T: replays events with
+  rv > N as JSON lines, then holds the connection open for new events
+  until the window closes (k8s watch semantics);
+- a too-old resourceVersion (below the compaction floor) yields a
+  `Status`-kind ERROR event — the 410 Gone path the watcher must answer
+  by resetting its high-water mark;
+- PATCH .../{name}/status merge-patches the status subresource WITHOUT
+  bumping resourceVersion for the watcher's own writeback (mirroring that
+  status updates don't re-trigger spec reconciliation in practice here).
+
+Test infra, not product code. The product-side client is
+operator/k8s_http.py (stdlib-only).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+BASE = "/apis/machinelearning.seldon.io/v1alpha1/namespaces/{namespace}/seldondeployments"
+
+
+class FakeKubeApiServer:
+    def __init__(self) -> None:
+        self.rv = 0
+        self.objects: dict[str, dict] = {}
+        self.events: list[tuple[int, str, dict]] = []  # (rv, type, object)
+        self.compacted_below = 0  # rv floor: older watches get ERROR/Status
+        # real apiservers answer a below-floor watch EITHER with a 200
+        # stream carrying a Status event OR with an HTTP 410 response;
+        # clients must handle both — this flag selects the 410 form
+        self.http_410_mode = False
+        self.status_patches: list[tuple[str, dict]] = []
+        self._new_event = asyncio.Event()
+
+    # ------------------------------------------------------------- helpers
+    def _record(self, etype: str, obj: dict) -> None:
+        self.rv += 1
+        obj = json.loads(json.dumps(obj))  # snapshot
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self.events.append((self.rv, etype, obj))
+        name = obj["metadata"].get("name", "")
+        if etype == "DELETED":
+            self.objects.pop(name, None)
+        else:
+            self.objects[name] = obj
+        self._new_event.set()
+        self._new_event = asyncio.Event()
+
+    def compact(self) -> None:
+        """Simulate etcd compaction at the current head: history up to and
+        including rv is discarded, so any watch resuming from a mark at or
+        below it gets the stale-version Status event (410 semantics)."""
+        self.compacted_below = self.rv + 1
+        self.events.clear()
+
+    # ------------------------------------------------------------- handlers
+    async def list_or_watch(self, request: web.Request) -> web.StreamResponse:
+        if request.query.get("watch") != "true":
+            return web.json_response(
+                {
+                    "kind": "SeldonDeploymentList",
+                    "metadata": {"resourceVersion": str(self.rv)},
+                    "items": list(self.objects.values()),
+                }
+            )
+        rv_arg = int(request.query.get("resourceVersion") or 0)
+        timeout_s = float(request.query.get("timeoutSeconds") or 30)
+        if self.http_410_mode and rv_arg and rv_arg < self.compacted_below:
+            return web.json_response(
+                {"kind": "Status", "code": 410, "reason": "Expired"}, status=410
+            )
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/json", "Transfer-Encoding": "chunked"}
+        )
+        await resp.prepare(request)
+
+        async def send(etype: str, obj: dict) -> None:
+            await resp.write(
+                json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+            )
+
+        if rv_arg and rv_arg < self.compacted_below:
+            await send(
+                "ERROR",
+                {
+                    "kind": "Status",
+                    "status": "Failure",
+                    "reason": "Expired",
+                    "code": 410,
+                    "message": f"too old resource version: {rv_arg}",
+                },
+            )
+            await resp.write_eof()
+            return resp
+
+        sent = rv_arg
+        if not rv_arg:
+            # k8s "Get State and Start at Most Recent" semantics: a watch
+            # with no resourceVersion first delivers synthetic ADDED events
+            # for every currently existing object, then streams new events
+            for obj in list(self.objects.values()):
+                await send("ADDED", obj)
+            sent = self.rv
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while True:
+            for rv, etype, obj in self.events:
+                if rv > sent:
+                    await send(etype, obj)
+                    sent = rv
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                break
+            waiter = self._new_event
+            try:
+                await asyncio.wait_for(waiter.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                break
+        await resp.write_eof()
+        return resp
+
+    async def create(self, request: web.Request) -> web.Response:
+        obj = await request.json()
+        name = obj.get("metadata", {}).get("name", "")
+        etype = "MODIFIED" if name in self.objects else "ADDED"
+        self._record(etype, obj)
+        return web.json_response(self.objects[name])
+
+    async def replace(self, request: web.Request) -> web.Response:
+        obj = await request.json()
+        obj.setdefault("metadata", {})["name"] = request.match_info["name"]
+        self._record("MODIFIED", obj)
+        return web.json_response(self.objects[request.match_info["name"]])
+
+    async def delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        if name not in self.objects:
+            return web.json_response({"kind": "Status", "code": 404}, status=404)
+        self._record("DELETED", self.objects[name])
+        return web.json_response({"kind": "Status", "status": "Success"})
+
+    async def get_one(self, request: web.Request) -> web.Response:
+        obj = self.objects.get(request.match_info["name"])
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404}, status=404)
+        return web.json_response(obj)
+
+    async def patch_status(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        obj = self.objects.get(name)
+        if obj is None:
+            return web.json_response({"kind": "Status", "code": 404}, status=404)
+        body = await request.json()
+        obj.setdefault("status", {}).update(body.get("status", {}))
+        self.status_patches.append((name, body))
+        return web.json_response(obj)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get(BASE, self.list_or_watch)
+        app.router.add_post(BASE, self.create)
+        app.router.add_get(BASE + "/{name}", self.get_one)
+        app.router.add_put(BASE + "/{name}", self.replace)
+        app.router.add_delete(BASE + "/{name}", self.delete)
+        app.router.add_patch(BASE + "/{name}/status", self.patch_status)
+        return app
