@@ -103,10 +103,14 @@ def _pallas_attention(q, k, v):
     block) tile trivially — _kv_block caps the block at the sequence."""
     from seldon_core_tpu.ops.pallas_flash import pallas_available
 
+    from seldon_core_tpu.ops.pallas_flash import DEFAULT_BLOCK_K
+
     sk = k.shape[2]
     # sublane alignment (16 for bf16) + either the 128-lane tiling or a
-    # single-block fit
-    if pallas_available() and sk % 16 == 0 and (sk % 128 == 0 or sk <= 1024):
+    # single-KV-block fit (the kernel caps its block at the sequence)
+    if pallas_available() and sk % 16 == 0 and (
+        sk % 128 == 0 or sk <= DEFAULT_BLOCK_K
+    ):
         from seldon_core_tpu.ops.pallas_flash import flash_attention
 
         return flash_attention(q, k, v)

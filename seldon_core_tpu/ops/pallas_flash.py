@@ -19,10 +19,13 @@ runs in interpreter mode, so numerics are covered everywhere while the
 compiled path exercises Mosaic only on real hardware.
 
 Measured on the v5e harness (bench.py pallas_long_seq, bf16, 12 heads,
-d=64): crossover vs the pure-JAX blockwise path is ~seq 4k; at 8k the
-kernel wins ~1.4x, and past 16k blockwise's per-step score tensor starts
-paying HBM round-trips the kernel never materializes. models/bert.py routes
-long sequences here on the TPU backend (PALLAS_MIN_SEQ policy).
+d=64, RTT-differenced): crossover vs the pure-JAX blockwise path is
+~seq 4k (parity there, within run noise); at 8k the kernel wins ~2x and at
+16k ~2.4x — blockwise's per-step score tensors go HBM-bound while the
+kernel keeps its working set in VMEM. Block defaults from a 9-point sweep
+at seq 8k: block_q 512 / block_k 2048 (5.34 ms vs 6.25 at the previous
+1024 KV block). models/bert.py routes long sequences here on the TPU
+backend (PALLAS_MIN_SEQ policy).
 """
 
 from __future__ import annotations
@@ -42,6 +45,10 @@ except Exception:  # noqa: BLE001
 
 NEG_INF = -1e30
 _LANES = 128  # stats are stored lane-replicated at this width
+# default KV block (flash_attention block_k): 9-point sweep at seq 8k on
+# the v5e harness picked 2048; the bert routing policy reuses it as the
+# single-block-fit bound for non-128-multiple sequences
+DEFAULT_BLOCK_K = 2048
 
 
 def pallas_available() -> bool:
@@ -112,7 +119,7 @@ def flash_attention(
     v: jax.Array,
     *,
     block_q: int = 512,
-    block_k: int = 1024,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
 ) -> jax.Array:
     """q,k,v: [batch, heads, seq, head_dim] -> same shape. Non-causal (the

@@ -196,6 +196,47 @@ async def test_quiet_watch_window_times_out_cleanly():
         await server.close()
 
 
+async def test_bound_token_reread_per_request(tmp_path):
+    """In-cluster bound tokens (~1h expiry) are refreshed in place by the
+    kubelet; the client must re-read the file per request or the watch
+    loop 401s forever after the first hour (code-review r5)."""
+    from aiohttp import web
+
+    fake = FakeKubeApiServer()
+    seen_auth: list[str] = []
+
+    async def record_auth(request: web.Request) -> web.StreamResponse:
+        seen_auth.append(request.headers.get("Authorization", ""))
+        return await fake.list_or_watch(request)
+
+    # wrap the list route to capture auth headers
+    from tests.fake_kube_apiserver import BASE
+
+    app2 = web.Application()
+    app2.router.add_get(BASE, record_auth)
+    server = TestServer(app2)
+    await server.start_server()
+    loop = asyncio.get_running_loop()
+    try:
+        token_file = tmp_path / "token"
+        token_file.write_text("tok-v1")
+        api = HttpK8sApi(
+            f"http://127.0.0.1:{server.port}", token_path=str(token_file)
+        )
+
+        def list_once():
+            return api.list_namespaced_custom_object(
+                "machinelearning.seldon.io", "v1alpha1", "default", "seldondeployments"
+            )
+
+        await loop.run_in_executor(None, list_once)
+        token_file.write_text("tok-v2")  # kubelet rotates the bound token
+        await loop.run_in_executor(None, list_once)
+        assert seen_auth == ["Bearer tok-v1", "Bearer tok-v2"]
+    finally:
+        await server.close()
+
+
 def test_http_api_list_roundtrip_shape():
     """The stdlib client's list call matches the kubernetes-client method
     signature the watcher would use."""
