@@ -686,12 +686,23 @@ def measure_pallas_long_seq(seq: int = 8192) -> dict:
     block_ms = per_call_ms(
         lambda q, k, v: blockwise_attention(q, k, v, block_size=512)
     )
+    # causal pair: decoder-style scoring through the same kernel (KV blocks
+    # above the diagonal skip their dots) vs the pure-JAX causal path
+    causal_ms = per_call_ms(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    block_causal_ms = per_call_ms(
+        lambda q, k, v: blockwise_attention(q, k, v, block_size=512, causal=True)
+    )
     return {
         "seq": seq,
         "batch_heads": [b, h],
         "pallas_ms": round(pallas_ms, 2),
         "blockwise_ms": round(block_ms, 2),
         "speedup": round(block_ms / pallas_ms, 2) if pallas_ms > 0 else 0.0,
+        "causal_ms": round(causal_ms, 2),
+        "blockwise_causal_ms": round(block_causal_ms, 2),
+        "causal_speedup": round(block_causal_ms / causal_ms, 2)
+        if causal_ms > 0
+        else 0.0,
     }
 
 
@@ -1172,7 +1183,15 @@ def compact_record(full: dict) -> dict:
         # byte budget if the producer grows per-seq rows later)
         c["pallas"] = {
             k: pallas.get(k)
-            for k in ("seq", "pallas_ms", "blockwise_ms", "speedup")
+            for k in (
+                "seq",
+                "pallas_ms",
+                "blockwise_ms",
+                "speedup",
+                "causal_ms",
+                "blockwise_causal_ms",
+                "causal_speedup",
+            )
             if k in pallas
         }
     if s:

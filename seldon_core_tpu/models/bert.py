@@ -101,9 +101,11 @@ def _pallas_attention(q, k, v):
     is not viable (pltpu-less build, or a static KV length its block sizes
     can't tile), mirroring _default_attention. Short sequences (<= one KV
     block) tile trivially — _kv_block caps the block at the sequence."""
-    from seldon_core_tpu.ops.pallas_flash import pallas_available
-
-    from seldon_core_tpu.ops.pallas_flash import DEFAULT_BLOCK_K
+    from seldon_core_tpu.ops.pallas_flash import (
+        DEFAULT_BLOCK_K,
+        flash_attention,
+        pallas_available,
+    )
 
     sk = k.shape[2]
     # sublane alignment (16 for bf16) + either the 128-lane tiling or a
@@ -111,8 +113,6 @@ def _pallas_attention(q, k, v):
     if pallas_available() and sk % 16 == 0 and (
         sk % 128 == 0 or sk <= DEFAULT_BLOCK_K
     ):
-        from seldon_core_tpu.ops.pallas_flash import flash_attention
-
         return flash_attention(q, k, v)
     from seldon_core_tpu.ops.attention import blockwise_attention
 

@@ -124,6 +124,9 @@ def worst_case_full_record() -> dict:
                 "pallas_ms": 123.45,
                 "blockwise_ms": 256.78,
                 "speedup": 2.08,
+                "causal_ms": 111.22,
+                "blockwise_causal_ms": 278.99,
+                "causal_speedup": 2.51,
             },
             "stack_ceiling_cpu": ceiling,
         },
@@ -178,6 +181,7 @@ def test_compact_record_carries_every_headline():
     assert c["mt"]["p99s"] == [88.16, 88.16, 88.16]
     assert c["mt"]["homo_p99s"] == [88.16, 88.16, 88.16]
     assert c["pallas"]["speedup"] == 2.08
+    assert c["pallas"]["causal_speedup"] == 2.51
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77
     assert c["floors"] == {

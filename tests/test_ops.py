@@ -106,3 +106,30 @@ def test_flash_attention_rejects_ragged_kv():
     q, k, v = _qkv(s=40)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block_q=16, block_k=16)
+
+
+def test_flash_attention_causal_matches_naive():
+    """Causal mode: whole KV blocks above the diagonal are skipped, the
+    straddling block masks entrywise — numerics must equal the dense
+    causal reference at shapes where skipping actually triggers (seq
+    spans several blocks)."""
+    q, k, v = _qkv(s=64, d=16)
+    ref = naive_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, block_q=16, block_k=16, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # mismatched block sizes exercise the straddling-block mask
+    got2 = flash_attention(q, k, v, block_q=32, block_k=16, causal=True)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_causal_with_q_padding():
+    # sq=40 pads to the 16-row q block; padded rows are sliced off and the
+    # real rows' causal numerics are unchanged
+    b, h, d = 1, 2, 16
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((b, h, 40, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, 64, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, 64, d)), jnp.float32)
+    ref = naive_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, block_q=16, block_k=16, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
