@@ -208,32 +208,11 @@ async def _serve_gateway_and_load(
     RestClientController.java:127): OAuth bearer auth -> principal ->
     deployment lookup -> in-process backend -> micro-batcher -> model ->
     audit hook -> response. What a client of the platform actually pays."""
-    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
-    from seldon_core_tpu.gateway.oauth import OAuthProvider
-    from seldon_core_tpu.gateway.store import DeploymentStore
-    from seldon_core_tpu.graph.spec import DeploymentSpec
-    from seldon_core_tpu.serving.server import PredictorServer
     from seldon_core_tpu.tools.loadtest import run_load
 
-    server = PredictorServer(predictor, deployment_name="bench")
-    server.warmup()  # compile buckets off the measured path
-    # the serving GC policy (gen-2 freeze) is part of the measured product
-    # boot (PredictorServer.start / platform.serve apply it); this harness
-    # wires the ingress directly, so apply it the same way
-    from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
-
-    apply_serving_gc_policy()
-    oauth = OAuthProvider()
-    store = DeploymentStore(oauth=oauth)
-    backend = InProcessBackend()
-    gw = Gateway(store=store, oauth=oauth, backend=backend)
-    store.deployment_added(
-        DeploymentSpec(
-            name="bench", oauth_key="bench-key", oauth_secret="bench-secret",
-            predictors=[predictor],
-        )
-    )
-    backend.register("bench", server.service)
+    # shared stack incl. warmup + the serving GC policy (the measured
+    # product boot applies both; this harness wires the ingress directly)
+    server, gw, oauth, token = _gateway_stack(predictor)
     # the platform's fast data-plane ingress (serving/fast_http.py) — same
     # wire-core handlers as the aiohttp app, purpose-built HTTP layer
     from seldon_core_tpu.serving.fast_http import gateway_routes, start_fast_server
@@ -513,28 +492,20 @@ def serving_full_dag_chip(duration_s: float = 10.0) -> dict:
     )
 
 
-async def _grpc_gateway_load(
-    predictor, *, users: int, batch: int, features, duration_s: float,
-    payload: str = "tensor",
-) -> dict:
-    """External gRPC hot path (reference SeldonGrpcServer.java:114-132):
-    Seldon.Predict with oauth_token metadata through the gRPC gateway onto
-    the same in-process backend the REST numbers use. Static pre-built
-    proto request; one shared HTTP/2 channel multiplexing all users."""
-    import grpc
-
+def _gateway_stack(predictor):
+    """The shared bench serving stack: warmed PredictorServer behind the
+    OAuth gateway + in-process backend, with the serving GC policy applied
+    exactly as the product boot does. Returns (server, gw, oauth, token).
+    One definition so the REST/gRPC/gRPC-Web legs cannot drift."""
     from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
-    from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
     from seldon_core_tpu.gateway.oauth import OAuthProvider
     from seldon_core_tpu.gateway.store import DeploymentStore
     from seldon_core_tpu.graph.spec import DeploymentSpec
-    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
     from seldon_core_tpu.serving.server import PredictorServer
 
     server = PredictorServer(predictor, deployment_name="bench")
     server.warmup()
-    from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
-
     apply_serving_gc_policy()
     oauth = OAuthProvider()
     store = DeploymentStore(oauth=oauth)
@@ -547,9 +518,55 @@ async def _grpc_gateway_load(
         )
     )
     backend.register("bench", server.service)
+    token = oauth.issue_token("bench-key", "bench-secret")["access_token"]
+    return server, gw, oauth, token
+
+
+def _window_summary(
+    latencies: list, completions: list, errors: int, stop_at: float,
+    *, batch: int, duration_s: float, users: int, wire: str,
+) -> dict:
+    """Windowed rate + percentiles, same policy as tools/loadtest
+    LoadStats.summary: drain-tail completions keep their latencies but
+    not the denominator. One definition shared by the raw gRPC/gRPC-Web
+    legs so the rate policy cannot diverge between compared numbers."""
+    in_window = sum(1 for t in completions if t <= stop_at)
+    latencies = sorted(latencies)
+
+    def pct(q: float) -> float:
+        return round(
+            latencies[min(len(latencies) - 1, int(q / 100 * len(latencies)))] * 1e3, 2
+        ) if latencies else 0.0
+
+    return {
+        "preds_per_sec": round(in_window * batch / duration_s, 2),
+        "p50_ms": pct(50),
+        "p95_ms": pct(95),
+        "p99_ms": pct(99),
+        "requests": len(latencies),
+        "errors": errors,
+        "batch_per_request": batch,
+        "users": users,
+        "wire": wire,
+    }
+
+
+async def _grpc_gateway_load(
+    predictor, *, users: int, batch: int, features, duration_s: float,
+    payload: str = "tensor",
+) -> dict:
+    """External gRPC hot path (reference SeldonGrpcServer.java:114-132):
+    Seldon.Predict with oauth_token metadata through the gRPC gateway onto
+    the same in-process backend the REST numbers use. Static pre-built
+    proto request; one shared HTTP/2 channel multiplexing all users."""
+    import grpc
+
+    from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    server, gw, oauth, token = _gateway_stack(predictor)
     port = _free_port()
     grpc_server = await start_gateway_grpc(gw, "127.0.0.1", port)
-    token = oauth.issue_token("bench-key", "bench-secret")["access_token"]
     metadata = (("oauth_token", token),)
 
     req = pb.SeldonMessage()
@@ -601,27 +618,10 @@ async def _grpc_gateway_load(
     if server.batcher is not None:
         await server.batcher.close()
 
-    # windowed rate, same policy as tools/loadtest.py LoadStats.summary:
-    # drain-tail completions keep their latencies but not the denominator
-    in_window = sum(1 for t in completions if t <= stop_at)
-    latencies.sort()
-
-    def pct(q: float) -> float:
-        return round(
-            latencies[min(len(latencies) - 1, int(q / 100 * len(latencies)))] * 1e3, 2
-        ) if latencies else 0.0
-
-    return {
-        "preds_per_sec": round(in_window * batch / duration_s, 2),
-        "p50_ms": pct(50),
-        "p95_ms": pct(95),
-        "p99_ms": pct(99),
-        "requests": len(latencies),
-        "errors": errors,
-        "batch_per_request": batch,
-        "users": users,
-        "wire": "grpc+proto",
-    }
+    return _window_summary(
+        latencies, completions, errors, stop_at,
+        batch=batch, duration_s=duration_s, users=users, wire="grpc+proto",
+    )
 
 
 def measure_pallas_long_seq(seq: int = 8192) -> dict:
@@ -749,6 +749,92 @@ def wire_matrix_cpu(duration_s: float = 5.0) -> dict:
         "rest_npy_errors": rest["errors"],
         "grpc_bindata_errors": grpc_leg["errors"],
     }
+
+
+async def _grpc_web_load(
+    predictor, *, users: int, batch: int, features: int, duration_s: float
+) -> dict:
+    """gRPC-Web unary (wire.py §gRPC-Web) on the FAST ingress, at exactly
+    the native-gRPC leg's load: proto request in grpc-web framing over
+    persistent HTTP/1.1 connections (tools/loadtest raw-conn client).
+    Measures what a gRPC-ecosystem client gains by riding the
+    asyncio.Protocol + C-parser data plane instead of python HTTP/2."""
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.fast_http import gateway_routes, start_fast_server
+    from seldon_core_tpu.serving.wire import GRPC_WEB_CTYPE, grpc_web_frame
+    from seldon_core_tpu.tools.loadtest import _RawHttpConn
+
+    server, gw, oauth, token = _gateway_stack(predictor)
+
+    req = pb.SeldonMessage()
+    rng = np.random.default_rng(0)
+    req.data.tensor.shape.extend([batch, features])
+    req.data.tensor.values.extend(rng.random(batch * features).tolist())
+    body = grpc_web_frame(0, req.SerializeToString())
+
+    port = _free_port()
+    fast_server = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    latencies: list[float] = []
+    completions: list[float] = []
+    errors = 0
+    try:
+        conns = [_RawHttpConn("127.0.0.1", port) for _ in range(users)]
+        raw_reqs = [
+            c.build_request(
+                "/seldon.tpu.Seldon/Predict", body, GRPC_WEB_CTYPE,
+                {"oauth_token": token},
+            )
+            for c in conns
+        ]
+        stop_at = time.perf_counter() + duration_s
+
+        async def user(conn, raw):
+            nonlocal errors
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                try:
+                    st, _, resp = await conn.request_raw(raw)
+                    # decode the DATA frame's SeldonMessage and require
+                    # SUCCESS — the exact ok-rule the native gRPC leg
+                    # applies, so the two legs' errors are comparable
+                    ok = st == 200 and resp[:1] == b"\x00"
+                    if ok:
+                        n = int.from_bytes(resp[1:5], "big")
+                        out = pb.SeldonMessage.FromString(resp[5 : 5 + n])
+                        ok = out.status.status == pb.Status.SUCCESS
+                except Exception:  # noqa: BLE001
+                    ok = False
+                done = time.perf_counter()
+                if ok:
+                    latencies.append(done - t0)
+                    completions.append(done)
+                else:
+                    errors += 1
+
+        await asyncio.gather(*(user(c, r) for c, r in zip(conns, raw_reqs)))
+        for c in conns:
+            await c.close()
+    finally:
+        fast_server.close()
+        await fast_server.wait_closed()
+        if server.batcher is not None:
+            await server.batcher.close()
+
+    return _window_summary(
+        latencies, completions, errors, stop_at,
+        batch=batch, duration_s=duration_s, users=users,
+        wire="grpc-web+proto over fast ingress",
+    )
+
+
+def serving_grpc_web_gateway(duration_s: float = 6.0, users: int = 32) -> dict:
+    pred = _deployment(
+        {"model": "iris_mlp"},
+        {"max_batch": 128, "batch_buckets": [128], "batch_timeout_ms": 2.0},
+    )
+    return asyncio.run(
+        _grpc_web_load(pred, users=users, batch=4, features=4, duration_s=duration_s)
+    )
 
 
 def serving_moe_cpu(duration_s: float = 6.0) -> dict:
@@ -1120,6 +1206,7 @@ def compact_record(full: dict) -> dict:
         ("full_dag", "full_dag"),
         ("abtest", "abtest"),
         ("grpc", "grpc"),
+        ("grpc_web", "grpc_web"),
         ("moe_cpu", "moe"),
     ):
         row = _row(srv.get(key))
@@ -1278,6 +1365,9 @@ def main() -> None:
             )
         # external gRPC ingress (VERDICT r3 Next #6)
         out["grpc"] = serving_grpc_gateway(duration_s=6.0)
+        # gRPC-Web unary on the fast ingress: the gRPC ecosystem's escape
+        # hatch from the python HTTP/2 floor (external-api.md §5)
+        out["grpc_web"] = serving_grpc_web_gateway(duration_s=6.0)
         # expert-parallel deployment through the same stack (r4 Next #5)
         out["moe_cpu"] = serving_moe_cpu()
         # image-class wire comparison: REST+npy vs gRPC binData, same model
@@ -1336,6 +1426,8 @@ def main() -> None:
                 serving["abtest"] = ceiling.pop("abtest")
             if "grpc" in ceiling:
                 serving["grpc"] = ceiling.pop("grpc")
+            if "grpc_web" in ceiling:
+                serving["grpc_web"] = ceiling.pop("grpc_web")
             if "moe_cpu" in ceiling:
                 serving["moe_cpu"] = ceiling.pop("moe_cpu")
         floors = {

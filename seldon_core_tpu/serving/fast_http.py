@@ -133,7 +133,7 @@ def parse_head_py(raw: bytes) -> "PyHead | int | tuple[int, bytes]":
         return (400, b"Transfer-Encoding not supported")
     if "content-length" in headers:
         clen = int(headers["content-length"])
-    elif method in ("GET", "HEAD", "DELETE"):
+    elif method in ("GET", "HEAD", "DELETE", "OPTIONS"):
         clen = 0
     else:
         # POST/PUT without Content-Length (incl. chunked): out of this
@@ -223,7 +223,7 @@ class HttpProtocol(asyncio.Protocol):
             return
         if flags & native.HDRF_HAS_CLEN:
             clen = parsed.content_length
-        elif method in ("GET", "HEAD", "DELETE"):
+        elif method in ("GET", "HEAD", "DELETE", "OPTIONS"):
             clen = 0
         else:
             self._respond_simple(411, b"Content-Length required")
@@ -238,6 +238,15 @@ class HttpProtocol(asyncio.Protocol):
             return
         self._pending_head = None
         body = bytes(buf[parsed.body_start : parsed.body_start + clen])
+        # gRPC-Web paths carry auth as arbitrary metadata headers (the
+        # reference's oauth_token key) that the C parser's two fixed
+        # capture slots don't cover — keep the validated head for a
+        # targeted scan below, before the buffer is consumed
+        head_bytes = (
+            bytes(buf[: parsed.body_start])
+            if parsed.path.startswith("/seldon.")
+            else b""
+        )
         del buf[: parsed.body_start + clen]
 
         headers: dict[str, str] = {}
@@ -245,6 +254,10 @@ class HttpProtocol(asyncio.Protocol):
             headers["content-type"] = parsed.content_type
         if parsed.authorization is not None:
             headers["authorization"] = parsed.authorization
+        if head_bytes:
+            token = _header_from_head(head_bytes, b"oauth_token")
+            if token is not None:
+                headers["oauth_token"] = token
         path = parsed.path.split("?", 1)[0]
         req = WireRequest(
             method=method,
@@ -417,6 +430,22 @@ def engine_routes(service, state: dict, metrics=None) -> dict:
     return routes
 
 
+def _header_from_head(head: bytes, name: bytes) -> str | None:
+    """Pull ONE extra header out of a head the C parser has already
+    VALIDATED (strict CRLF lines, token field-names, no obs-fold) — the C
+    fast path copies out only content-type/authorization; gRPC-Web
+    metadata keys like oauth_token need this targeted scan. LAST duplicate
+    wins, matching both the Python fallback's dict assignment and the C
+    parser's overwrite-on-match for its captured headers (C/Python
+    agreement is the fuzz-enforced invariant here)."""
+    target = name + b":"
+    found: str | None = None
+    for line in head.split(b"\r\n")[1:]:
+        if line[: len(target)].lower() == target:
+            found = line[len(target) :].strip(b" \t").decode("latin-1")
+    return found
+
+
 def gateway_routes(gw) -> dict:
     """The gateway data-plane route table (fast twin of gateway/app.py)."""
     from seldon_core_tpu.serving import wire
@@ -440,7 +469,13 @@ def gateway_routes(gw) -> dict:
         m = gw.metrics
         return WireResponse.text((m.export() if m is not None else b"").decode())
 
-    return {
+    async def grpc_web_predict(req: WireRequest) -> WireResponse:
+        return await wire.gateway_grpc_web_predict(gw, req)
+
+    async def grpc_web_feedback(req: WireRequest) -> WireResponse:
+        return await wire.gateway_grpc_web_feedback(gw, req)
+
+    routes = {
         ("POST", "/api/v0.1/predictions"): predictions,
         ("POST", "/api/v0.1/feedback"): feedback,
         ("POST", "/oauth/token"): token,
@@ -449,3 +484,22 @@ def gateway_routes(gw) -> dict:
         ("GET", "/metrics"): prometheus,
         ("GET", "/prometheus"): prometheus,
     }
+    async def grpc_web_preflight(req: WireRequest) -> WireResponse:
+        # CORS preflight: browser gRPC-Web clients send OPTIONS with
+        # Access-Control-Request-Headers for the non-simple content type +
+        # metadata headers before the real POST
+        return WireResponse(
+            status=204,
+            body=b"",
+            content_type="text/plain",
+            headers=dict(wire.GRPC_WEB_CORS_HEADERS),
+        )
+
+    # gRPC-Web unary (wire.py §gRPC-Web): gRPC-ecosystem clients on the
+    # fast HTTP/1.1 data plane, both package spellings of the contract
+    for pkg in ("seldon.tpu", "seldon.protos"):
+        for m in ("Predict", "SendFeedback"):
+            routes[("OPTIONS", f"/{pkg}.Seldon/{m}")] = grpc_web_preflight
+        routes[("POST", f"/{pkg}.Seldon/Predict")] = grpc_web_predict
+        routes[("POST", f"/{pkg}.Seldon/SendFeedback")] = grpc_web_feedback
+    return routes

@@ -370,3 +370,153 @@ async def gateway_token(gw, req: WireRequest) -> WireResponse:
             {"error": "invalid_client", "error_description": "Bad client credentials"},
             status=401,
         )
+
+
+# ------------------------------------------------------------- gRPC-Web
+# The HTTP/1.1-compatible gRPC wire (unary): each message is framed as
+# 1 flags byte + u32 big-endian length + payload; trailers travel as a
+# final frame with the 0x80 flag. Serving it on the fast ingress gives
+# gRPC-ecosystem clients (browsers, envoy grpc_web filters, generated
+# stubs) the asyncio.Protocol + C-head-parser data plane instead of the
+# Python HTTP/2 stack — the measured floor behind the native-gRPC gap
+# (docs/reference/external-api.md §5).
+
+GRPC_WEB_CTYPE = "application/grpc-web+proto"
+
+# CORS surface for browser gRPC-Web clients: the content type and the
+# metadata headers are non-simple, so cross-origin browsers preflight.
+# grpc-status rides HTTP trailers-in-body frames, but grpc-web JS also
+# reads response HEADERS — expose them.
+GRPC_WEB_CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "POST, OPTIONS",
+    "Access-Control-Allow-Headers": (
+        "content-type, oauth_token, authorization, x-grpc-web, x-user-agent"
+    ),
+    "Access-Control-Expose-Headers": "grpc-status, grpc-message",
+    "Access-Control-Max-Age": "86400",
+}
+
+
+def grpc_web_frame(flags: int, payload: bytes) -> bytes:
+    return bytes([flags]) + len(payload).to_bytes(4, "big") + payload
+
+
+def grpc_web_first_message(body: bytes) -> bytes:
+    """Payload of the first DATA frame (unary requests carry exactly one)."""
+    if len(body) < 5:
+        raise ValueError("grpc-web frame truncated")
+    if body[0] & 0x80:
+        raise ValueError("grpc-web request began with a trailer frame")
+    if body[0] & 0x01:
+        raise ValueError("compressed grpc-web frames not supported")
+    n = int.from_bytes(body[1:5], "big")
+    if len(body) < 5 + n:
+        raise ValueError("grpc-web frame length exceeds body")
+    return body[5 : 5 + n]
+
+
+def _grpc_web_response(message_pb: bytes, status: int = 0) -> "WireResponse":
+    body = grpc_web_frame(0, message_pb) + grpc_web_frame(
+        0x80, f"grpc-status:{status}\r\n".encode()
+    )
+    return WireResponse(
+        body=body,
+        content_type=GRPC_WEB_CTYPE,
+        headers=dict(GRPC_WEB_CORS_HEADERS),
+    )
+
+
+def _grpc_web_error(code: int, message: str) -> "WireResponse":
+    """Trailers-only response (no DATA frame): transport-level failure,
+    e.g. malformed framing. HTTP status stays 200 per the grpc-web spec;
+    the grpc-status trailer carries the error. The message is
+    percent-encoded per the gRPC spec — raw exception text can carry
+    CR/LF/non-ASCII that would corrupt the trailer block."""
+    from urllib.parse import quote
+
+    safe_msg = quote(message, safe=" ()[]{}<>=,.:;!?/'~@#$^&*+-_|")
+    trailer = f"grpc-status:{code}\r\ngrpc-message:{safe_msg}\r\n".encode()
+    return WireResponse(
+        body=grpc_web_frame(0x80, trailer),
+        content_type=GRPC_WEB_CTYPE,
+        headers=dict(GRPC_WEB_CORS_HEADERS),
+    )
+
+
+def _grpc_web_principal(gw, req: "WireRequest") -> str:
+    """gRPC metadata maps to HTTP headers under grpc-web: accept the
+    gateway's ``oauth_token`` metadata key (HeaderServerInterceptor
+    parity) or a standard Authorization bearer."""
+    token = req.headers.get("oauth_token", "")
+    if token:
+        principal = gw.oauth.principal(token)
+        if not principal:
+            raise APIException(
+                ErrorCode.APIFE_GRPC_NO_PRINCIPAL_FOUND, "oauth_token"
+            )
+        return principal
+    return gw.principal_from_auth(req.headers.get("authorization", ""))
+
+
+async def gateway_grpc_web_predict(gw, req: "WireRequest") -> "WireResponse":
+    """POST /seldon.*.Seldon/Predict with application/grpc-web+proto."""
+    import time as _time
+
+    from seldon_core_tpu.core.codec_proto import (
+        message_from_proto,
+        message_to_proto,
+    )
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    start = _time.perf_counter()
+    try:
+        pbmsg = pb.SeldonMessage.FromString(grpc_web_first_message(req.body))
+    except Exception as e:  # noqa: BLE001 - malformed framing/proto
+        return _grpc_web_error(3, f"invalid grpc-web request: {e}")  # 3=INVALID_ARGUMENT
+    try:
+        principal = _grpc_web_principal(gw, req)
+        dep = gw._deployment(principal)
+        msg = message_from_proto(pbmsg)
+        out = await gw.backend.predict(dep, msg)
+        gw.audit.send(principal, msg, out)
+        if gw.metrics is not None:
+            gw.metrics.ingress_request(
+                dep.name, "predict", _time.perf_counter() - start
+            )
+        return _grpc_web_response(message_to_proto(out).SerializeToString())
+    except APIException as e:
+        # application-level failure rides a SUCCESS grpc-status with the
+        # failure inside the SeldonMessage — byte-for-byte the native gRPC
+        # gateway's behavior (gateway/grpc_gateway.py), so a client sees
+        # identical semantics on either transport
+        failure = SeldonMessage.failure(e.error.code, e.error.message, e.info)
+        return _grpc_web_response(message_to_proto(failure).SerializeToString())
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        log.exception("grpc-web predict failed")
+        return _grpc_web_error(13, str(e))  # 13=INTERNAL
+
+
+async def gateway_grpc_web_feedback(gw, req: "WireRequest") -> "WireResponse":
+    """POST /seldon.*.Seldon/SendFeedback with application/grpc-web+proto."""
+    from seldon_core_tpu.core.codec_proto import (
+        feedback_from_proto,
+        message_to_proto,
+    )
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+
+    try:
+        fb_pb = pb.Feedback.FromString(grpc_web_first_message(req.body))
+    except Exception as e:  # noqa: BLE001
+        return _grpc_web_error(3, f"invalid grpc-web request: {e}")
+    try:
+        principal = _grpc_web_principal(gw, req)
+        dep = gw._deployment(principal)
+        out = await gw.backend.feedback(dep, feedback_from_proto(fb_pb))
+        return _grpc_web_response(message_to_proto(out).SerializeToString())
+    except APIException as e:
+        failure = SeldonMessage.failure(e.error.code, e.error.message, e.info)
+        return _grpc_web_response(message_to_proto(failure).SerializeToString())
+    except Exception as e:  # noqa: BLE001
+        log.exception("grpc-web feedback failed")
+        return _grpc_web_error(13, str(e))

@@ -118,6 +118,7 @@ def worst_case_full_record() -> dict:
             "full_dag": _leg(78.42, 190.7, 1234.56),
             "abtest": _leg(20885.97, 5.52, 8.54),
             "grpc": _leg(5831.07, 21.61, 35.92),
+            "grpc_web": _leg(17536.0, 6.69, 13.96),
             "moe_cpu": _leg(9123.45, 6.78, 14.31),
             "pallas_long_seq": {
                 "seq": 2048,
@@ -168,6 +169,7 @@ def test_compact_record_carries_every_headline():
     assert s["full_dag"][0] == 78.42
     assert s["abtest"][0] == 20885.97
     assert s["grpc"][0] == 5831.07
+    assert s["grpc_web"][0] == 17536.0
     assert s["moe"][0] == 9123.45
     assert s["ceiling"] == [24141.53, 5.55, 10.85, 0]
     # cross-leg ratios and aggregates

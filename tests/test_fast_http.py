@@ -709,3 +709,184 @@ async def test_fast_server_handler_exception_is_500_json():
     finally:
         server.close()
         await server.wait_closed()
+
+
+# ------------------------------------------------------------- gRPC-Web
+
+
+def _grpc_web_frames(body: bytes) -> list[tuple[int, bytes]]:
+    """Split a grpc-web response body into (flags, payload) frames."""
+    frames = []
+    i = 0
+    while i < len(body):
+        flags = body[i]
+        n = int.from_bytes(body[i + 1 : i + 5], "big")
+        frames.append((flags, body[i + 5 : i + 5 + n]))
+        i += 5 + n
+    return frames
+
+
+async def test_grpc_web_predict_on_fast_ingress_matches_native_grpc():
+    """gRPC-Web unary Seldon.Predict rides the fast HTTP/1.1 ingress with
+    the SAME semantics as the native gRPC gateway: oauth_token metadata as
+    a header, proto in/out, app-level failures inside the SeldonMessage."""
+    import grpc
+
+    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
+    from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+    from seldon_core_tpu.gateway.oauth import OAuthProvider
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.proto.services import ServiceStub
+    from seldon_core_tpu.serving.wire import grpc_web_frame
+
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    backend = InProcessBackend()
+    gw = Gateway(store=store, oauth=oauth, backend=backend)
+    store.deployment_added(
+        DeploymentSpec(name="dep1", oauth_key="k1", oauth_secret="s1")
+    )
+    backend.register("dep1", _service())
+    token = oauth.issue_token("k1", "s1")["access_token"]
+
+    req = pb.SeldonMessage()
+    req.data.tensor.shape.extend([1, 3])
+    req.data.tensor.values.extend([1.0, 2.0, 3.0])
+    raw = req.SerializeToString()
+
+    port = free_port()
+    fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    grpc_port = free_port()
+    native = await start_gateway_grpc(gw, "127.0.0.1", grpc_port)
+    try:
+        st, hdrs, body = await _http(
+            port,
+            "POST",
+            "/seldon.tpu.Seldon/Predict",
+            grpc_web_frame(0, raw),
+            {
+                "Content-Type": "application/grpc-web+proto",
+                "oauth_token": token,
+            },
+        )
+        assert st == 200
+        assert hdrs.get("content-type") == "application/grpc-web+proto"
+        frames = _grpc_web_frames(body)
+        assert [f for f, _ in frames] == [0, 0x80]
+        out = pb.SeldonMessage.FromString(frames[0][1])
+        assert b"grpc-status:0" in frames[1][1]
+
+        # byte-level parity with the native gRPC gateway
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{grpc_port}") as ch:
+            stub = ServiceStub(ch, "Seldon")
+            native_out = await stub.Predict(req, metadata=(("oauth_token", token),))
+        assert out.data.tensor.values == native_out.data.tensor.values
+        assert list(out.data.names) == list(native_out.data.names)
+
+        # the other package spelling serves too (reference clients)
+        st2, _, body2 = await _http(
+            port,
+            "POST",
+            "/seldon.protos.Seldon/Predict",
+            grpc_web_frame(0, raw),
+            {"Content-Type": "application/grpc-web+proto", "oauth_token": token},
+        )
+        assert st2 == 200
+        out2 = pb.SeldonMessage.FromString(_grpc_web_frames(body2)[0][1])
+        # values identical; meta.puid is per-request by design
+        assert out2.data.tensor.values == out.data.tensor.values
+
+        # auth failure: SUCCESS transport, failure in the message (native
+        # gateway parity — status code 205 No Principal)
+        st3, _, body3 = await _http(
+            port,
+            "POST",
+            "/seldon.tpu.Seldon/Predict",
+            grpc_web_frame(0, raw),
+            {"Content-Type": "application/grpc-web+proto", "oauth_token": "bad"},
+        )
+        assert st3 == 200
+        fail = pb.SeldonMessage.FromString(_grpc_web_frames(body3)[0][1])
+        assert fail.status.code == 205
+
+        # malformed framing: trailers-only, grpc-status 3 INVALID_ARGUMENT
+        st4, _, body4 = await _http(
+            port,
+            "POST",
+            "/seldon.tpu.Seldon/Predict",
+            b"\x00\x00\x00",
+            {"Content-Type": "application/grpc-web+proto", "oauth_token": token},
+        )
+        assert st4 == 200
+        (flags, trailer), = _grpc_web_frames(body4)
+        assert flags == 0x80 and b"grpc-status:3" in trailer
+        # trailer values are percent-encoded: no raw CR/LF beyond the
+        # key:value\r\n structure itself (2 lines -> 2 CRLFs)
+        assert trailer.count(b"\r\n") == 2
+
+        # CORS: browsers preflight the non-simple content type + headers
+        st5, hdrs5, _ = await _http(
+            port,
+            "OPTIONS",
+            "/seldon.tpu.Seldon/Predict",
+            b"",
+            {
+                "Origin": "http://app.example",
+                "Access-Control-Request-Method": "POST",
+                "Access-Control-Request-Headers": "content-type,oauth_token",
+            },
+        )
+        assert st5 == 204
+        assert hdrs5.get("access-control-allow-origin") == "*"
+        assert "oauth_token" in hdrs5.get("access-control-allow-headers", "")
+        # and the actual response carries the allow-origin for the reader
+        assert hdrs.get("access-control-allow-origin") == "*"
+    finally:
+        fast.close()
+        await fast.wait_closed()
+        await native.stop(None)
+
+
+async def test_grpc_web_feedback_on_fast_ingress():
+    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
+    from seldon_core_tpu.gateway.oauth import OAuthProvider
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.wire import grpc_web_frame
+
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    backend = InProcessBackend()
+    gw = Gateway(store=store, oauth=oauth, backend=backend)
+    store.deployment_added(
+        DeploymentSpec(name="dep1", oauth_key="k1", oauth_secret="s1")
+    )
+    backend.register("dep1", _service())
+    token = oauth.issue_token("k1", "s1")["access_token"]
+
+    fb = pb.Feedback()
+    fb.request.data.tensor.shape.extend([1, 3])
+    fb.request.data.tensor.values.extend([1.0, 2.0, 3.0])
+    fb.response.meta.routing["r"] = 0
+    fb.reward = 1.0
+
+    port = free_port()
+    fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    try:
+        st, _, body = await _http(
+            port,
+            "POST",
+            "/seldon.tpu.Seldon/SendFeedback",
+            grpc_web_frame(0, fb.SerializeToString()),
+            {"Content-Type": "application/grpc-web+proto", "oauth_token": token},
+        )
+        assert st == 200
+        frames = _grpc_web_frames(body)
+        assert [f for f, _ in frames] == [0, 0x80]
+        assert b"grpc-status:0" in frames[1][1]
+    finally:
+        fast.close()
+        await fast.wait_closed()
