@@ -274,6 +274,23 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         body = gw.metrics.export() if gw.metrics is not None else b""
         return web.Response(body=body, content_type="text/plain")
 
+    async def grpc_web_predict(request: web.Request) -> web.Response:
+        from seldon_core_tpu.serving import wire
+
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.gateway_grpc_web_predict(gw, req))
+
+    async def grpc_web_feedback(request: web.Request) -> web.Response:
+        from seldon_core_tpu.serving import wire
+
+        req = await to_wire_request(request)
+        return from_wire_response(await wire.gateway_grpc_web_feedback(gw, req))
+
+    async def grpc_web_preflight(request: web.Request) -> web.Response:
+        from seldon_core_tpu.serving import wire
+
+        return web.Response(status=204, headers=dict(wire.GRPC_WEB_CORS_HEADERS))
+
     app.router.add_post("/oauth/token", token)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
@@ -281,4 +298,14 @@ def build_gateway_app(gw: Gateway) -> web.Application:
     app.router.add_get("/ping", ping)
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/prometheus", prometheus)
+    # gRPC-Web unary — same wire-core handlers AND the same route table
+    # constant (wire.GRPC_WEB_ROUTES) as the fast ingress: one source, no
+    # drift channel between the transports
+    from seldon_core_tpu.serving.wire import GRPC_WEB_ROUTES
+
+    for path, method in GRPC_WEB_ROUTES:
+        app.router.add_options(path, grpc_web_preflight)
+        app.router.add_post(
+            path, grpc_web_predict if method == "Predict" else grpc_web_feedback
+        )
     return app

@@ -42,6 +42,7 @@ _TCHAR = frozenset(
 
 _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",
+    204: b"HTTP/1.1 204 No Content\r\n",
     400: b"HTTP/1.1 400 Bad Request\r\n",
     401: b"HTTP/1.1 401 Unauthorized\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
@@ -344,6 +345,18 @@ class HttpProtocol(asyncio.Protocol):
         extra = b""
         for k, v in resp.headers.items():
             extra += f"{k}: {v}\r\n".encode()
+        if resp.status == 204:
+            # RFC 7230 3.3.2: a 204 MUST NOT carry Content-Length or a
+            # body (CORS preflights ride this) — a desync-pedantic front
+            # proxy may reject the header we'd otherwise always write
+            t.write(
+                _status_line(204)
+                + extra
+                + (b"Connection: keep-alive\r\n\r\n" if keep_alive else b"Connection: close\r\n\r\n")
+            )
+            if not keep_alive:
+                self._close()
+            return
         t.write(
             _status_line(resp.status)
             + b"Content-Type: " + resp.content_type.encode() + b"\r\n"
@@ -495,11 +508,11 @@ def gateway_routes(gw) -> dict:
             headers=dict(wire.GRPC_WEB_CORS_HEADERS),
         )
 
-    # gRPC-Web unary (wire.py §gRPC-Web): gRPC-ecosystem clients on the
-    # fast HTTP/1.1 data plane, both package spellings of the contract
-    for pkg in ("seldon.tpu", "seldon.protos"):
-        for m in ("Predict", "SendFeedback"):
-            routes[("OPTIONS", f"/{pkg}.Seldon/{m}")] = grpc_web_preflight
-        routes[("POST", f"/{pkg}.Seldon/Predict")] = grpc_web_predict
-        routes[("POST", f"/{pkg}.Seldon/SendFeedback")] = grpc_web_feedback
+    # gRPC-Web unary: the ONE route table (wire.GRPC_WEB_ROUTES) shared
+    # with the aiohttp gateway app, so the transports cannot drift
+    for path, method in wire.GRPC_WEB_ROUTES:
+        routes[("OPTIONS", path)] = grpc_web_preflight
+        routes[("POST", path)] = (
+            grpc_web_predict if method == "Predict" else grpc_web_feedback
+        )
     return routes

@@ -403,7 +403,10 @@ def grpc_web_frame(flags: int, payload: bytes) -> bytes:
 
 
 def grpc_web_first_message(body: bytes) -> bytes:
-    """Payload of the first DATA frame (unary requests carry exactly one)."""
+    """Payload of the single DATA frame a unary request carries. Trailing
+    bytes (a second frame / attempted client streaming) are rejected —
+    native gRPC errors extra messages on a unary RPC, and silently serving
+    half a payload would be the hardest client bug to debug."""
     if len(body) < 5:
         raise ValueError("grpc-web frame truncated")
     if body[0] & 0x80:
@@ -413,7 +416,19 @@ def grpc_web_first_message(body: bytes) -> bytes:
     n = int.from_bytes(body[1:5], "big")
     if len(body) < 5 + n:
         raise ValueError("grpc-web frame length exceeds body")
+    if len(body) > 5 + n:
+        raise ValueError("trailing bytes after the unary request frame")
     return body[5 : 5 + n]
+
+
+# one route table consumed by BOTH transports (gateway/app.py and
+# fast_http.gateway_routes) — the parity the docs promise must have a
+# single source, not two loops with matching comments
+GRPC_WEB_ROUTES: tuple[tuple[str, str], ...] = tuple(
+    (f"/{pkg}.Seldon/{method}", method)
+    for pkg in ("seldon.tpu", "seldon.protos")
+    for method in ("Predict", "SendFeedback")
+)
 
 
 def _grpc_web_response(message_pb: bytes, status: int = 0) -> "WireResponse":
@@ -490,21 +505,28 @@ async def gateway_grpc_web_predict(gw, req: "WireRequest") -> "WireResponse":
         # failure inside the SeldonMessage — byte-for-byte the native gRPC
         # gateway's behavior (gateway/grpc_gateway.py), so a client sees
         # identical semantics on either transport
+        if gw.metrics is not None:
+            gw.metrics.ingress_error("", "predict", e.error.code)
         failure = SeldonMessage.failure(e.error.code, e.error.message, e.info)
         return _grpc_web_response(message_to_proto(failure).SerializeToString())
     except Exception as e:  # noqa: BLE001 - wire boundary
         log.exception("grpc-web predict failed")
+        if gw.metrics is not None:
+            gw.metrics.ingress_error("", "predict", ErrorCode.APIFE_MICROSERVICE_ERROR.code)
         return _grpc_web_error(13, str(e))  # 13=INTERNAL
 
 
 async def gateway_grpc_web_feedback(gw, req: "WireRequest") -> "WireResponse":
     """POST /seldon.*.Seldon/SendFeedback with application/grpc-web+proto."""
+    import time as _time
+
     from seldon_core_tpu.core.codec_proto import (
         feedback_from_proto,
         message_to_proto,
     )
     from seldon_core_tpu.proto import prediction_pb2 as pb
 
+    start = _time.perf_counter()
     try:
         fb_pb = pb.Feedback.FromString(grpc_web_first_message(req.body))
     except Exception as e:  # noqa: BLE001
@@ -512,11 +534,25 @@ async def gateway_grpc_web_feedback(gw, req: "WireRequest") -> "WireResponse":
     try:
         principal = _grpc_web_principal(gw, req)
         dep = gw._deployment(principal)
-        out = await gw.backend.feedback(dep, feedback_from_proto(fb_pb))
+        fb = feedback_from_proto(fb_pb)
+        out = await gw.backend.feedback(dep, fb)
+        # same instrumentation as the REST feedback path: dashboards must
+        # see grpc-web traffic (latency + the bandit reward gauge)
+        if gw.metrics is not None:
+            gw.metrics.ingress_request(
+                dep.name, "feedback", _time.perf_counter() - start
+            )
+            gw.metrics.feedback(dep.name, "", "", fb.reward)
         return _grpc_web_response(message_to_proto(out).SerializeToString())
     except APIException as e:
+        if gw.metrics is not None:
+            gw.metrics.ingress_error("", "feedback", e.error.code)
         failure = SeldonMessage.failure(e.error.code, e.error.message, e.info)
         return _grpc_web_response(message_to_proto(failure).SerializeToString())
     except Exception as e:  # noqa: BLE001
         log.exception("grpc-web feedback failed")
+        if gw.metrics is not None:
+            gw.metrics.ingress_error(
+                "", "feedback", ErrorCode.APIFE_MICROSERVICE_ERROR.code
+            )
         return _grpc_web_error(13, str(e))

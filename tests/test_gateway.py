@@ -179,6 +179,45 @@ async def test_grpc_gateway_auth_and_predict(mode):
         await server.stop(None)
 
 
+async def test_grpc_web_on_aiohttp_gateway_matches_fast_ingress_contract():
+    """Route-table parity invariant (external-api.md): the aiohttp app
+    serves the same gRPC-Web unary surface as the fast ingress, from the
+    same wire-core handlers."""
+    from seldon_core_tpu.gateway.app import build_gateway_app
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.wire import grpc_web_frame
+
+    gw = _gateway()
+    token = gw.oauth.issue_token("oauth-key-1", "oauth-secret-1")["access_token"]
+    client = TestClient(TestServer(build_gateway_app(gw)))
+    await client.start_server()
+    try:
+        req = pb.SeldonMessage()
+        req.data.tensor.shape.extend([1, 1])
+        req.data.tensor.values.extend([1.0])
+        resp = await client.post(
+            "/seldon.tpu.Seldon/Predict",
+            data=grpc_web_frame(0, req.SerializeToString()),
+            headers={
+                "Content-Type": "application/grpc-web+proto",
+                "oauth_token": token,
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("application/grpc-web")
+        body = await resp.read()
+        n = int.from_bytes(body[1:5], "big")
+        out = pb.SeldonMessage.FromString(body[5 : 5 + n])
+        assert out.data.WhichOneof("data_oneof") is not None
+        assert b"grpc-status:0" in body[5 + n :]
+        # preflight
+        resp = await client.options("/seldon.tpu.Seldon/Predict")
+        assert resp.status == 204
+        assert resp.headers["Access-Control-Allow-Origin"] == "*"
+    finally:
+        await client.close()
+
+
 def test_oauth_key_rotation_revokes_old_key():
     from seldon_core_tpu.graph.spec import DeploymentSpec
 
