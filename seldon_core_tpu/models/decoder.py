@@ -1,0 +1,256 @@
+"""GPT-style causal decoder with KV-cache generation — the generative
+serving tier.
+
+Greenfield vs the reference (SURVEY §2: classifiers/regressors only); the
+TPU-native pieces are exactly the ones a naive port gets wrong:
+
+- ONE compiled program per (batch bucket, prompt length): prefill computes
+  every prompt position's K/V in one causal-attention pass (the same
+  length-adaptive policy BERT serving uses — naive < 1024, blockwise, the
+  Pallas causal kernel on TPU at long prompts), writes them into a
+  [b, h, max_ctx, d] cache, then a ``lax.scan`` runs ``max_new_tokens``
+  greedy steps — static shapes throughout, no Python loop, no recompiles.
+- per-step attention is one [b, h, 1, d] query against the cache with a
+  position mask (cache slots beyond the current length contribute zero
+  mass), K/V written in place via ``lax.dynamic_update_slice``.
+- outputs are int32 token ids (the serving wire keeps integer dtypes
+  exact; float32 readback holds every id < 2^24).
+
+Serving contract: apply(params, ids[b, s]) -> [b, s + max_new_tokens]
+(prompt echoed, generated ids appended) — max_new_tokens is a DEPLOYMENT
+parameter (static at trace time), the zoo entry is ``tiny_gpt``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _dense(rng: np.random.Generator, n_in: int, n_out: int) -> dict:
+    scale = (2.0 / (n_in + n_out)) ** 0.5
+    return {
+        "w": (rng.standard_normal((n_in, n_out)) * scale).astype(np.float32),
+        "b": np.zeros((n_out,), np.float32),
+    }
+
+
+def _ln_init(d: int) -> dict:
+    return {"scale": np.ones((d,), np.float32), "bias": np.zeros((d,), np.float32)}
+
+
+def _ln(p: dict, x: jax.Array) -> jax.Array:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + jnp.asarray(1e-5, x.dtype))
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def init_decoder(
+    seed: int = 0,
+    vocab: int = 512,
+    hidden: int = 128,
+    layers: int = 2,
+    ffn: int = 256,
+    max_len: int = 128,
+) -> dict:
+    heads = _heads_for(hidden)
+    if hidden % heads:
+        raise ValueError(
+            f"hidden={hidden} not divisible by its derived head count "
+            f"{heads} (head_dim-64 convention) — a cryptic reshape error "
+            "at first trace otherwise"
+        )
+    rng = np.random.default_rng(seed)
+    return {
+        "tok_emb": (rng.standard_normal((vocab, hidden)) * 0.02).astype(np.float32),
+        "pos_emb": (rng.standard_normal((max_len, hidden)) * 0.02).astype(np.float32),
+        "layers": [
+            {
+                "ln1": _ln_init(hidden),
+                "qkv": _dense(rng, hidden, 3 * hidden),
+                "attn_out": _dense(rng, hidden, hidden),
+                "ln2": _ln_init(hidden),
+                "mlp_in": _dense(rng, hidden, ffn),
+                "mlp_out": _dense(rng, ffn, hidden),
+            }
+            for _ in range(layers)
+        ],
+        "ln_f": _ln_init(hidden),
+        # lm head reuses tok_emb^T (weight tying, the standard decoder move)
+    }
+
+
+def _heads_for(hidden: int) -> int:
+    return max(1, hidden // 64) if hidden >= 64 else 2
+
+
+def _heads(params: dict) -> int:
+    return _heads_for(params["layers"][0]["qkv"]["w"].shape[0])
+
+
+def _split_heads(t: jax.Array, h: int) -> jax.Array:
+    b, s, d = t.shape
+    return t.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(t: jax.Array) -> jax.Array:
+    b, h, s, hd = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+
+
+def _causal_attention(q, k, v):
+    """Prefill attention: the shared backend-adaptive causal policy
+    (ops/attention.causal_attention_auto — Pallas kernel on TPU at long
+    prompts, pure JAX elsewhere)."""
+    from seldon_core_tpu.ops.attention import causal_attention_auto
+
+    return causal_attention_auto(q, k, v)
+
+
+def _layer_prefill(p, x, h):
+    """Returns (x_out, k[b,h,s,hd], v[b,h,s,hd]) for the cache."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
+    ctx = _merge_heads(_causal_attention(q, k, v))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, k, v
+
+
+def _layer_step(p, x, cache_k, cache_v, pos, h):
+    """One token through one layer against the cache. x: [b, 1, d]; cache
+    [b, h, max_ctx, hd]; pos: scalar current position (tokens < pos are
+    valid). Returns (x_out, cache_k, cache_v) with the new K/V written at
+    ``pos``."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, h)  # [b, h, 1, hd]
+    k = _split_heads(k, h)
+    v = _split_heads(v, h)
+    cache_k = lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
+    # masked dot attention over the whole (static) cache: slots > pos get
+    # -inf, so their mass is exactly zero — no dynamic shapes
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)) * scale
+    valid = jnp.arange(cache_k.shape[2]) <= pos  # [max_ctx]
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    p_attn = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", p_attn, cache_v.astype(jnp.float32))
+    ctx = _merge_heads(ctx.astype(x.dtype))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, cache_k, cache_v
+
+
+def _embed(params, ids, pos_offset: int = 0):
+    # jnp.asarray: params may be host numpy on the direct (un-device_put)
+    # call path, and numpy arrays cannot be indexed by tracers
+    h = jnp.asarray(params["tok_emb"])[ids]
+    return h + jnp.asarray(params["pos_emb"])[
+        pos_offset : pos_offset + ids.shape[1]
+    ][None, :, :]
+
+
+def _logits(params, x):
+    x = _ln(params["ln_f"], x)
+    return x @ jnp.asarray(params["tok_emb"]).T.astype(x.dtype)  # weight-tied head
+
+
+def generate(params: dict, ids: jax.Array, max_new_tokens: int) -> jax.Array:
+    """Greedy decode: ids[b, s] int -> [b, s + max_new_tokens] int32.
+
+    Prefill fills the KV caches in one causal pass; a lax.scan then runs
+    ``max_new_tokens`` single-token steps. max_ctx = s + max_new_tokens is
+    static, so one XLA program serves every request of this bucket."""
+    ids = ids.astype(jnp.int32)
+    b, s = ids.shape
+    heads = _heads(params)
+    max_ctx = s + max_new_tokens
+    max_len = params["pos_emb"].shape[0]
+    if max_ctx > max_len:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds the "
+            f"position table ({max_len}) — raise max_len"
+        )
+
+    # ---- prefill
+    x = _embed(params, ids)
+    caches = []
+    hd = x.shape[-1] // heads
+    for lp in params["layers"]:
+        x, k, v = _layer_prefill(lp, x, heads)
+        ck = jnp.zeros((b, heads, max_ctx, hd), x.dtype)
+        cv = jnp.zeros((b, heads, max_ctx, hd), x.dtype)
+        ck = lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+        caches.append((ck, cv))
+    first_tok = jnp.argmax(_logits(params, x[:, -1:, :]), axis=-1)  # [b, 1]
+
+    # ---- decode scan: carry = (token, pos, caches)
+    cache_k = jnp.stack([c[0] for c in caches])  # [L, b, h, max_ctx, hd]
+    cache_v = jnp.stack([c[1] for c in caches])
+
+    def step(carry, _):
+        tok, pos, ck_all, cv_all = carry
+        x = _embed_one(params, tok, pos)
+        new_k, new_v = [], []
+        for li, lp in enumerate(params["layers"]):
+            x, ck, cv = _layer_step(lp, x, ck_all[li], cv_all[li], pos, heads)
+            new_k.append(ck)
+            new_v.append(cv)
+        nxt = jnp.argmax(_logits(params, x), axis=-1)  # [b, 1]
+        return (nxt, pos + 1, jnp.stack(new_k), jnp.stack(new_v)), tok
+
+    # max_new - 1 steps: each step consumes one already-chosen token and
+    # chooses the next, and first_tok came from prefill — a full step for
+    # the token after the last would be paid-for-then-discarded compute
+    (last, _, _, _), toks = lax.scan(
+        step, (first_tok, jnp.int32(s), cache_k, cache_v), None,
+        length=max_new_tokens - 1,
+    )
+    # toks: the token CONSUMED by each step (first_tok first); `last` is
+    # the final chosen token — together exactly max_new generated ids
+    gen = jnp.concatenate(
+        [toks[:, :, 0].T.reshape(b, -1), last], axis=1
+    )
+    return jnp.concatenate([ids, gen.astype(jnp.int32)], axis=1)
+
+
+def _embed_one(params, tok: jax.Array, pos) -> jax.Array:
+    """tok: [b, 1] -> [b, 1, d] with the position-``pos`` embedding."""
+    h = jnp.asarray(params["tok_emb"])[tok]
+    return h + lax.dynamic_slice_in_dim(
+        jnp.asarray(params["pos_emb"]), pos, 1, axis=0
+    )[None, :, :]
+
+
+def reference_generate(params: dict, ids: np.ndarray, max_new_tokens: int) -> np.ndarray:
+    """Cache-less reference: full forward per step (the slow obvious
+    implementation the scan version must match token-for-token)."""
+    ids = np.asarray(ids, dtype=np.int32)
+    heads = _heads(params)
+    for _ in range(max_new_tokens):
+        x = _embed(params, jnp.asarray(ids))
+        for lp in params["layers"]:
+            x, _, _ = _layer_prefill(lp, x, heads)
+        nxt = np.asarray(jnp.argmax(_logits(params, x[:, -1:, :]), axis=-1))
+        ids = np.concatenate([ids, nxt.astype(np.int32)], axis=1)
+    return ids

@@ -372,6 +372,54 @@ def build_moe_mlp(
     )
 
 
+@register_model("tiny_gpt")
+def build_tiny_gpt(
+    seed: int = 0,
+    vocab: int = 512,
+    hidden: int = 128,
+    layers: int = 2,
+    ffn: int = 256,
+    max_len: int = 128,
+    seq: int = 32,
+    max_new_tokens: int = 16,
+    **_,
+) -> ModelSpec:
+    """Generative SERVING model (greenfield tier — the reference serves no
+    autoregressive models): GPT-style causal decoder, greedy KV-cache
+    decode inside one compiled program (models/decoder.py — prefill
+    through the causal-attention policy incl. the Pallas kernel on TPU,
+    then a lax.scan of single-token steps). ``max_new_tokens`` and the
+    prompt bucket are deployment parameters, so every request of a bucket
+    reuses one XLA program. Wire: int token ids in, ids out
+    ([b, seq + max_new_tokens], exact int32 through the serving dtype
+    policy)."""
+    from functools import partial
+
+    from seldon_core_tpu.models.decoder import init_decoder
+
+    if seq + max_new_tokens > max_len:
+        raise ValueError(
+            f"seq={seq} + max_new_tokens={max_new_tokens} exceeds "
+            f"max_len={max_len} — raise max_len"
+        )
+    params = init_decoder(
+        seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn, max_len=max_len
+    )
+    return ModelSpec(
+        partial(_apply_tiny_gpt, max_new_tokens=max_new_tokens),
+        params,
+        (seq,),
+        (),
+        int_inputs="ids",
+    )
+
+
+def _apply_tiny_gpt(p, x, *, max_new_tokens: int):
+    from seldon_core_tpu.models.decoder import generate
+
+    return generate(p, x, max_new_tokens)
+
+
 def _register_heavy_models() -> None:
     """resnet50 / bert_base import lazily — they pull flax."""
     from seldon_core_tpu.models import resnet as _resnet  # noqa: F401

@@ -118,6 +118,27 @@ def blockwise_attention(
     return o / l[..., None]
 
 
+def causal_attention_auto(q, k, v) -> jax.Array:
+    """Backend-adaptive CAUSAL attention — the one policy shared by every
+    causal consumer (decoder prefill today): dense below FLASH_MIN_SEQ,
+    blockwise above it, the Pallas causal kernel on the TPU backend from
+    PALLAS_MIN_SEQ when the KV axis tiles. Mirrors models/bert.py's
+    non-causal `_default_attention` thresholds so the two policies cannot
+    drift apart in spirit."""
+    s = q.shape[2]
+    if s >= FLASH_MIN_SEQ:
+        if s >= PALLAS_MIN_SEQ and jax.default_backend() == "tpu" and k.shape[2] % 128 == 0:
+            from seldon_core_tpu.ops.pallas_flash import (
+                flash_attention,
+                pallas_available,
+            )
+
+            if pallas_available():
+                return flash_attention(q, k, v, causal=True)
+        return blockwise_attention(q, k, v, block_size=512, causal=True)
+    return naive_attention(q, k, v, causal=True)
+
+
 def naive_attention(q, k, v, *, causal: bool = False) -> jax.Array:
     """Reference O(seq^2) attention for testing."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.asarray(q.shape[-1] ** 0.5, q.dtype)
