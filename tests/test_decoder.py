@@ -78,6 +78,41 @@ def test_tiny_gpt_serves_as_deployment():
     np.testing.assert_array_equal(out.astype(np.int32), direct)
 
 
+def test_tiny_gpt_decodes_on_data_mesh():
+    """Generative serving shards like everything else: the same CR on a
+    data-axis mesh produces token-for-token the single-device output (the
+    KV caches are created inside jit and inherit the batch sharding)."""
+    from seldon_core_tpu.graph.spec import PredictiveUnit, TpuSpec
+    from seldon_core_tpu.models.zoo import make_jax_model_unit
+    from seldon_core_tpu.parallel.mesh import mesh_from_spec
+
+    spec = PredictiveUnit.model_validate(
+        {
+            "name": "gpt",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                {"name": "seq", "value": "8", "type": "INT"},
+                {"name": "max_new_tokens", "value": "4", "type": "INT"},
+                {"name": "vocab", "value": "64", "type": "INT"},
+            ],
+        }
+    )
+    mesh = mesh_from_spec({"data": 4})
+    sharded = make_jax_model_unit(
+        spec, {"tpu": TpuSpec(batch_buckets=[4], max_batch=4), "mesh": mesh}
+    )
+    plain = make_jax_model_unit(
+        spec, {"tpu": TpuSpec(batch_buckets=[4], max_batch=4)}
+    )
+    ids = _prompt(b=4, s=8, vocab=64, seed=11)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.runtime.predict(ids)).astype(np.int32),
+        np.asarray(plain.runtime.predict(ids)).astype(np.int32),
+    )
+
+
 def test_tiny_gpt_overflowing_config_rejected_at_build():
     from seldon_core_tpu.models.zoo import get_model
 

@@ -890,3 +890,29 @@ async def test_grpc_web_feedback_on_fast_ingress():
     finally:
         fast.close()
         await fast.wait_closed()
+
+
+def test_oauth_token_header_extraction_matches_python_parser():
+    """C-path metadata scan (_header_from_head) vs the Python fallback
+    parser: same value for every duplicate/case/whitespace arrangement —
+    last duplicate wins on both (the C/Python-agreement invariant)."""
+    import itertools
+
+    from seldon_core_tpu.serving.fast_http import _header_from_head, parse_head_py
+
+    cases = []
+    values = ["tokA", "tokB"]
+    for combo in itertools.product([0, 1, 2], ["oauth_token", "OAuth_Token"], ["", " ", "\t "]):
+        n, name, ows = combo
+        lines = [b"POST /seldon.tpu.Seldon/Predict HTTP/1.1", b"Host: t"]
+        for i in range(n):
+            lines.append(f"{name}:{ows}{values[i % 2]}".encode())
+        lines.append(b"Content-Length: 0")
+        cases.append(b"\r\n".join(lines) + b"\r\n\r\n")
+
+    for raw in cases:
+        parsed = parse_head_py(raw)
+        assert not isinstance(parsed, (int, tuple)), raw
+        py_val = parsed.headers.get("oauth_token")
+        c_val = _header_from_head(raw[: raw.find(b"\r\n\r\n") + 2], b"oauth_token")
+        assert c_val == py_val, f"divergence for head {raw!r}: {c_val!r} vs {py_val!r}"
