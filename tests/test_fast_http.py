@@ -711,6 +711,26 @@ async def test_fast_server_handler_exception_is_500_json():
         await server.wait_closed()
 
 
+
+def _oauth_gateway(dep_name: str = "dep1", key: str = "k1", secret: str = "s1"):
+    """Shared gateway stack for the gRPC-Web tests: returns (gw, token)."""
+    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
+    from seldon_core_tpu.gateway.oauth import OAuthProvider
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.graph.spec import DeploymentSpec
+
+    oauth = OAuthProvider()
+    store = DeploymentStore(oauth=oauth)
+    backend = InProcessBackend()
+    gw = Gateway(store=store, oauth=oauth, backend=backend)
+    store.deployment_added(
+        DeploymentSpec(name=dep_name, oauth_key=key, oauth_secret=secret)
+    )
+    backend.register(dep_name, _service())
+    token = oauth.issue_token(key, secret)["access_token"]
+    return gw, token
+
+
 # ------------------------------------------------------------- gRPC-Web
 
 
@@ -732,24 +752,12 @@ async def test_grpc_web_predict_on_fast_ingress_matches_native_grpc():
     a header, proto in/out, app-level failures inside the SeldonMessage."""
     import grpc
 
-    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
     from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
-    from seldon_core_tpu.gateway.oauth import OAuthProvider
-    from seldon_core_tpu.gateway.store import DeploymentStore
-    from seldon_core_tpu.graph.spec import DeploymentSpec
     from seldon_core_tpu.proto import prediction_pb2 as pb
     from seldon_core_tpu.proto.services import ServiceStub
     from seldon_core_tpu.serving.wire import grpc_web_frame
 
-    oauth = OAuthProvider()
-    store = DeploymentStore(oauth=oauth)
-    backend = InProcessBackend()
-    gw = Gateway(store=store, oauth=oauth, backend=backend)
-    store.deployment_added(
-        DeploymentSpec(name="dep1", oauth_key="k1", oauth_secret="s1")
-    )
-    backend.register("dep1", _service())
-    token = oauth.issue_token("k1", "s1")["access_token"]
+    gw, token = _oauth_gateway()
 
     req = pb.SeldonMessage()
     req.data.tensor.shape.extend([1, 3])
@@ -850,22 +858,10 @@ async def test_grpc_web_predict_on_fast_ingress_matches_native_grpc():
 
 
 async def test_grpc_web_feedback_on_fast_ingress():
-    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
-    from seldon_core_tpu.gateway.oauth import OAuthProvider
-    from seldon_core_tpu.gateway.store import DeploymentStore
-    from seldon_core_tpu.graph.spec import DeploymentSpec
     from seldon_core_tpu.proto import prediction_pb2 as pb
     from seldon_core_tpu.serving.wire import grpc_web_frame
 
-    oauth = OAuthProvider()
-    store = DeploymentStore(oauth=oauth)
-    backend = InProcessBackend()
-    gw = Gateway(store=store, oauth=oauth, backend=backend)
-    store.deployment_added(
-        DeploymentSpec(name="dep1", oauth_key="k1", oauth_secret="s1")
-    )
-    backend.register("dep1", _service())
-    token = oauth.issue_token("k1", "s1")["access_token"]
+    gw, token = _oauth_gateway()
 
     fb = pb.Feedback()
     fb.request.data.tensor.shape.extend([1, 3])
@@ -916,3 +912,50 @@ def test_oauth_token_header_extraction_matches_python_parser():
         py_val = parsed.headers.get("oauth_token")
         c_val = _header_from_head(raw[: raw.find(b"\r\n\r\n") + 2], b"oauth_token")
         assert c_val == py_val, f"divergence for head {raw!r}: {c_val!r} vs {py_val!r}"
+
+
+async def test_grpc_web_fuzz_never_crashes_always_frames():
+    """Robustness: arbitrary bytes at the gRPC-Web endpoint must never
+    raise out of the handler and must always come back as a well-formed
+    grpc-web response (DATA+trailer for app-level outcomes, trailers-only
+    for transport errors) with HTTP 200 — the grpc-web contract."""
+    import random
+
+    from seldon_core_tpu.proto import prediction_pb2 as pb
+    from seldon_core_tpu.serving.wire import grpc_web_frame
+
+    gw, token = _oauth_gateway()
+
+    rng = random.Random(0)
+    bodies = [b"", b"\x00", b"\x80\x00\x00\x00\x00", b"\x01\x00\x00\x00\x00"]
+    for _ in range(60):
+        n = rng.randrange(0, 40)
+        bodies.append(bytes(rng.randrange(256) for _ in range(n)))
+    # valid frame wrapping garbage proto bytes
+    bodies.append(grpc_web_frame(0, b"\xff\xfe\xfd"))
+    # valid frame + trailing junk (multi-frame rejection)
+    req = pb.SeldonMessage()
+    req.data.tensor.shape.extend([1, 1])
+    req.data.tensor.values.append(1.0)
+    bodies.append(grpc_web_frame(0, req.SerializeToString()) + b"JUNK")
+
+    port = free_port()
+    fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+    try:
+        for body in bodies:
+            st, hdrs, resp = await _http(
+                port,
+                "POST",
+                "/seldon.tpu.Seldon/Predict",
+                body,
+                {"Content-Type": "application/grpc-web+proto", "oauth_token": token},
+            )
+            assert st == 200, (body, st)
+            assert hdrs.get("content-type") == "application/grpc-web+proto"
+            frames = _grpc_web_frames(resp)
+            assert frames, (body, resp)
+            assert frames[-1][0] == 0x80, (body, resp)  # trailer frame last
+            assert b"grpc-status:" in frames[-1][1]
+    finally:
+        fast.close()
+        await fast.wait_closed()
