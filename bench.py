@@ -493,33 +493,12 @@ def serving_full_dag_chip(duration_s: float = 10.0) -> dict:
 
 
 def _gateway_stack(predictor):
-    """The shared bench serving stack: warmed PredictorServer behind the
-    OAuth gateway + in-process backend, with the serving GC policy applied
-    exactly as the product boot does. Returns (server, gw, oauth, token).
-    One definition so the REST/gRPC/gRPC-Web legs cannot drift."""
-    from seldon_core_tpu.gateway.app import Gateway, InProcessBackend
-    from seldon_core_tpu.gateway.oauth import OAuthProvider
-    from seldon_core_tpu.gateway.store import DeploymentStore
-    from seldon_core_tpu.graph.spec import DeploymentSpec
-    from seldon_core_tpu.serving.gc_policy import apply_serving_gc_policy
-    from seldon_core_tpu.serving.server import PredictorServer
+    """The measured serving stack — one definition for every tool
+    (seldon_core_tpu/tools/stack.py), so the bench legs, the soak
+    harness, and the product boot cannot drift apart."""
+    from seldon_core_tpu.tools.stack import build_gateway_stack
 
-    server = PredictorServer(predictor, deployment_name="bench")
-    server.warmup()
-    apply_serving_gc_policy()
-    oauth = OAuthProvider()
-    store = DeploymentStore(oauth=oauth)
-    backend = InProcessBackend()
-    gw = Gateway(store=store, oauth=oauth, backend=backend)
-    store.deployment_added(
-        DeploymentSpec(
-            name="bench", oauth_key="bench-key", oauth_secret="bench-secret",
-            predictors=[predictor],
-        )
-    )
-    backend.register("bench", server.service)
-    token = oauth.issue_token("bench-key", "bench-secret")["access_token"]
-    return server, gw, oauth, token
+    return build_gateway_stack(predictor)
 
 
 def _window_summary(

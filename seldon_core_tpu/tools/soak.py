@@ -1,0 +1,201 @@
+"""Soak harness: serve under sustained load and report stability.
+
+The reference validated long-running behavior with locust soaks against a
+cluster (SURVEY C24); this is the single-process twin with the two signals
+that actually catch serving regressions early:
+
+- **RSS slope** (MB/min, least-squares over per-second samples): a
+  positive slope under steady load is a leak — e.g. an unbounded cache, a
+  GC-frozen object churn, or a native buffer that never returns.
+- **event-loop lag** (p99 of per-second max samples): scheduling stalls
+  from GC, host-side compute, or ingress pathology, the same signal the
+  `seldon_tpu_event_loop_lag_ms` gauge exports in production.
+
+Runs the REAL stack: OAuth gateway -> fast ingress -> micro-batcher ->
+model, driven by the raw-conn load generator. One JSON line on stdout.
+
+    python -m seldon_core_tpu.tools.soak --duration 60 --users 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import resource
+import socket
+import sys
+import time
+
+
+def _rss_mb() -> float:
+    """CURRENT resident set (VmRSS), not the getrusage high-water mark —
+    a leak running below a prior RSS peak would be invisible to
+    ru_maxrss (it only ratchets), which is exactly the case a soak
+    exists to catch. Falls back to the high-water mark off-Linux."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def soak(
+    duration_s: float = 60.0,
+    users: int = 16,
+    model: str = "iris_mlp",
+    features: int = 4,
+    batch: int = 4,
+) -> dict:
+    from seldon_core_tpu.graph.defaulting import default_deployment
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+    from seldon_core_tpu.graph.validation import validate_deployment
+    from seldon_core_tpu.serving.fast_http import gateway_routes, start_fast_server
+    from seldon_core_tpu.tools.loadtest import run_load
+    from seldon_core_tpu.tools.stack import build_gateway_stack
+
+    dep = SeldonDeployment.from_dict(
+        {
+            "spec": {
+                "name": "soak",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "m",
+                            "type": "MODEL",
+                            "implementation": "JAX_MODEL",
+                            "parameters": [
+                                {"name": "model", "value": model, "type": "STRING"}
+                            ],
+                        },
+                    }
+                ],
+            }
+        }
+    )
+    dep = default_deployment(dep)
+    validate_deployment(dep)
+    predictor = dep.spec.predictors[0]
+
+    server, gw, oauth, _token = build_gateway_stack(
+        predictor,
+        deployment_name="soak",
+        oauth_key="soak-key",
+        oauth_secret="soak-secret",
+    )
+
+    port = _free_port()
+    fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
+
+    rss_samples: list[tuple[float, float]] = []
+    lag_samples: list[float] = []
+    stop = asyncio.Event()
+
+    async def sampler() -> None:
+        while not stop.is_set():
+            window_max_lag = 0.0
+            t_end = time.perf_counter() + 1.0
+            while time.perf_counter() < t_end and not stop.is_set():
+                t0 = time.perf_counter()
+                await asyncio.sleep(0.02)
+                window_max_lag = max(
+                    window_max_lag, time.perf_counter() - t0 - 0.02
+                )
+            rss_samples.append((time.perf_counter(), _rss_mb()))
+            lag_samples.append(window_max_lag * 1e3)
+
+    sampler_task = asyncio.ensure_future(sampler())
+    try:
+        stats = await run_load(
+            f"http://127.0.0.1:{port}",
+            users=users,
+            duration_s=duration_s,
+            features=features,
+            batch=batch,
+            oauth_key="soak-key",
+            oauth_secret="soak-secret",
+            static_payload=True,
+        )
+    finally:
+        stop.set()
+        await sampler_task
+        fast.close()
+        await fast.wait_closed()
+        if server.batcher is not None:
+            await server.batcher.close()
+
+    s = stats.summary()
+    # The in-process load GENERATOR keeps every request's latency +
+    # completion time for exact percentiles (tools/loadtest.py LoadStats)
+    # — that is real, expected growth of ~64 bytes/request in THIS
+    # process, not a server leak. Estimate it so the net server slope is
+    # the leak signal. (A measured 90 s iris soak: 45 MB raw growth,
+    # ~36 MB of it the stats lists.)
+    loadgen_mb = s["requests"] * 64 / 1e6
+    # least-squares slope over (minute, MB) samples
+    slope = 0.0
+    if len(rss_samples) >= 2:
+        t0 = rss_samples[0][0]
+        xs = [(t - t0) / 60.0 for t, _ in rss_samples]
+        ys = [m for _, m in rss_samples]
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        denom = sum((x - mx) ** 2 for x in xs)
+        if denom > 0:
+            slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+    lag_sorted = sorted(lag_samples)
+    return {
+        "duration_s": duration_s,
+        "users": users,
+        "model": model,
+        "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
+        "p99_ms": s["p99_ms"],
+        "errors": s["errors"],
+        "rss_start_mb": round(rss_samples[0][1], 1) if rss_samples else None,
+        "rss_end_mb": round(rss_samples[-1][1], 1) if rss_samples else None,
+        "rss_slope_mb_per_min": round(slope, 3),
+        "loadgen_stats_mb_est": round(loadgen_mb, 1),
+        # the leak signal: growth with the loadgen's own accounting removed
+        "rss_slope_net_mb_per_min": round(
+            slope - loadgen_mb / max(duration_s / 60.0, 1e-9), 3
+        ),
+        "loop_lag_p99_ms": round(
+            lag_sorted[min(len(lag_sorted) - 1, int(0.99 * len(lag_sorted)))], 2
+        ) if lag_sorted else None,
+        "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--users", type=int, default=16)
+    ap.add_argument("--model", default="iris_mlp")
+    ap.add_argument("--features", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args(argv)
+    out = asyncio.run(
+        soak(
+            duration_s=args.duration,
+            users=args.users,
+            model=args.model,
+            features=args.features,
+            batch=args.batch,
+        )
+    )
+    json.dump(out, sys.stdout)
+    print()
+
+
+if __name__ == "__main__":
+    main()

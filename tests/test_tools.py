@@ -648,3 +648,32 @@ def test_install_autoscaling_hpa():
         "PersistentVolumeClaim", "CustomResourceDefinition",
     ):
         assert expected in kinds, expected
+
+
+def test_soak_harness_reports_stability_signals():
+    """tools/soak.py in a SUBPROCESS (its boot applies the serving GC
+    policy — gc.freeze inside the shared pytest process would pin every
+    prior test's leftovers permanently): the leak/stall detector runs the
+    real gateway stack and reports RSS slope + loop lag + throughput."""
+    import json as json_mod
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    out_raw = subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.tools.soak", "--duration", "2", "--users", "4"],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+    )
+    assert out_raw.returncode == 0, out_raw.stderr[-1500:]
+    out = json_mod.loads(out_raw.stdout.strip().splitlines()[-1])
+    assert out["errors"] == 0
+    assert out["preds_per_sec"] > 0
+    assert out["rss_end_mb"] > 0 and out["rss_start_mb"] > 0
+    assert out["loop_lag_p99_ms"] is not None
+    assert "rss_slope_net_mb_per_min" in out
