@@ -276,8 +276,10 @@ async def _serve_gateway_and_load(
         b = server.batcher
         if b.stat_batches:
             out["mean_batch_rows"] = round(b.stat_rows / b.stat_batches, 1)
+            # stat_queue_wait_s now sums EVERY batch-mate's wait (not just
+            # the first item's) — the mean is per request, over stat_items
             out["mean_queue_wait_ms"] = round(
-                b.stat_queue_wait_s / b.stat_batches * 1e3, 2
+                b.stat_queue_wait_s / max(b.stat_items, 1) * 1e3, 2
             )
     return out
 
@@ -468,7 +470,16 @@ def serving_full_dag_chip(duration_s: float = 10.0) -> dict:
         },
         {
             "max_batch": 32,
-            "batch_buckets": [32],
+            # bucket LADDER, not a single 32 bucket (the r05 full_dag p99
+            # fix, PARITY "full_dag attribution"): 16 closed-loop users
+            # coalesce into <= 16-row batches, so a lone 32 bucket padded
+            # EVERY batch to 2x its rows — double BERT compute per walk —
+            # and the epsilon-greedy explore arm's 1-2 row split group
+            # padded to ANOTHER full 32-row forward, serialized on-device
+            # behind the greedy arm's. With the ladder each group runs in
+            # its snug bucket (all warmed ahead of traffic, zero live
+            # compiles, same policy as the multi-tenant legs).
+            "batch_buckets": [4, 8, 16, 32],
             "batch_timeout_ms": 10.0,
             "dtype": "bfloat16",
             # a DAG walk is several tunnel dispatches (transformer ->
@@ -816,6 +827,182 @@ def serving_grpc_web_gateway(duration_s: float = 6.0, users: int = 32) -> dict:
     )
 
 
+def serving_gen_cpu(
+    n_requests: int = 64, n_slots: int = 8, stagger_ms: float = 2.0
+) -> dict:
+    """The generative-tier leg: continuous-batching decode scheduler
+    (serving/decode_scheduler.py) vs the whole-batch ``lax.scan`` path at
+    EQUAL slot count, under staggered concurrent arrivals with per-request
+    token budgets — the workload iteration-level scheduling exists for.
+
+    Same decoder deployment both ways (seq 16, max_new cap 64, hidden 256
+    x 4 layers — big enough that per-step compute, not Python dispatch,
+    dominates, which is the regime a real accelerator serves in): the
+    scheduler admits each arrival into a free KV slot between steps and
+    retires it at its own budget; the scan path coalesces arrivals into
+    bucket-``n_slots`` batches that each run the FULL 64 steps (a
+    deployment-level constant there) with later arrivals blocked behind
+    the running generation. Budgets are heavy-tailed (most generations
+    short, a few at the cap — the EOS-shaped distribution the cap must
+    provision for). Useful tokens = each request's own budget for both
+    paths (the scan path computes 64 for everyone and the client
+    truncates — exactly the waste the scheduler removes), so tokens/s is
+    an apples-to-apples rate of DELIVERED tokens.
+
+    Both paths are driven through the same service + batcher layers with
+    buffered responses; TTFT / inter-token latency come from the
+    scheduler's own metrics hooks (what production prometheus exports —
+    the per-token SSE transport is covered by the e2e streaming test).
+    The scan path has no first-token concept: its request latency IS its
+    time-to-first-visible-token."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # runs inside the CPU subprocess
+
+    from seldon_core_tpu.core.message import Meta, SeldonMessage
+    from seldon_core_tpu.metrics import NullMetrics
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    seq, max_new, vocab = 16, 64, 512
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, vocab, (n_requests, seq)).astype(np.int32)
+    budgets = rng.choice([8, 16, 32, 64], size=n_requests, p=[0.4, 0.3, 0.2, 0.1])
+    stagger_s = stagger_ms / 1000.0
+
+    class _LatencyRecorder(NullMetrics):
+        def __init__(self):
+            self.ttfts: list[float] = []
+            self.itls: list[float] = []
+
+        def decode_ttft(self, deployment, duration_s):
+            self.ttfts.append(duration_s)
+
+        def decode_inter_token(self, deployment, duration_s):
+            self.itls.append(duration_s)
+
+    def _pred(decode_slots: int):
+        tpu = {
+            "max_batch": n_slots,
+            "batch_buckets": [n_slots],
+            "batch_timeout_ms": 4.0,
+            # the scan path's later arrivals queue behind whole-batch
+            # generations for seconds on the CPU backend — that latency is
+            # the measurement, not a timeout
+            "queue_timeout_ms": 120000.0,
+        }
+        if decode_slots:
+            tpu["decode_slots"] = decode_slots
+        return _graph_predictor(
+            {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": str(seq), "type": "INT"},
+                    {"name": "max_new_tokens", "value": str(max_new), "type": "INT"},
+                    {"name": "vocab", "value": str(vocab), "type": "INT"},
+                    {"name": "hidden", "value": "256", "type": "INT"},
+                    {"name": "layers", "value": "4", "type": "INT"},
+                    {"name": "ffn", "value": "1024", "type": "INT"},
+                    {"name": "max_len", "value": str(seq + max_new), "type": "INT"},
+                ],
+            },
+            tpu,
+        )
+
+    def _msg(i: int) -> "SeldonMessage":
+        return SeldonMessage.from_array(
+            prompts[i : i + 1],
+            meta=Meta(tags={"max_new_tokens": int(budgets[i])}),
+        )
+
+    def _pct(vals: list, q: float) -> float:
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(q / 100 * len(vals)))] * 1e3, 2)
+
+    async def run_scheduler() -> dict:
+        server = PredictorServer(_pred(n_slots), deployment_name="gen")
+        server.warmup()
+        rec = _LatencyRecorder()
+        server.decode_scheduler._metrics = rec
+        t0 = time.perf_counter()
+
+        async def one(i: int) -> int:
+            await asyncio.sleep(i * stagger_s)
+            out = await server.service.predict(_msg(i))
+            return int(out.meta.tags["gen_lens"][0])
+
+        tokens = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        elapsed = time.perf_counter() - t0
+        sched = server.decode_scheduler
+        out = {
+            "tokens_per_sec": round(sum(tokens) / elapsed, 2),
+            "ttft_p50_ms": _pct(rec.ttfts, 50),
+            "ttft_p99_ms": _pct(rec.ttfts, 99),
+            "inter_token_p99_ms": _pct(rec.itls, 99),
+            "slot_occupancy_mean": round(
+                sched.stat_occupancy_sum / max(sched.stat_steps, 1), 3
+            ),
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+            "steps": sched.stat_steps,
+        }
+        await sched.close()
+        if server.batcher is not None:
+            await server.batcher.close()
+        assert list(tokens) == [int(b) for b in budgets], "budget mismatch"
+        return out
+
+    async def run_scan() -> dict:
+        server = PredictorServer(_pred(0), deployment_name="gen-scan")
+        server.warmup()
+        lats: list[float] = []
+        t0 = time.perf_counter()
+
+        async def one(i: int) -> int:
+            await asyncio.sleep(i * stagger_s)
+            sent = time.perf_counter()
+            out = await server.service.predict(_msg(i))
+            lats.append(time.perf_counter() - sent)
+            assert np.asarray(out.array).shape[1] == seq + max_new
+            return int(budgets[i])  # delivered tokens: the client's budget
+
+        tokens = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        elapsed = time.perf_counter() - t0
+        out = {
+            "tokens_per_sec": round(sum(tokens) / elapsed, 2),
+            # the scan path's first visible token is the whole response
+            "ttft_p50_ms": _pct(lats, 50),
+            "ttft_p99_ms": _pct(lats, 99),
+        }
+        if server.batcher is not None:
+            await server.batcher.close()
+        return out
+
+    sched = asyncio.run(run_scheduler())
+    scan = asyncio.run(run_scan())
+    speedup = (
+        round(sched["tokens_per_sec"] / scan["tokens_per_sec"], 2)
+        if scan["tokens_per_sec"]
+        else 0.0
+    )
+    return {
+        "scenario": {
+            "requests": n_requests,
+            "n_slots": n_slots,
+            "seq": seq,
+            "max_new_cap": max_new,
+            "budgets": "choice(8,16,32,64; p=.4/.3/.2/.1)",
+            "stagger_ms": stagger_ms,
+        },
+        "scheduler": sched,
+        "scan": scan,
+        "tokens_per_sec_speedup": speedup,
+    }
+
+
 def serving_moe_cpu(duration_s: float = 6.0) -> dict:
     """Expert-parallel model through the full gateway stack (VERDICT r4
     Next #5): the moe_mlp zoo entry (dense top-1 dispatch, ops/moe.py) at
@@ -996,10 +1183,10 @@ async def _multi_tenant_load(
         }
         b = batchers.get(name)
         if b is not None and b.stat_batches:
-            # attribution: achieved batch size + queue wait per tenant
+            # attribution: achieved batch size + per-REQUEST queue wait
             entry["mean_batch_rows"] = round(b.stat_rows / b.stat_batches, 1)
             entry["mean_queue_wait_ms"] = round(
-                b.stat_queue_wait_s / b.stat_batches * 1e3, 2
+                b.stat_queue_wait_s / max(b.stat_items, 1) * 1e3, 2
             )
         per_tenant[name] = entry
     return {
@@ -1172,7 +1359,8 @@ def compact_record(full: dict) -> dict:
     carrying EVERY figure README/PARITY cite: kernel, stack ceiling, abtest,
     grpc, fused/unfused combiner + fusion_speedup, full DAG, wire matrix,
     multi-tenant aggregates (hetero + homo) + loop lag, loadgen sweep,
-    pallas-vs-blockwise, MoE, BERT MFU, floors."""
+    pallas-vs-blockwise, MoE, BERT MFU, the generative-tier scheduler-vs-
+    scan leg (tokens/s, TTFT, inter-token, occupancy), floors."""
     c = {k: full[k] for k in ("metric", "value", "unit", "vs_baseline") if k in full}
     c["legend"] = "[preds/s,p50_ms,p99_ms,errs]"
     srv = full.get("serving") or {}
@@ -1242,6 +1430,22 @@ def compact_record(full: dict) -> dict:
             "p99s": _tenant_p99s(mt),
             "homo_p99s": _tenant_p99s(homo),
             "lag_max_ms": [mt.get("loop_lag_max_ms"), homo.get("loop_lag_max_ms")],
+        }
+    gen = srv.get("gen") or {}
+    if gen:
+        gs = gen.get("scheduler") or {}
+        gn = gen.get("scan") or {}
+        c["gen"] = {
+            "tok_s": gs.get("tokens_per_sec"),
+            "tok_s_scan": gn.get("tokens_per_sec"),
+            "speedup": gen.get("tokens_per_sec_speedup"),
+            "ttft_p50": gs.get("ttft_p50_ms"),
+            "ttft_p99": gs.get("ttft_p99_ms"),
+            "itl_p99": gs.get("inter_token_p99_ms"),
+            "scan_lat_p50": gn.get("ttft_p50_ms"),
+            "occ": gs.get("slot_occupancy_mean"),
+            "recompiles": gs.get("recompiles_after_warmup"),
+            "slots": (gen.get("scenario") or {}).get("n_slots"),
         }
     pallas = srv.get("pallas_long_seq") or {}
     if pallas:
@@ -1349,6 +1553,9 @@ def main() -> None:
         out["grpc_web"] = serving_grpc_web_gateway(duration_s=6.0)
         # expert-parallel deployment through the same stack (r4 Next #5)
         out["moe_cpu"] = serving_moe_cpu()
+        # generative tier: continuous-batching decode scheduler vs the
+        # whole-batch scan path, staggered arrivals, equal slot count
+        out["gen"] = serving_gen_cpu()
         # image-class wire comparison: REST+npy vs gRPC binData, same model
         out["wire_matrix"] = wire_matrix_cpu()
         out["multi_tenant"] = multi_tenant_cpu()
@@ -1409,6 +1616,8 @@ def main() -> None:
                 serving["grpc_web"] = ceiling.pop("grpc_web")
             if "moe_cpu" in ceiling:
                 serving["moe_cpu"] = ceiling.pop("moe_cpu")
+            if "gen" in ceiling:
+                serving["gen"] = ceiling.pop("gen")
         floors = {
             "dispatch_rtt_p50_ms": rtt_ms,
             "transfer_mb_s": measure_transfer_mb_s(),

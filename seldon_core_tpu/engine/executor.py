@@ -190,9 +190,37 @@ class GraphExecutor:
                 result.append(out.with_meta(meta))
         return result
 
+    @staticmethod
+    async def _settle_to_host(out: SeldonMessage) -> SeldonMessage:
+        """Read an accelerator-resident result back to host OFF the event
+        loop before row-scattering it. _scatter_rows's np.asarray on a
+        device array is a BLOCKING readback (device compute + transfer);
+        run on the loop it would stall the ingress, the batcher's timers,
+        and every concurrent branch group for the whole device latency of
+        each batch — measured as the full_dag leg's p99 blowup (PARITY
+        "full_dag attribution"). XLA releases the GIL during the copy, so
+        the worker-pool overlap is real. CPU-backend arrays view host
+        memory (readback is free) and skip the hop."""
+        arr = out.array
+        if arr is None:
+            return out
+        import jax  # lazy: the executor itself has no jax dependency
+
+        if not isinstance(arr, jax.Array):
+            return out
+        if all(d.platform == "cpu" for d in arr.devices()):
+            return out
+        from seldon_core_tpu.models.base import compute_pool
+
+        host = await asyncio.get_running_loop().run_in_executor(
+            compute_pool(), np.asarray, arr
+        )
+        return out.with_array(host)
+
     async def _merged_call(self, node, method_name, method, msgs, spans):
         merged = self._merge_rows(msgs)
         out = await self._timed(node, method_name, method(merged), spans)
+        out = await self._settle_to_host(out)
         return self._scatter_rows(msgs, out)
 
     async def _get_output_many(
@@ -297,6 +325,7 @@ class GraphExecutor:
             out = await self._timed(
                 node, "aggregate", unit.aggregate(merged_children), spans
             )
+            out = await self._settle_to_host(out)
             base = []
             for i, m in enumerate(msgs):
                 meta = m.meta

@@ -168,6 +168,22 @@ class TpuSpec(_Spec):
     # releases the GIL during execution, so the overlap is real);
     # "always"/"never" force the decision
     offload_compute: str = "auto"
+    # Continuous-batching decode scheduler for GENERATIVE predictors
+    # (serving/decode_scheduler.py). decode_slots > 0 opts a single-node
+    # decoder deployment into iteration-level scheduling over a slot KV
+    # cache: requests admit into free slots between steps and retire on EOS
+    # / their own max_new_tokens instead of riding one whole-batch scan.
+    # 0 (default) keeps the fused lax.scan path.
+    decode_slots: int = 0
+    # EOS token id that retires a sequence early (-1: no EOS, every
+    # sequence runs its max_new_tokens)
+    decode_eos_id: int = -1
+    # deployment-default sampling; per-request overrides ride meta.tags
+    # (temperature / top_k / max_new_tokens). temperature <= 0 = greedy
+    # (the fused-oracle-equivalent default), top_k <= 0 = full vocabulary.
+    decode_temperature: float = 0.0
+    decode_top_k: int = 0
+    decode_seed: int = 0
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

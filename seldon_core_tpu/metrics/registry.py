@@ -51,7 +51,18 @@ class NullMetrics:
     def feedback(self, deployment: str, predictor: str, unit: str, reward: float) -> None:
         pass
 
-    def batch(self, deployment: str, size: int, queue_wait_s: float) -> None:
+    def batch(self, deployment: str, size: int, queue_waits_s) -> None:
+        """``queue_waits_s``: the per-request waits of EVERY batch-mate (a
+        float is accepted for a single request)."""
+        pass
+
+    def decode_step(self, deployment: str, active: int, slots: int) -> None:
+        pass
+
+    def decode_ttft(self, deployment: str, duration_s: float) -> None:
+        pass
+
+    def decode_inter_token(self, deployment: str, duration_s: float) -> None:
         pass
 
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
@@ -145,6 +156,35 @@ class Metrics(NullMetrics):
             registry=registry,
         )
         self._loop_lag_max_val = 0.0
+        # generative tier (serving/decode_scheduler.py): slot occupancy per
+        # step, step counter, and the two latency contracts streaming
+        # clients feel — time-to-first-token and inter-token latency
+        self._decode_occupancy = Gauge(
+            "seldon_tpu_decode_slot_occupancy",
+            "Active decode slots / total slots at the last scheduler step",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._decode_steps = Counter(
+            "seldon_tpu_decode_steps_total",
+            "Decode scheduler steps executed",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._decode_ttft = Histogram(
+            "seldon_tpu_decode_ttft_seconds",
+            "Time from request arrival to its first generated token",
+            ["deployment_name"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._decode_itl = Histogram(
+            "seldon_tpu_decode_inter_token_seconds",
+            "Latency between consecutive generated tokens of one sequence",
+            ["deployment_name"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
         # SHADOW router candidate validation: per-shadow-child prediction
         # agreement with the primary (argmax match on classifier outputs)
         self._shadow = Counter(
@@ -167,9 +207,26 @@ class Metrics(NullMetrics):
         self._feedback.labels(deployment, predictor, unit).inc()
         self._feedback_reward.labels(deployment, predictor, unit).inc(reward)
 
-    def batch(self, deployment, size, queue_wait_s):
+    def batch(self, deployment, size, queue_waits_s):
         self._batch_size.labels(deployment).observe(size)
-        self._queue_wait.labels(deployment).observe(queue_wait_s)
+        # the queue-wait histogram is PER REQUEST: every batch-mate's wait
+        # is observed, not just the first item's (which under-reported the
+        # wait of everyone coalesced behind it)
+        if isinstance(queue_waits_s, (int, float)):
+            queue_waits_s = (queue_waits_s,)
+        h = self._queue_wait.labels(deployment)
+        for w in queue_waits_s:
+            h.observe(w)
+
+    def decode_step(self, deployment, active, slots):
+        self._decode_occupancy.labels(deployment).set(active / slots if slots else 0.0)
+        self._decode_steps.labels(deployment).inc()
+
+    def decode_ttft(self, deployment, duration_s):
+        self._decode_ttft.labels(deployment).observe(duration_s)
+
+    def decode_inter_token(self, deployment, duration_s):
+        self._decode_itl.labels(deployment).observe(duration_s)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

@@ -119,6 +119,10 @@ class ModelRuntime:
         # measured forward time; until then only "always" offloads.
         self.offload_compute_mode = offload_compute
         self.offload_compute = offload_compute == "always"
+        # generative decode geometry ({"seq", "max_new_tokens"}) — set by
+        # the zoo factory for decoder models; consumed by the decode
+        # scheduler opt-in (serving/decode_scheduler.scheduler_for_executor)
+        self.generative: dict | None = None
         self.stat_forward_ms: float | None = None
         self.buckets = tuple(buckets) if buckets else default_buckets(max_batch)
         if mesh is not None and data_axis in mesh.axis_names:

@@ -242,6 +242,160 @@ def _embed_one(params, tok: jax.Array, pos) -> jax.Array:
     )[None, :, :]
 
 
+# --------------------------------------------------------------------------
+# Continuous-batching building blocks (serving/decode_scheduler.py).
+#
+# The fused ``generate`` above runs one whole batch to completion inside a
+# single lax.scan — the correctness oracle. The functions below split that
+# program into the three pieces iteration-level scheduling needs:
+#   prefill()      one causal pass over a prompt -> per-sequence K/V + the
+#                  last-position logits (the first generated token's logits)
+#   init_slot_cache / write_prefill  a STATIC [L, n_slots, h, max_ctx, hd]
+#                  cache, sequences scattered into slots
+#   decode_step()  one token for EVERY slot at per-slot positions — batch
+#                  composition changes between steps without shape changes
+#   sample_tokens  per-slot temperature/top-k sampling, greedy at temp<=0
+# All shapes are static in (n_slots, max_ctx), so one XLA program per
+# function serves every batch composition (zero recompiles after warmup).
+
+
+def decoder_dims(params: dict) -> dict:
+    """Static geometry the scheduler sizes its cache from."""
+    hidden = params["layers"][0]["qkv"]["w"].shape[0]
+    heads = _heads(params)
+    return {
+        "layers": len(params["layers"]),
+        "heads": heads,
+        "hidden": hidden,
+        "head_dim": hidden // heads,
+        "vocab": params["tok_emb"].shape[0],
+        "max_len": params["pos_emb"].shape[0],
+    }
+
+
+def prefill(params: dict, ids: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One causal pass over prompts ids[b, s] -> (logits[b, vocab],
+    k[L, b, h, s, hd], v[L, b, h, s, hd]).
+
+    Same math as the fused generate's prefill phase (shared _layer_prefill /
+    causal-attention policy), but the K/V comes back to the caller to be
+    scattered into slots instead of being written into a private cache."""
+    ids = ids.astype(jnp.int32)
+    heads = _heads(params)
+    x = _embed(params, ids)
+    ks, vs = [], []
+    for lp in params["layers"]:
+        x, k, v = _layer_prefill(lp, x, heads)
+        ks.append(k)
+        vs.append(v)
+    logits = _logits(params, x[:, -1:, :])[:, 0, :]
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def init_slot_cache(
+    params: dict, n_slots: int, max_ctx: int, dtype=jnp.float32
+) -> tuple[jax.Array, jax.Array]:
+    """Zeroed slot KV cache pair, each [L, n_slots, heads, max_ctx, hd]."""
+    d = decoder_dims(params)
+    shape = (d["layers"], n_slots, d["heads"], max_ctx, d["head_dim"])
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prefill(
+    cache_k: jax.Array, cache_v: jax.Array, k: jax.Array, v: jax.Array, slot
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter one prefilled sequence's K/V (k[L, 1, h, s, hd]) into ``slot``
+    positions 0..s-1 via lax.dynamic_update_slice. Jitted by the scheduler
+    with cache donation, so the update is in-place in HBM."""
+    cache_k = lax.dynamic_update_slice(cache_k, k, (0, slot, 0, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v, (0, slot, 0, 0, 0))
+    return cache_k, cache_v
+
+
+def _layer_step_slots(p, x, cache_k, cache_v, positions, h):
+    """_layer_step generalized to PER-SLOT positions. x: [n, 1, d]; cache
+    [n, h, max_ctx, hd]; positions: [n] (slot i's token sits at
+    positions[i]; cache entries <= positions[i] are valid)."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, h)  # [n, h, 1, hd]
+    k = _split_heads(k, h)
+    v = _split_heads(v, h)
+    # per-slot scatter: vmap over the slot axis turns the per-sequence
+    # dynamic_update_slice into one batched scatter — no host loop, no
+    # per-slot programs
+    write = jax.vmap(lambda c, kk, pos: lax.dynamic_update_slice(c, kk, (0, pos, 0)))
+    cache_k = write(cache_k, k, positions)
+    cache_v = write(cache_v, v, positions)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum(
+        "nhqd,nhkd->nhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)
+    ) * scale
+    valid = jnp.arange(cache_k.shape[2])[None, :] <= positions[:, None]  # [n, max_ctx]
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p_attn = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("nhqk,nhkd->nhqd", p_attn, cache_v.astype(jnp.float32))
+    ctx = _merge_heads(ctx.astype(x.dtype))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, cache_k, cache_v
+
+
+def decode_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for every slot: consume tokens[n] sitting at
+    positions[n], return (logits[n, vocab], cache_k, cache_v) with each
+    slot's K/V written at its own position.
+
+    Free slots step too (their compute is the price of static shapes); the
+    scheduler passes position 0 for them and their garbage K/V is
+    overwritten by the next admission's prefill scatter."""
+    heads = _heads(params)
+    x = jnp.asarray(params["tok_emb"])[tokens][:, None, :]
+    x = x + jnp.asarray(params["pos_emb"])[positions][:, None, :]
+    new_k, new_v = [], []
+    for li, lp in enumerate(params["layers"]):
+        x, ck, cv = _layer_step_slots(lp, x, cache_k[li], cache_v[li], positions, heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    logits = _logits(params, x)[:, 0, :]
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def sample_tokens(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+) -> jax.Array:
+    """Per-row sampling: greedy argmax where temperature <= 0 (the serving
+    default — what the fused oracle computes), else temperature-scaled
+    categorical restricted to the top_k logits (top_k <= 0 means the full
+    vocabulary). top_k is data, not shape: the cutoff is looked up in the
+    sorted logits, so one compiled program serves every per-request k."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [n, 1]
+    restricted = jnp.where(logits < thresh, -jnp.inf, logits)
+    masked = jnp.where(top_k[:, None] > 0, restricted, logits)
+    scaled = masked / jnp.maximum(temperature, 1e-6)[:, None].astype(logits.dtype)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 def reference_generate(params: dict, ids: np.ndarray, max_new_tokens: int) -> np.ndarray:
     """Cache-less reference: full forward per step (the slow obvious
     implementation the scan version must match token-for-token)."""

@@ -45,6 +45,11 @@ class ModelSpec:
     # tabular) and normalize to the model dtype; "ids" = integers are token
     # ids and stay exact int32 (ModelRuntime wire-dtype policy)
     int_inputs: str = "cast"
+    # generative decoders (models/decoder.py layout) advertise their decode
+    # geometry here ({"seq": prompt bucket, "max_new_tokens": cap}) so the
+    # serving layer can offer the continuous-batching decode scheduler
+    # (tpu.decode_slots) as an alternative to the fused whole-batch apply
+    generative: dict | None = None
 
 
 Builder = Callable[..., ModelSpec]
@@ -411,6 +416,7 @@ def build_tiny_gpt(
         (seq,),
         (),
         int_inputs="ids",
+        generative={"seq": seq, "max_new_tokens": max_new_tokens},
     )
 
 
@@ -453,6 +459,7 @@ def _runtime_from_modelspec(ms: ModelSpec, tpu_cfg, mesh=None) -> ModelRuntime:
         offload_compute=getattr(tpu_cfg, "offload_compute", "auto"),
     )
     rt.feature_shape = ms.feature_shape
+    rt.generative = ms.generative
     return rt
 
 

@@ -22,11 +22,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from seldon_core_tpu.parallel.compat import pvary
+from seldon_core_tpu.parallel.compat import pvary, shard_map as _shard_map
 
 from seldon_core_tpu.ops.attention import NEG_INF, _block_stats, combine_stats
-
-_shard_map = jax.shard_map  # jax>=0.7 top-level export
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, seq_per_dev: int, vary_axes: tuple):

@@ -39,7 +39,7 @@ from seldon_core_tpu.ops.attention import (
     naive_attention,
 )
 
-_shard_map = jax.shard_map  # jax>=0.7 top-level export
+from seldon_core_tpu.parallel.compat import shard_map as _shard_map
 
 
 def _local_attention(q, k, v, causal: bool, vary_axes: tuple):

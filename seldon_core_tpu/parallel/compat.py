@@ -2,12 +2,27 @@
 
 from __future__ import annotations
 
+import jax
 from jax import lax
 
 
 def pvary(x, axes):
     """Mark x as varying over manual mesh axes. jax >= 0.9 renamed
-    lax.pvary to lax.pcast(..., to='varying')."""
+    lax.pvary to lax.pcast(..., to='varying'); jax <= 0.5 has neither and
+    does not type scan carries by mesh-axis variance, so identity is
+    correct there."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
+
+
+def shard_map(*args, **kwargs):
+    """jax >= 0.7 exports shard_map at top level; older versions keep it in
+    jax.experimental.shard_map."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(*args, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(*args, **kwargs)

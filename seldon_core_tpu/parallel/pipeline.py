@@ -100,7 +100,9 @@ def pipeline_apply(
                 f"'{pipe_axis}' axis has {n_stages} devices — they must match"
             )
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
-    fn = jax.shard_map(
+    from seldon_core_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         partial(_pipeline_local, stage_fn=stage_fn, axis_name=pipe_axis),
         mesh=mesh,
         in_specs=(param_specs, P()),

@@ -40,13 +40,31 @@ def make_batcher(
     execute_many: "ExecuteManyFn | None" = None,
     metrics=None,
     deployment_name: str = "",
+    decode_scheduler=None,
 ) -> "MicroBatcher | None":
     """The one place batching policy is decided from a predictor's TpuSpec:
     None when batching is disabled (batch_across_requests false — the
     per-request escape hatch) or pointless (max_batch <= 1). Used by both
     the engine server and the reconciler so their gating can't drift.
     ``execute_many`` (GraphExecutor.execute_many) gives routers per-request
-    decisions under batching; without it the merged batch routes as one."""
+    decisions under batching; without it the merged batch routes as one.
+
+    ``decode_scheduler`` (serving/decode_scheduler.DecodeScheduler): a
+    generative predictor's continuous-batching loop. When set, tensor
+    requests are handed to the scheduler — iteration-level slot admission
+    replaces shape-keyed coalescing entirely, so a batcher is returned even
+    when max_batch would otherwise disable one."""
+    if decode_scheduler is not None:
+        return MicroBatcher(
+            execute,
+            execute_many=execute_many,
+            max_batch=getattr(tpu_spec, "max_batch", 64),
+            batch_timeout_ms=getattr(tpu_spec, "batch_timeout_ms", 3.0),
+            queue_timeout_ms=getattr(tpu_spec, "queue_timeout_ms", 2000.0),
+            metrics=metrics,
+            deployment_name=deployment_name,
+            decode_scheduler=decode_scheduler,
+        )
     if not getattr(tpu_spec, "batch_across_requests", True):
         return None
     if getattr(tpu_spec, "max_batch", 1) <= 1:
@@ -87,9 +105,13 @@ class MicroBatcher:
         queue_timeout_ms: float = 2000.0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
+        decode_scheduler=None,
     ):
         self._execute = execute
         self._execute_many = execute_many
+        # generative tier: tensor requests bypass coalescing and ride the
+        # continuous-batching decode loop (per-row slot admission)
+        self._decode = decode_scheduler
         self.max_batch = max_batch
         self.batch_timeout_s = batch_timeout_ms / 1000.0
         self.queue_timeout_s = queue_timeout_ms / 1000.0
@@ -106,7 +128,10 @@ class MicroBatcher:
         # prometheus histograms carry the same data for production scrapes
         self.stat_batches = 0
         self.stat_rows = 0
+        # SUM of per-item queue waits (every batch-mate, not just the first
+        # enqueued item) — divide by stat_items for the mean per request
         self.stat_queue_wait_s = 0.0
+        self.stat_items = 0
         self.stat_passthrough = 0  # requests that bypassed coalescing
 
     async def submit(self, msg: SeldonMessage) -> SeldonMessage:
@@ -119,6 +144,11 @@ class MicroBatcher:
         if arr is None:
             # non-tensor payloads can't batch — run through directly
             return await self._execute(msg)
+        if self._decode is not None:
+            # generative predictor: iteration-level scheduling replaces
+            # shape-keyed coalescing — every row admits into a KV slot as
+            # one becomes free, retires on EOS / its own max_new_tokens
+            return await self._decode.execute_message(msg)
         if "trace" in msg.meta.tags:
             # traced requests bypass coalescing: spans must describe THIS
             # request, and batch-mates must not inherit its trace tags
@@ -174,8 +204,12 @@ class MicroBatcher:
         total_rows = sum(i.rows for i in items)
         self.stat_batches += 1
         self.stat_rows += total_rows
-        self.stat_queue_wait_s += now - items[0].enqueued_at
-        self._metrics.batch(self._deployment, total_rows, now - items[0].enqueued_at)
+        # per-item waits: items[0] is the FIRST enqueued (longest wait);
+        # accounting only it under-reported every other batch-mate's wait
+        waits = [now - i.enqueued_at for i in items]
+        self.stat_queue_wait_s += sum(waits)
+        self.stat_items += len(items)
+        self._metrics.batch(self._deployment, total_rows, waits)
         try:
             if len(items) > 1 and self._execute_many is not None:
                 # split-batch dispatch: data nodes run merged, route nodes

@@ -13,7 +13,9 @@ Not a general web server, by design:
 - no chunked request bodies (411 if no Content-Length; serving clients and
   the reference's engines always send it),
 - no TLS (terminate at the LB, as the reference's ingress does),
-- no streaming responses, no websockets.
+- no websockets. Streaming RESPONSES exist for exactly one surface: the
+  generative tier's per-token SSE endpoint (chunked transfer, see
+  _write_stream) — request bodies stay Content-Length-framed.
 The full aiohttp apps remain for everything else (admin, tests, tooling);
 `PredictorServer`/platform keep them unless fast ingress is requested.
 """
@@ -24,7 +26,7 @@ import asyncio
 import logging
 from typing import Awaitable, Callable, Mapping
 
-from seldon_core_tpu.serving.wire import WireRequest, WireResponse
+from seldon_core_tpu.serving.wire import WireRequest, WireResponse, WireStreamResponse
 
 log = logging.getLogger(__name__)
 
@@ -328,6 +330,9 @@ class HttpProtocol(asyncio.Protocol):
         except Exception:  # noqa: BLE001 - handler contract is no-raise; belt+braces
             log.exception("fast-ingress handler failed for %s", req.path)
             resp = WireResponse(status=500, body=b'{"status":"FAILURE"}')
+        if isinstance(resp, WireStreamResponse):
+            await self._write_stream(resp, keep_alive)
+            return
         self._write_response(resp, keep_alive)
 
     def _on_handler_done(self, task: asyncio.Task) -> None:
@@ -368,6 +373,62 @@ class HttpProtocol(asyncio.Protocol):
         if not keep_alive:
             self._close()
 
+    async def _write_stream(self, resp: WireStreamResponse, keep_alive: bool = True) -> None:
+        """Streaming (SSE) response under Transfer-Encoding: chunked — the
+        one place the fast ingress emits a body it does not know the length
+        of up front. Each event is one chunk, flushed as it is produced, so
+        a generative client sees token i while token i+1 is still being
+        decoded. Chunked framing keeps the connection reusable; a consumer
+        that vanishes mid-stream just ends the write loop."""
+        t = self._transport
+        if t is None:
+            # connection already gone: still close the event source so the
+            # in-flight generation is cancelled, not left running for a
+            # vanished client
+            aclose = getattr(resp.events, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 - nothing to respond to
+                    log.exception("stream close failed")
+            return
+        extra = b""
+        for k, v in resp.headers.items():
+            extra += f"{k}: {v}\r\n".encode()
+        t.write(
+            _status_line(resp.status)
+            + b"Content-Type: " + resp.content_type.encode() + b"\r\n"
+            + b"Transfer-Encoding: chunked\r\n"
+            + b"Cache-Control: no-cache\r\n"
+            + extra
+            + (b"Connection: keep-alive\r\n\r\n" if keep_alive else b"Connection: close\r\n\r\n")
+        )
+        try:
+            async for chunk in resp.events:
+                if self._transport is None or self._closing:
+                    break
+                if not chunk:
+                    continue
+                self._transport.write(
+                    f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                )
+        finally:
+            # close the event source DETERMINISTICALLY: on client
+            # disconnect the break above leaves the async generator
+            # suspended, and only aclose() runs its finally blocks (which
+            # cancel the in-flight generation) — waiting for GC would keep
+            # a vanished client's sequences occupying KV slots
+            aclose = getattr(resp.events, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 - teardown must not mask the response
+                    log.exception("stream close failed")
+            if self._transport is not None and not self._closing:
+                self._transport.write(b"0\r\n\r\n")
+                if not keep_alive:
+                    self._close()
+
     def _respond_simple(self, status: int, text: bytes, keep_alive: bool = False) -> None:
         self._write_response(
             WireResponse(status=status, body=text, content_type="text/plain"),
@@ -395,6 +456,9 @@ def engine_routes(service, state: dict, metrics=None) -> dict:
     async def predictions(req: WireRequest) -> WireResponse:
         return await wire.engine_predictions(service, req)
 
+    async def predictions_stream(req: WireRequest):
+        return await wire.engine_predictions_stream(service, req)
+
     async def feedback(req: WireRequest) -> WireResponse:
         return await wire.engine_feedback(service, req)
 
@@ -420,6 +484,9 @@ def engine_routes(service, state: dict, metrics=None) -> dict:
 
     routes: dict = {
         ("POST", "/api/v0.1/predictions"): predictions,
+        # per-token SSE streaming for generative deployments; the buffered
+        # /predictions contract above is untouched
+        ("POST", "/api/v0.1/predictions/stream"): predictions_stream,
         ("POST", "/api/v0.1/feedback"): feedback,
         ("GET", "/ready"): ready,
         ("GET", "/ping"): ping,

@@ -76,6 +76,18 @@ class PredictorServer:
             unit_call_hook=unit_call_hook,
             shadow_compare_hook=shadow_hook,
         )
+        # generative tier: a single-node decoder deployment with
+        # tpu.decode_slots > 0 gets the continuous-batching decode loop;
+        # the fused whole-batch apply stays as the correctness oracle (and
+        # the path every other deployment keeps)
+        from seldon_core_tpu.serving.decode_scheduler import scheduler_for_executor
+
+        self.decode_scheduler = scheduler_for_executor(
+            self.executor,
+            predictor.tpu,
+            metrics=self.metrics,
+            deployment_name=deployment_name,
+        )
         self.batcher = (
             make_batcher(
                 predictor.tpu,
@@ -83,6 +95,7 @@ class PredictorServer:
                 execute_many=self.executor.execute_many,
                 metrics=self.metrics,
                 deployment_name=deployment_name,
+                decode_scheduler=self.decode_scheduler,
             )
             if enable_batching
             else None
@@ -94,6 +107,7 @@ class PredictorServer:
             batcher=self.batcher,
             metrics=self.metrics,
             decode_npy=predictor.tpu.decode_npy_bindata,
+            decode_scheduler=self.decode_scheduler,
         )
         self.state = {"paused": False}
         self.app = build_app(self.service, self.state, metrics=self.metrics)
@@ -156,6 +170,8 @@ class PredictorServer:
             probe.cancel()
         if self.batcher is not None:
             await self.batcher.close()
+        if self.decode_scheduler is not None:
+            await self.decode_scheduler.close()
         # let in-flight SHADOW mirror walks finish BEFORE closing the remote
         # channels/session they may still be using — the shutdown window's
         # candidate-validation traffic must not be lost or error spuriously
@@ -182,6 +198,8 @@ class PredictorServer:
             runtime = getattr(node.unit, "runtime", None)
             if runtime is not None and getattr(runtime, "feature_shape", None) is not None:
                 runtime.warmup()
+        if self.decode_scheduler is not None:
+            self.decode_scheduler.warmup()
 
 
 def _prepare(pred: PredictorSpec, dep_name: str) -> tuple[PredictorSpec, str]:

@@ -49,6 +49,7 @@ class PredictionService:
         batcher: MicroBatcher | None = None,
         metrics: NullMetrics | None = None,
         decode_npy: bool = True,
+        decode_scheduler=None,
     ):
         self.executor = executor
         self.deployment_name = deployment_name
@@ -59,6 +60,10 @@ class PredictionService:
         # binData opaque — reference oneof passthrough for bytes-contract
         # graphs whose payloads could collide with the npy magic
         self.decode_npy = decode_npy
+        # generative tier: the continuous-batching decode loop
+        # (serving/decode_scheduler.py) — feeds per-token streaming and the
+        # batcher's generative handoff; None for every other deployment
+        self.decode_scheduler = decode_scheduler
 
     async def predict(self, msg: SeldonMessage, *, wire_npy: bool = False) -> SeldonMessage:
         start = time.perf_counter()
@@ -101,6 +106,108 @@ class PredictionService:
             self.deployment_name, "predict", time.perf_counter() - start
         )
         return out
+
+    async def predict_stream(self, msg: SeldonMessage, *, wire_npy: bool = False):
+        """Per-token streaming predict for generative deployments: an async
+        generator of JSON-able events —
+            {"row": r, "index": i, "token": t}   per generated token
+            {"done": true, "ids": [[...]], "gen_lens": [...], "puid": ...}
+        as the terminal event. Without a decode scheduler the terminal
+        event carries the buffered predict()'s ids (the endpoint stays
+        functional for whole-batch generative deployments; gen_lens is
+        present only when the response pipeline computed it)."""
+        import asyncio
+
+        import numpy as np
+
+        start = time.perf_counter()
+        # same binary-wire gate as predict(): an EXPLICIT application/x-npy
+        # declaration (wire_npy) is honored even when sniffing is off
+        npy_requested = wire_npy or (self.decode_npy and is_npy(msg.bin_data))
+        if npy_requested:
+            msg = SeldonMessage.from_array(array_from_npy(msg.bin_data), meta=msg.meta)
+        if not msg.meta.puid:
+            msg = msg.with_meta(
+                Meta(
+                    puid=new_puid(),
+                    tags=dict(msg.meta.tags),
+                    routing=dict(msg.meta.routing),
+                    request_path=dict(msg.meta.request_path),
+                )
+            )
+        puid = msg.meta.puid
+        sched = self.decode_scheduler
+        if sched is None:
+            out = await self.predict(msg)
+            arr = out.array
+            ev = {
+                "done": True,
+                "ids": np.atleast_2d(np.asarray(arr)).astype(int).tolist()
+                if arr is not None
+                else [],
+                "puid": puid,
+            }
+            if "gen_lens" in out.meta.tags:
+                ev["gen_lens"] = out.meta.tags["gen_lens"]
+            yield ev
+            return
+        if msg.array is None:
+            from seldon_core_tpu.core.errors import APIException, ErrorCode
+
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_JSON,
+                "streaming predict needs tensor token ids",
+            )
+        rows = np.atleast_2d(np.asarray(msg.array)).astype(np.int32)
+        overrides = sched.request_params_from_meta(msg.meta)
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def on_token(row: int):
+            def cb(tok: int, index: int) -> None:
+                queue.put_nowait({"row": row, "index": index, "token": tok})
+
+            return cb
+
+        async def run_all():
+            try:
+                # settle every row before failing (plain gather would leave
+                # sibling rows decoding detached with unretrieved errors)
+                outs = await asyncio.gather(
+                    *(
+                        sched.submit(row, **overrides, on_token=on_token(i))
+                        for i, row in enumerate(rows)
+                    ),
+                    return_exceptions=True,
+                )
+                for o in outs:
+                    if isinstance(o, BaseException):
+                        raise o
+                queue.put_nowait(("done", outs))
+            except Exception as e:  # noqa: BLE001 - surfaced as a stream event
+                queue.put_nowait(("error", e))
+
+        runner = asyncio.ensure_future(run_all())
+        try:
+            while True:
+                ev = await queue.get()
+                if isinstance(ev, dict):
+                    yield ev
+                    continue
+                kind, payload = ev
+                if kind == "error":
+                    raise payload
+                yield {
+                    "done": True,
+                    "ids": [o.tolist() for o in payload],
+                    "gen_lens": [len(o) - rows.shape[1] for o in payload],
+                    "puid": puid,
+                }
+                break
+        finally:
+            runner.cancel()
+            self.metrics.ingress_request(
+                self.deployment_name, "predict_stream", time.perf_counter() - start
+            )
 
     async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
         start = time.perf_counter()

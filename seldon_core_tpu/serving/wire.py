@@ -69,6 +69,25 @@ class WireResponse:
         )
 
 
+@dataclass
+class WireStreamResponse:
+    """A streaming response: ``events`` is an async iterator of ready-to-
+    write bytes chunks (SSE frames). Transports write the head, then each
+    chunk as it arrives (the fast ingress uses Transfer-Encoding: chunked).
+    Only produced once the request validated — handler errors BEFORE the
+    first event come back as a plain WireResponse instead."""
+
+    events: object  # AsyncIterator[bytes]
+    status: int = 200
+    content_type: str = "text/event-stream"
+    headers: dict = field(default_factory=dict)
+
+
+def sse_frame(obj) -> bytes:
+    """One server-sent-events data frame."""
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
+
+
 NPY_CONTENT_TYPES = ("application/x-npy", "application/octet-stream")
 
 
@@ -190,6 +209,63 @@ async def engine_predictions(service, req: WireRequest) -> WireResponse:
                 service.deployment_name, "predict", c
             ),
         )
+
+
+async def engine_predictions_stream(service, req: WireRequest):
+    """POST /api/v0.1/predictions/stream — per-token SSE streaming for the
+    generative tier (service.predict_stream). The buffered /predictions
+    surface is untouched: existing clients see no change, streaming is a
+    separate opt-in route on the fast ingress.
+
+    Events: ``data: {"row": r, "index": i, "token": t}`` per generated
+    token, then ``data: {"done": true, "ids": [[...]], ...}``. Request
+    parsing (JSON envelope or npy body) matches /predictions; per-request
+    sampling rides meta.tags (temperature / top_k / max_new_tokens).
+
+    The FIRST event is awaited before the response head is committed, so
+    validation errors still come back as ordinary status-JSON failures;
+    errors after streaming began are sent as a terminal error event."""
+    try:
+        ctype = req.content_type
+        kind = classify_binary_bytes(
+            ctype, req.declared_ctype, req.body, sniff_npy=service.decode_npy
+        )
+        if kind != "json":
+            msg = SeldonMessage(bin_data=req.body)
+        elif ctype == "application/json" or not req.declared_ctype:
+            msg = message_from_json_fast(req.body)
+        else:
+            msg = message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+        gen = service.predict_stream(msg, wire_npy=kind == "npy")
+        first = await gen.__anext__()
+    except StopAsyncIteration:
+        return WireResponse(status=500, body=b'{"status":"FAILURE"}')
+    except Exception as e:  # noqa: BLE001 - wire boundary
+        return failure_response(
+            e,
+            fallback_code=ErrorCode.ENGINE_MICROSERVICE_ERROR,
+            op="predict_stream",
+            metrics_error=lambda c: service.metrics.ingress_error(
+                service.deployment_name, "predict_stream", c
+            ),
+        )
+
+    async def events():
+        try:
+            yield sse_frame(first)
+            try:
+                async for ev in gen:
+                    yield sse_frame(ev)
+            except Exception as e:  # noqa: BLE001 - head already committed
+                log.exception("stream failed mid-flight")
+                err = e.to_status_json() if isinstance(e, APIException) else {"status": "FAILURE"}
+                yield sse_frame({"error": err})
+        finally:
+            # transport-initiated close (client disconnect) must reach the
+            # service generator so its finally cancels in-flight generation
+            await gen.aclose()
+
+    return WireStreamResponse(events=events())
 
 
 async def engine_feedback(service, req: WireRequest) -> WireResponse:

@@ -105,12 +105,38 @@ def worst_case_full_record() -> dict:
     )
     bert = _leg(1234.56, 105.5, 871.2)
     bert.update(tflops=35.21, mfu_pct=61.77)
+    gen = {
+        "scenario": {
+            "requests": 64,
+            "n_slots": 8,
+            "seq": 16,
+            "max_new_cap": 64,
+            "budgets": "choice(8,16,32,64; p=.4/.3/.2/.1)",
+            "stagger_ms": 2.0,
+        },
+        "scheduler": {
+            "tokens_per_sec": 1690.42,
+            "ttft_p50_ms": 630.44,
+            "ttft_p99_ms": 1265.01,
+            "inter_token_p99_ms": 26.81,
+            "slot_occupancy_mean": 0.893,
+            "recompiles_after_warmup": 0,
+            "steps": 1234,
+        },
+        "scan": {
+            "tokens_per_sec": 261.63,
+            "ttft_p50_ms": 3279.11,
+            "ttft_p99_ms": 4411.92,
+        },
+        "tokens_per_sec_speedup": 2.64,
+    }
     return {
         "metric": "resnet50_predictions_per_sec",
         "value": 12833.61,
         "unit": "preds/s",
         "vs_baseline": 10.2669,
         "serving": {
+            "gen": gen,
             "iris_chip": _leg(2950.44, 85.2, 870.13),
             "resnet50_chip": _leg(65.83, 453.11, 1870.42),
             "bert_base_chip": bert,
@@ -184,6 +210,19 @@ def test_compact_record_carries_every_headline():
     assert c["mt"]["homo_p99s"] == [88.16, 88.16, 88.16]
     assert c["pallas"]["speedup"] == 2.08
     assert c["pallas"]["causal_speedup"] == 2.51
+    # generative tier: scheduler-vs-scan tokens/s + latency contracts
+    assert c["gen"] == {
+        "tok_s": 1690.42,
+        "tok_s_scan": 261.63,
+        "speedup": 2.64,
+        "ttft_p50": 630.44,
+        "ttft_p99": 1265.01,
+        "itl_p99": 26.81,
+        "scan_lat_p50": 3279.11,
+        "occ": 0.893,
+        "recompiles": 0,
+        "slots": 8,
+    }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77
     assert c["floors"] == {
