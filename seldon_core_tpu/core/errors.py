@@ -30,6 +30,8 @@ class ErrorCode(enum.Enum):
     TPU_COMPILE_ERROR = (301, 500, "XLA compilation failed")
     TPU_SHAPE_BUCKET_OVERFLOW = (302, 400, "Request exceeds largest compiled batch bucket")
     REQUEST_TIMEOUT = (303, 504, "Request timed out in batching queue")
+    REQUEST_DEADLINE_EXCEEDED = (304, 504, "Request deadline budget exhausted")
+    ENGINE_BREAKER_OPEN = (305, 503, "Circuit breaker open for endpoint")
 
     @property
     def code(self) -> int:
@@ -45,10 +47,34 @@ class ErrorCode(enum.Enum):
 
 
 class APIException(Exception):
-    def __init__(self, error: ErrorCode, info: str = ""):
+    def __init__(
+        self,
+        error: ErrorCode,
+        info: str = "",
+        *,
+        retry_after_s: float | None = None,
+        retryable: bool | None = None,
+    ):
         self.error = error
         self.info = info
+        # when set (open circuit breaker), the wire layers emit it as an
+        # HTTP Retry-After header so clients can back off instead of hammer
+        self.retry_after_s = retry_after_s
+        # explicit retryability override for the resilience layer: a remote
+        # 4xx is normalised to ENGINE_MICROSERVICE_ERROR for wire compat but
+        # is DETERMINISTIC — replaying it or counting it against the
+        # endpoint's breaker would punish a healthy backend. None = classify
+        # by error code (engine/resilience.is_retryable).
+        self.retryable = retryable
         super().__init__(f"{error.name}({error.code}): {error.message} {info}".rstrip())
+
+    def retry_after_header(self) -> str | None:
+        """Value for the HTTP Retry-After header, or None. One place for
+        the rounding policy (ceil, floor 1 s) so the aiohttp and fast-
+        ingress wire layers cannot drift."""
+        if self.retry_after_s is None:
+            return None
+        return str(max(1, int(self.retry_after_s + 0.999)))
 
     def to_status_json(self) -> dict:
         """The JSON error body shape the reference engine returns."""

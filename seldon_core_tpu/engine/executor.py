@@ -32,13 +32,31 @@ log = logging.getLogger(__name__)
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
+from seldon_core_tpu.engine.resilience import (
+    HALF_OPEN,
+    NULL_EVENTS,
+    CircuitBreaker,
+    DEADLINE,
+    ResilienceEvents,
+    RetryState,
+    breaker_open_error,
+    current_deadline,
+    deadline_exceeded,
+    is_breaker_open_error,
+    is_retryable,
+)
 from seldon_core_tpu.engine.units import ROUTE_ALL, Unit, UnitRegistry, default_registry
 from seldon_core_tpu.graph.spec import (
     PredictiveUnit,
     PredictiveUnitMethod,
     PredictiveUnitType,
     PredictorSpec,
+    ResilienceSpec,
 )
+
+# degradation marker written into meta.tags when a request was served by a
+# fallback branch / partial quorum instead of its nominal path
+DEGRADED_TAG = "degraded"
 
 
 @dataclasses.dataclass
@@ -48,6 +66,10 @@ class Node:
     spec: PredictiveUnit
     unit: Unit
     children: list["Node"]
+    # per-node resilience knobs parsed off the CR parameters (retry/breaker/
+    # fallback_child/quorum) — runtime state (breaker state machines, retry
+    # RNGs) lives on the executor, keyed by node name
+    policy: ResilienceSpec = dataclasses.field(default_factory=ResilienceSpec)
 
     @property
     def name(self) -> str:
@@ -96,6 +118,7 @@ class GraphExecutor:
         feedback_metrics_hook: Callable[[str, float], None] | None = None,
         unit_call_hook: Callable[[str, str, float], None] | None = None,
         shadow_compare_hook: Callable[[str, bool], None] | None = None,
+        resilience_events: ResilienceEvents | None = None,
     ):
         self.root = root
         self._feedback_hook = feedback_metrics_hook
@@ -111,6 +134,54 @@ class GraphExecutor:
         # seldon_tpu_shadow_comparisons so a candidate's agreement rate with
         # production is a dashboard number, not a log-diving exercise
         self._shadow_hook = shadow_compare_hook
+        # resilience runtime: event sink + per-node retry RNGs + breakers.
+        # Breakers are keyed per ENDPOINT (host:port for remote nodes, node
+        # name for in-process ones) and shared by nodes on the same
+        # endpoint, so a backend's health is tracked once per backend.
+        self._events = resilience_events or NULL_EVENTS
+        self._retries: dict[str, RetryState] = {}
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breaker_keys: dict[str, str] = {}
+        shared: dict[str, CircuitBreaker] = {}
+        shared_spec: dict[str, Any] = {}
+        for n in root.walk():
+            if n.policy.retry is not None:
+                self._retries[n.name] = RetryState(n.policy.retry)
+            if n.policy.breaker is None:
+                continue
+            ep = n.spec.endpoint
+            key = (
+                f"{ep.service_host}:{ep.service_port}"
+                if ep is not None and ep.service_port
+                else n.name
+            )
+            cb = shared.get(key)
+            if cb is None:
+                cb = CircuitBreaker(
+                    n.policy.breaker,
+                    on_transition=lambda state, k=key: self._events.breaker_transition(
+                        k, state
+                    ),
+                )
+                shared[key] = cb
+                shared_spec[key] = n.policy.breaker
+            elif n.policy.breaker != shared_spec.get(key):
+                # first-walked node's spec governs the shared breaker; a
+                # conflicting spec on a later node would otherwise be
+                # silently dropped
+                log.warning(
+                    "node '%s': breaker config conflicts with the one already "
+                    "governing endpoint '%s' (first-declared wins)",
+                    n.name,
+                    key,
+                )
+            self._breakers[n.name] = cb
+            self._breaker_keys[n.name] = key
+
+    def breaker_for(self, node_name: str) -> CircuitBreaker | None:
+        """The breaker guarding a node's endpoint, if one is configured
+        (tests and the router fallback check read state through this)."""
+        return self._breakers.get(node_name)
 
     def units(self):
         """All runtime units in the graph, pre-order (used by persistence,
@@ -219,7 +290,7 @@ class GraphExecutor:
 
     async def _merged_call(self, node, method_name, method, msgs, spans):
         merged = self._merge_rows(msgs)
-        out = await self._timed(node, method_name, method(merged), spans)
+        out = await self._call(node, method_name, method, merged, spans=spans)
         out = await self._settle_to_host(out)
         return self._scatter_rows(msgs, out)
 
@@ -244,7 +315,7 @@ class GraphExecutor:
         if _has_method(node, PredictiveUnitMethod.ROUTE):
             branches = []
             for m in msgs:
-                b = await self._timed(node, "route", unit.route(m), spans)
+                b = await self._call(node, "route", unit.route, m, spans=spans)
                 if shadow and b == ROUTE_ALL:
                     b = 0  # shadow default primary (matches the single path)
                 if b != ROUTE_ALL and not (0 <= b < len(node.children)):
@@ -280,7 +351,18 @@ class GraphExecutor:
                 if b == ROUTE_ALL:
                     outs = await self._fanout_many(node, sub, spans)
                 else:
-                    outs = await self._get_output_many(node.children[b], sub, spans)
+                    fb = self._fallback_branch(node, b)
+                    if fb is not None and self._branch_breaker_open(node, b):
+                        outs = await self._degraded_group(node, fb, sub, spans)
+                    else:
+                        try:
+                            outs = await self._get_output_many(
+                                node.children[b], sub, spans
+                            )
+                        except Exception as e:  # noqa: BLE001 - gated below
+                            if fb is None or not self._fallback_eligible(e):
+                                raise
+                            outs = await self._degraded_group(node, fb, sub, spans)
                 return idxs, outs
 
             results: list[SeldonMessage | None] = [None] * len(msgs)
@@ -304,6 +386,27 @@ class GraphExecutor:
             )
         return out_msgs
 
+    async def _settle_quorum(self, node: Node, aws: list):
+        """Settle every child walk; with a configured COMBINER quorum, a
+        partial fan-out failure degrades to aggregating the survivors
+        instead of failing the request. Returns (surviving outputs,
+        degraded?). Without a quorum (or below it) this is exactly
+        _gather_settled: all siblings settle, then the failure re-raises."""
+        results = await asyncio.gather(*aws, return_exceptions=True)
+        failures = [r for r in results if isinstance(r, BaseException)]
+        ok = [r for r in results if not isinstance(r, BaseException)]
+        if not failures:
+            return ok, False
+        quorum = node.policy.quorum
+        if (
+            quorum is not None
+            and _has_method(node, PredictiveUnitMethod.AGGREGATE)
+            and len(ok) >= max(quorum, 1)
+        ):
+            self._events.degraded(node.name, "quorum")
+            return ok, True
+        raise failures[0]
+
     async def _fanout_many(
         self, node: Node, msgs: list[SeldonMessage], spans: list | None
     ) -> list[SeldonMessage]:
@@ -311,21 +414,22 @@ class GraphExecutor:
         then AGGREGATE runs once on the row-aligned merged child outputs."""
         unit = node.unit
         targets = node.children
+        degraded = False
         if len(targets) == 1:
             child_outs = [await self._get_output_many(targets[0], msgs, spans)]
         else:
-            child_outs = list(
-                await _gather_settled(
-                    *(self._get_output_many(c, msgs, spans) for c in targets)
-                )
+            child_outs, degraded = await self._settle_quorum(
+                node, [self._get_output_many(c, msgs, spans) for c in targets]
             )
 
         if _has_method(node, PredictiveUnitMethod.AGGREGATE):
             merged_children = [self._merge_rows(co) for co in child_outs]
-            out = await self._timed(
-                node, "aggregate", unit.aggregate(merged_children), spans
-            )
+            out = await self._call(node, "aggregate", unit.aggregate, merged_children, spans=spans)
             out = await self._settle_to_host(out)
+            if degraded:
+                out = out.with_meta(
+                    out.meta.merged_with(Meta(tags={DEGRADED_TAG: "quorum"}))
+                )
             base = []
             for i, m in enumerate(msgs):
                 meta = m.meta
@@ -340,18 +444,165 @@ class GraphExecutor:
             f"unit '{node.name}' fanned out to {len(child_outs)} children without AGGREGATE",
         )
 
-    async def _timed(self, node: Node, method: str, coro, spans):
-        t0 = time.perf_counter()
+    @staticmethod
+    def _counts_for_breaker(e: BaseException) -> bool:
+        """Which failures indict the ENDPOINT's health: everything except
+        our own budget exhaustion, breaker fast-fails, and cancellation —
+        those say nothing about whether the backend is up."""
+        if isinstance(e, asyncio.CancelledError):
+            return False
+        if isinstance(e, APIException):
+            if e.error in (
+                ErrorCode.REQUEST_DEADLINE_EXCEEDED,
+                ErrorCode.ENGINE_BREAKER_OPEN,
+            ):
+                return False
+            if e.retryable is False:
+                # explicitly deterministic (e.g. remote 4xx on a bad
+                # payload): the backend answered correctly — counting it
+                # would open the breaker against a healthy endpoint
+                return False
+        return True
+
+    async def _call(self, node: Node, method: str, fn, *args, spans):
+        """One unit-method invocation through the resilience pipeline:
+
+            deadline check -> breaker gate -> timed attempt -> retry loop
+
+        Every attempt is timed individually (the per-unit observability
+        contract counts real dispatches, not logical calls). Retries apply
+        only to idempotent methods on transport/5xx-class failures and
+        never sleep past the request's remaining budget; breaker outcomes
+        are recorded per attempt so a flapping endpoint opens its breaker
+        even while retries are absorbing the failures."""
+        d = current_deadline()
+        if d is not None and d.expired():
+            self._events.deadline_exceeded(node.name)
+            raise deadline_exceeded(f"unit '{node.name}'.{method}")
+        breaker = self._breakers.get(node.name)
+        took_probe = False
+        if breaker is not None and method != "send_feedback":
+            if not breaker.allow():
+                raise breaker_open_error(self._breaker_keys[node.name], breaker)
+            # allow() consumed a probe slot iff the breaker sits half-open
+            took_probe = breaker.state == HALF_OPEN
+        retry = self._retries.get(node.name)
+        attempt = 0
+        while True:
+            attempt += 1
+            t0 = time.perf_counter()
+            try:
+                result = await fn(*args)
+            except BaseException as e:
+                self._record_call(node, method, time.perf_counter() - t0, spans)
+                if breaker is not None:
+                    if self._counts_for_breaker(e):
+                        breaker.record_failure()
+                    elif took_probe:
+                        # no verdict (cancel / deadline): free the probe
+                        # slot so the breaker cannot wedge in half-open
+                        breaker.release_probe()
+                # the backoff actually slept is the SAME jittered value
+                # validated against the remaining budget (one RNG draw)
+                backoff_s = retry.backoff(attempt) if retry is not None else 0.0
+                if retry is not None and retry.should_retry(method, attempt, e, backoff_s):
+                    self._events.retry(node.name, attempt)
+                    await asyncio.sleep(backoff_s)
+                    if breaker is not None:
+                        if not breaker.allow():
+                            # the endpoint tripped open while we backed off
+                            raise breaker_open_error(
+                                self._breaker_keys[node.name], breaker
+                            ) from e
+                        took_probe = breaker.state == HALF_OPEN
+                    continue
+                raise
+            else:
+                self._record_call(node, method, time.perf_counter() - t0, spans)
+                if breaker is not None:
+                    breaker.record_success()
+                return result
+
+    def _record_call(self, node: Node, method: str, dt: float, spans) -> None:
+        if self._unit_hook is not None:
+            self._unit_hook(node.name, method, dt)
+        if spans is not None:
+            spans.append(
+                {"unit": node.name, "method": method, "ms": round(dt * 1e3, 3)}
+            )
+
+    # ------------------------------------------------- graceful degradation
+    def _fallback_branch(self, node: Node, chosen: int) -> int | None:
+        """The router's configured degradation branch, when it is a real,
+        DIFFERENT child than the one routing chose."""
+        fb = node.policy.fallback_child
+        if fb is None or fb == chosen or not (0 <= fb < len(node.children)):
+            return None
+        return fb
+
+    def _branch_breaker_open(self, node: Node, branch: int) -> bool:
+        """Non-consuming peek at the chosen child's breaker: firmly-open ->
+        degrade immediately (don't even dispatch); a reset-elapsed breaker
+        reads half-open so probe traffic still reaches the child and can
+        recover it."""
+        breaker = self._breakers.get(node.children[branch].name)
+        return breaker is not None and breaker.is_open()
+
+    @staticmethod
+    def _fallback_eligible(e: BaseException) -> bool:
+        """Failures a router may degrade around: the chosen child's breaker
+        fast-failing, or a transport/5xx-class failure from its subtree.
+        Deadline exhaustion is NOT eligible — the budget is gone either
+        way, and walking the fallback would just overrun it further."""
+        return is_breaker_open_error(e) or is_retryable(e)
+
+    @staticmethod
+    def _degrade_meta(msg: SeldonMessage, node_name: str, branch: int, mode: str):
+        """Restamp routing with the branch actually served plus the
+        degradation marker (feedback must replay down the REAL path)."""
+        return msg.with_meta(
+            msg.meta.merged_with(
+                Meta(routing={node_name: branch}, tags={DEGRADED_TAG: mode})
+            )
+        )
+
+    async def _degraded_group(
+        self, node: Node, fb: int, sub: list[SeldonMessage], spans
+    ) -> list[SeldonMessage]:
+        """Batched router fallback: walk the whole group down the fallback
+        branch, restamping routing + the degraded tag per request."""
+        self._events.degraded(node.name, "router_fallback")
+        sub = [self._degrade_meta(m, node.name, fb, "router_fallback") for m in sub]
+        return await self._get_output_many(node.children[fb], sub, spans)
+
+    async def _routed_walk(
+        self, node: Node, branch: int, msg: SeldonMessage, spans
+    ) -> SeldonMessage:
+        """Walk the routed child with graceful degradation: when the chosen
+        child's breaker is firmly open, serve the configured fallback branch
+        without dispatching; when the chosen subtree fails transport-class
+        (or fast-fails on a deeper breaker), fail over to the fallback. The
+        served branch is restamped into meta.routing so feedback replays
+        down the path the request ACTUALLY took."""
+        fb = self._fallback_branch(node, branch)
+        if fb is not None and self._branch_breaker_open(node, branch):
+            self._events.degraded(node.name, "router_fallback")
+            return await self._get_output(
+                node.children[fb],
+                self._degrade_meta(msg, node.name, fb, "router_fallback"),
+                spans,
+            )
         try:
-            return await coro
-        finally:
-            dt = time.perf_counter() - t0
-            if self._unit_hook is not None:
-                self._unit_hook(node.name, method, dt)
-            if spans is not None:
-                spans.append(
-                    {"unit": node.name, "method": method, "ms": round(dt * 1e3, 3)}
-                )
+            return await self._get_output(node.children[branch], msg, spans)
+        except Exception as e:  # noqa: BLE001 - gated by _fallback_eligible
+            if fb is None or not self._fallback_eligible(e):
+                raise
+            self._events.degraded(node.name, "router_fallback")
+            return await self._get_output(
+                node.children[fb],
+                self._degrade_meta(msg, node.name, fb, "router_fallback"),
+                spans,
+            )
 
     @staticmethod
     def _shadow_copy(msg: SeldonMessage) -> SeldonMessage:
@@ -381,6 +632,10 @@ class GraphExecutor:
             payload = self._shadow_copy(payload)
 
         async def _run():
+            # shadows outlive the primary's response by design — the
+            # request's deadline budget must not fail a slow candidate's
+            # mirror walk (that would read as disagreement, not latency)
+            DEADLINE.set(None)
             try:
                 if isinstance(payload, list):
                     return await self._get_output_many(child, payload, None)
@@ -472,17 +727,17 @@ class GraphExecutor:
         )
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_INPUT):
-            out = await self._timed(
-                node, "transform_input", unit.transform_input(msg), spans
-            )
+            out = await self._call(node, "transform_input", unit.transform_input, msg, spans=spans)
             msg = out.with_meta(msg.meta.merged_with(out.meta))
 
         if not node.children:
             return msg
 
         branch = ROUTE_ALL
+        routed = False
         if _has_method(node, PredictiveUnitMethod.ROUTE):
-            branch = await self._timed(node, "route", unit.route(msg), spans)
+            branch = await self._call(node, "route", unit.route, msg, spans=spans)
+            routed = True
             # sanityCheckRouting (reference :244-250)
             if branch != ROUTE_ALL and not (0 <= branch < len(node.children)):
                 raise APIException(
@@ -515,13 +770,15 @@ class GraphExecutor:
         else:
             targets = [node.children[branch]]
 
+        degraded_quorum = False
         if len(targets) == 1:
-            child_outputs = [await self._get_output(targets[0], msg, spans)]
+            if routed and branch != ROUTE_ALL and not getattr(unit, "shadow_fanout", False):
+                child_outputs = [await self._routed_walk(node, branch, msg, spans)]
+            else:
+                child_outputs = [await self._get_output(targets[0], msg, spans)]
         else:
-            child_outputs = list(
-                await _gather_settled(
-                    *(self._get_output(c, msg, spans) for c in targets)
-                )
+            child_outputs, degraded_quorum = await self._settle_quorum(
+                node, [self._get_output(c, msg, spans) for c in targets]
             )
 
         if getattr(unit, "shadow_fanout", False):
@@ -535,9 +792,7 @@ class GraphExecutor:
             merged_meta = merged_meta.merged_with(co.meta)
 
         if _has_method(node, PredictiveUnitMethod.AGGREGATE):
-            out = await self._timed(
-                node, "aggregate", unit.aggregate(child_outputs), spans
-            )
+            out = await self._call(node, "aggregate", unit.aggregate, child_outputs, spans=spans)
         elif len(child_outputs) == 1:
             out = child_outputs[0]
         else:
@@ -546,11 +801,13 @@ class GraphExecutor:
                 f"unit '{node.name}' fanned out to {len(child_outputs)} children without AGGREGATE",
             )
         msg = out.with_meta(merged_meta.merged_with(out.meta))
+        if degraded_quorum:
+            msg = msg.with_meta(
+                msg.meta.merged_with(Meta(tags={DEGRADED_TAG: "quorum"}))
+            )
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
-            out = await self._timed(
-                node, "transform_output", unit.transform_output(msg), spans
-            )
+            out = await self._call(node, "transform_output", unit.transform_output, msg, spans=spans)
             msg = out.with_meta(msg.meta.merged_with(out.meta))
         return msg
 
@@ -637,7 +894,12 @@ def build_node(
         unit.image = container.image
 
     children = [build_node(c, registry, context) for c in spec.children]
-    return Node(spec=spec, unit=unit, children=children)
+    return Node(
+        spec=spec,
+        unit=unit,
+        children=children,
+        policy=ResilienceSpec.for_unit(spec),
+    )
 
 
 def build_executor(
@@ -647,6 +909,7 @@ def build_executor(
     feedback_metrics_hook: Callable[[str, float], None] | None = None,
     unit_call_hook: Callable[[str, str, float], None] | None = None,
     shadow_compare_hook: Callable[[str, bool], None] | None = None,
+    resilience_events: ResilienceEvents | None = None,
 ) -> GraphExecutor:
     registry = registry or default_registry()
     context = dict(context or {})
@@ -663,4 +926,5 @@ def build_executor(
         feedback_metrics_hook=feedback_metrics_hook,
         unit_call_hook=unit_call_hook,
         shadow_compare_hook=shadow_compare_hook,
+        resilience_events=resilience_events,
     )

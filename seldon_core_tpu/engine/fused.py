@@ -222,9 +222,13 @@ def fuse_graph(root: Node, tpu_cfg=None, mesh=None) -> Node:
         # island — that is the documented trade-off of fuse_graph=true)
         members = ",".join(n.name for n in root.walk() if n is not root)
         unit.image = f"fused[{members}]" if len(members) <= 120 else f"fused:{sub.n_models}-models"
-        return Node(spec=spec, unit=unit, children=[])
+        # the island REPLACES the subtree rooted here: the root's resilience
+        # knobs (retry/breaker ride the island's single dispatch) survive
+        return Node(spec=spec, unit=unit, children=[], policy=root.policy)
 
     new_children = [fuse_graph(c, tpu_cfg, mesh) for c in root.children]
     if new_children != root.children:
-        return Node(spec=root.spec, unit=root.unit, children=new_children)
+        return Node(
+            spec=root.spec, unit=root.unit, children=new_children, policy=root.policy
+        )
     return root

@@ -14,6 +14,7 @@ unmodified reference model container (wrappers/python) plugs in directly.
 
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Any, Sequence
 
@@ -26,33 +27,80 @@ from seldon_core_tpu.core.codec_json import (
 )
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.engine.resilience import call_timeout, current_deadline
 from seldon_core_tpu.engine.units import ROUTE_ALL, Unit
 from seldon_core_tpu.graph.spec import EndpointType, PredictiveUnit
+from seldon_core_tpu.utils.env import rest_timeouts
 
-GRPC_DEADLINE_S = 5.0  # reference InternalPredictionService.java:77
+GRPC_DEADLINE_S = 5.0  # reference InternalPredictionService.java:77 (default
+# only: a request carrying a deadline budget uses its REMAINING budget as
+# the per-call timeout instead — engine/resilience.call_timeout)
 
 
 class _RestSession:
-    """Shared pooled aiohttp session (lazy, one per process)."""
+    """Shared pooled aiohttp session (lazy, one per event loop).
+
+    Guarded by a per-loop lock: a ``close()`` overlapping a ``get()`` used
+    to race (get() could return the session close() was about to tear down,
+    or resurrect a half-closed one); now create/close are serialized and
+    the session is re-created if it was built on a previous (dead) loop.
+    Connect and total timeouts are split and env-tunable (utils/env
+    .rest_timeouts); per-call deadline budgets override total per request.
+    """
 
     _session = None
+    _session_loop = None
+    _lock: asyncio.Lock | None = None
+    _lock_loop = None
+
+    @classmethod
+    def _get_lock(cls) -> asyncio.Lock:
+        loop = asyncio.get_running_loop()
+        if cls._lock is None or cls._lock_loop is not loop:
+            cls._lock = asyncio.Lock()
+            cls._lock_loop = loop
+        return cls._lock
 
     @classmethod
     async def get(cls):
         import aiohttp
 
-        if cls._session is None or cls._session.closed:
-            cls._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=GRPC_DEADLINE_S),
-                connector=aiohttp.TCPConnector(limit=150),  # reference pool size
-            )
-        return cls._session
+        loop = asyncio.get_running_loop()
+        async with cls._get_lock():
+            if (
+                cls._session is None
+                or cls._session.closed
+                or cls._session_loop is not loop
+            ):
+                stale = cls._session
+                if stale is not None and not stale.closed:
+                    # a session left over from a previous (dead) event loop:
+                    # close its connector best-effort instead of leaking the
+                    # sockets until GC ("Unclosed client session")
+                    try:
+                        await stale.close()
+                    except Exception:  # noqa: BLE001 - cross-loop teardown
+                        pass
+                connect_s, total_s = rest_timeouts()
+                cls._session = aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=total_s, connect=connect_s),
+                    connector=aiohttp.TCPConnector(limit=150),  # reference pool size
+                )
+                cls._session_loop = loop
+            return cls._session
 
     @classmethod
     async def close(cls):
-        if cls._session is not None and not cls._session.closed:
-            await cls._session.close()
-        cls._session = None
+        async with cls._get_lock():
+            session, cls._session = cls._session, None
+            cls._session_loop = None
+            if session is not None and not session.closed:
+                try:
+                    await session.close()
+                except Exception:  # noqa: BLE001 - cross-loop teardown (a
+                    # session built on a previous, now-dead loop) must not
+                    # abort the caller's shutdown path
+                    pass
 
 
 class RemoteUnit(Unit):
@@ -74,17 +122,33 @@ class RemoteUnit(Unit):
         # reference wire quirk kept for compatibility: body is form-encoded
         # with the message under a `json=` field (microservice.py:44-52)
         data = {"json": json.dumps(payload)}
+        # a stamped request deadline REPLACES the session's default total
+        # timeout with the remaining budget (connect stays bounded by the
+        # session default); unbudgeted requests ride the session default
+        # without paying a per-call ClientTimeout construction
+        kwargs = {}
+        if current_deadline() is not None:
+            import aiohttp
+
+            connect_s, total_s = rest_timeouts()
+            kwargs["timeout"] = aiohttp.ClientTimeout(
+                total=call_timeout(total_s), connect=connect_s
+            )
         try:
-            async with session.post(url, data=data) as resp:
+            async with session.post(url, data=data, **kwargs) as resp:
                 body = await resp.text()
                 if resp.status != 200:
+                    # 4xx is a DETERMINISTIC answer from a healthy backend:
+                    # never retried, never counted against its breaker
                     raise APIException(
                         ErrorCode.ENGINE_MICROSERVICE_ERROR,
                         f"{url} -> {resp.status}: {body[:300]}",
+                        retryable=resp.status >= 500,
                     )
         except APIException:
             raise
         except Exception as e:  # noqa: BLE001 - network errors normalised
+            self._raise_if_deadline(e, url)
             raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, f"{url}: {e}") from e
         try:
             return message_from_dict(json.loads(body))
@@ -112,6 +176,72 @@ class RemoteUnit(Unit):
             return "Combiner"
         return "Generic"
 
+    @staticmethod
+    def _raise_if_deadline(e: Exception, where: str) -> None:
+        """A transport timeout on a request whose budget has run out IS the
+        deadline firing — surface it as 504 budget exhaustion, not as a
+        retryable 5xx transport error."""
+        from seldon_core_tpu.engine.resilience import current_deadline, deadline_exceeded
+
+        d = current_deadline()
+        if d is not None and d.expired():
+            raise deadline_exceeded(where) from e
+
+    @staticmethod
+    def _is_transport_failure(e: Exception) -> bool:
+        """gRPC failures that indict the CHANNEL (connect refused / backend
+        gone / TLS reset) rather than the request: the cached channel must
+        be rebuilt so a restarted backend recovers without a process
+        bounce. Application-level statuses keep the channel."""
+        code = getattr(e, "code", None)
+        if not callable(code):
+            return isinstance(e, (ConnectionError, OSError))
+        try:
+            import grpc
+
+            return code() is grpc.StatusCode.UNAVAILABLE
+        except Exception:  # noqa: BLE001 - classification must never raise
+            return False
+
+    @staticmethod
+    def _grpc_retryable(e: Exception) -> bool | None:
+        """Explicit retryability for gRPC statuses: deterministic
+        request-level codes (INVALID_ARGUMENT and friends) must not be
+        replayed or counted against the endpoint's breaker. None = let the
+        resilience layer classify by error code (default retryable, since
+        the failure normalises to ENGINE_MICROSERVICE_ERROR)."""
+        code = getattr(e, "code", None)
+        if not callable(code):
+            return None
+        try:
+            import grpc
+
+            deterministic = (
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.StatusCode.NOT_FOUND,
+                grpc.StatusCode.ALREADY_EXISTS,
+                grpc.StatusCode.PERMISSION_DENIED,
+                grpc.StatusCode.UNAUTHENTICATED,
+                grpc.StatusCode.FAILED_PRECONDITION,
+                grpc.StatusCode.OUT_OF_RANGE,
+                grpc.StatusCode.UNIMPLEMENTED,
+            )
+            return False if code() in deterministic else None
+        except Exception:  # noqa: BLE001 - classification must never raise
+            return None
+
+    async def _invalidate_channel(self, channel) -> None:
+        """Drop (and close) the cached channel IF it is still the one that
+        failed — a concurrent call may already have rebuilt it."""
+        if self._grpc_channel is not channel:
+            return
+        self._grpc_channel = None
+        self._stub_cache.clear()
+        try:
+            await channel.close()
+        except Exception:  # noqa: BLE001 - teardown of a dead channel
+            pass
+
     async def _grpc_call(self, method: str, request_pb) -> SeldonMessage:
         import grpc
 
@@ -121,6 +251,7 @@ class RemoteUnit(Unit):
         if self._grpc_channel is None:
             target = f"{self.endpoint.service_host}:{self.endpoint.service_port}"
             self._grpc_channel = grpc.aio.insecure_channel(target)
+        channel = self._grpc_channel
         service = self._grpc_service_for(method)
         # stub per service, cached — the reference's perf hazard is a new
         # ManagedChannel per call (InternalPredictionService.java:211-214);
@@ -129,14 +260,26 @@ class RemoteUnit(Unit):
         # identical, so address them under that package
         stub = self._stub_cache.get(service)
         if stub is None:
-            stub = ServiceStub(self._grpc_channel, service, package="seldon.protos")
+            stub = ServiceStub(channel, service, package="seldon.protos")
             self._stub_cache[service] = stub
         rpc_method = "Predict" if service == "Model" else method
         try:
-            reply = await getattr(stub, rpc_method)(request_pb, timeout=GRPC_DEADLINE_S)
+            reply = await getattr(stub, rpc_method)(
+                request_pb, timeout=call_timeout(GRPC_DEADLINE_S)
+            )
+        except APIException:
+            raise
         except Exception as e:  # noqa: BLE001
+            if self._is_transport_failure(e):
+                # a channel that failed at the transport layer was cached
+                # forever before this: every later call kept failing even
+                # after the backend came back
+                await self._invalidate_channel(channel)
+            self._raise_if_deadline(e, f"gRPC {service}.{rpc_method}")
             raise APIException(
-                ErrorCode.ENGINE_MICROSERVICE_ERROR, f"gRPC {service}.{rpc_method}: {e}"
+                ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                f"gRPC {service}.{rpc_method}: {e}",
+                retryable=self._grpc_retryable(e),
             ) from e
         return message_from_proto(reply)
 

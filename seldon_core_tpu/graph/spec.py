@@ -130,6 +130,89 @@ class PredictiveUnit(_Spec):
             yield from c.walk()
 
 
+class RetrySpec(_Spec):
+    """Per-node retry policy (engine/resilience.RetryState runs it).
+
+    Retries apply only to idempotent methods (never send_feedback) and only
+    to transport/5xx-class failures, and never sleep past the request's
+    deadline budget."""
+
+    max_attempts: int = 1  # total attempts; 1 = no retry
+    backoff_ms: float = 25.0  # first backoff; doubles by backoff_mult
+    backoff_mult: float = 2.0
+    jitter: float = 0.5  # +/- fraction applied to each backoff
+    seed: Optional[int] = None  # deterministic jitter for tests/chaos runs
+
+
+class BreakerSpec(_Spec):
+    """Per-endpoint circuit breaker (engine/resilience.CircuitBreaker).
+
+    Opens on ``failure_threshold`` consecutive failures OR a windowed error
+    rate >= ``error_rate``; after ``reset_ms`` admits ``half_open_probes``
+    probe calls (success closes, failure re-opens)."""
+
+    failure_threshold: int = 5
+    error_rate: float = 0.5
+    window: int = 20
+    reset_ms: float = 1000.0
+    half_open_probes: int = 1
+
+
+class ResilienceSpec(_Spec):
+    """Resilience knobs for ONE graph node, parsed from its CR ``parameters``
+    (TpuSpec-style: plain config riding the deployment CR; runtime state
+    lives in engine/resilience.py). Parameter names:
+
+    - ``retry_max_attempts`` (INT > 1 enables retry), ``retry_backoff_ms``,
+      ``retry_backoff_mult``, ``retry_jitter``, ``retry_seed``
+    - ``breaker_failure_threshold`` (INT > 0 enables the breaker),
+      ``breaker_error_rate``, ``breaker_window``, ``breaker_reset_ms``,
+      ``breaker_half_open_probes``
+    - ``fallback_child`` (ROUTER: branch index served when the chosen
+      child's breaker is open or its subtree fails transport-class)
+    - ``quorum`` (COMBINER: aggregate this many surviving children instead
+      of failing the request when a child errors)
+    """
+
+    retry: Optional[RetrySpec] = None
+    breaker: Optional[BreakerSpec] = None
+    fallback_child: Optional[int] = None
+    quorum: Optional[int] = None
+
+    @staticmethod
+    def from_parameters(params: dict[str, Any]) -> "ResilienceSpec":
+        retry = None
+        if int(params.get("retry_max_attempts", 1)) > 1:
+            retry = RetrySpec(
+                max_attempts=int(params["retry_max_attempts"]),
+                backoff_ms=float(params.get("retry_backoff_ms", 25.0)),
+                backoff_mult=float(params.get("retry_backoff_mult", 2.0)),
+                jitter=float(params.get("retry_jitter", 0.5)),
+                seed=int(params["retry_seed"]) if "retry_seed" in params else None,
+            )
+        breaker = None
+        if int(params.get("breaker_failure_threshold", 0)) > 0:
+            breaker = BreakerSpec(
+                failure_threshold=int(params["breaker_failure_threshold"]),
+                error_rate=float(params.get("breaker_error_rate", 0.5)),
+                window=int(params.get("breaker_window", 20)),
+                reset_ms=float(params.get("breaker_reset_ms", 1000.0)),
+                half_open_probes=int(params.get("breaker_half_open_probes", 1)),
+            )
+        return ResilienceSpec(
+            retry=retry,
+            breaker=breaker,
+            fallback_child=int(params["fallback_child"])
+            if "fallback_child" in params
+            else None,
+            quorum=int(params["quorum"]) if "quorum" in params else None,
+        )
+
+    @staticmethod
+    def for_unit(unit: "PredictiveUnit") -> "ResilienceSpec":
+        return ResilienceSpec.from_parameters(parameters_dict(unit.parameters))
+
+
 class TpuSpec(_Spec):
     """TPU-native execution config for a predictor (no reference analogue).
 
@@ -141,6 +224,12 @@ class TpuSpec(_Spec):
     batch_buckets: list[int] = Field(default_factory=list)  # [] -> derived from max_batch
     max_batch: int = 64
     batch_timeout_ms: float = 3.0
+    # per-request deadline BUDGET stamped at the serving entrypoint: every
+    # node call gets the remaining budget, remote calls use it as their
+    # timeout, exhaustion cancels the in-flight subtree and returns 504.
+    # 0 = no deadline (per-call defaults only). Requests may tighten (never
+    # widen) it with a meta.tags["deadline_ms"] override.
+    deadline_ms: float = 0.0
     # how long a request may sit in the batch queue before REQUEST_TIMEOUT:
     # deep DAGs (several device dispatches per walk) or high-RTT links need
     # more than the 2 s default

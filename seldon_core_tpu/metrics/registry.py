@@ -76,6 +76,23 @@ class NullMetrics:
     def loop_lag(self, lag_ms: float) -> None:
         pass
 
+    # resilience layer (engine/resilience.py): retries, breaker state,
+    # deadline exhaustion, degraded responses, injected faults
+    def retry(self, deployment: str, unit: str) -> None:
+        pass
+
+    def breaker(self, deployment: str, endpoint: str, state: str) -> None:
+        pass
+
+    def deadline_exceeded(self, deployment: str, unit: str) -> None:
+        pass
+
+    def degraded(self, deployment: str, mode: str) -> None:
+        pass
+
+    def fault_injected(self, deployment: str, unit: str, kind: str) -> None:
+        pass
+
     def export(self) -> bytes:
         return b""
 
@@ -193,6 +210,46 @@ class Metrics(NullMetrics):
             ["deployment_name", "predictor_name", "shadow_unit", "agree"],
             registry=registry,
         )
+        # resilience layer (engine/resilience.py): these four are the
+        # observable proof of the chaos acceptance test — retries absorbed,
+        # breakers opening/half-open-recovering, budgets exhausted, and
+        # requests served degraded instead of failed
+        self._retries = Counter(
+            "seldon_tpu_retries_total",
+            "Unit-call retry attempts dispatched",
+            ["deployment_name", "model_name"],
+            registry=registry,
+        )
+        self._breaker_transitions = Counter(
+            "seldon_tpu_breaker_transitions_total",
+            "Circuit breaker state transitions per endpoint",
+            ["deployment_name", "endpoint", "state"],
+            registry=registry,
+        )
+        self._breaker_state = Gauge(
+            "seldon_tpu_breaker_state",
+            "Current breaker state per endpoint (0=closed 1=half_open 2=open)",
+            ["deployment_name", "endpoint"],
+            registry=registry,
+        )
+        self._deadline_exceeded = Counter(
+            "seldon_tpu_deadline_exceeded_total",
+            "Requests whose deadline budget ran out, by the unit reached",
+            ["deployment_name", "model_name"],
+            registry=registry,
+        )
+        self._degraded = Counter(
+            "seldon_tpu_degraded_responses_total",
+            "Responses served degraded (router_fallback | quorum)",
+            ["deployment_name", "mode"],
+            registry=registry,
+        )
+        self._faults = Counter(
+            "seldon_tpu_faults_injected_total",
+            "Faults injected by the chaos harness (engine/faults.py)",
+            ["deployment_name", "model_name", "kind"],
+            registry=registry,
+        )
 
     def ingress_request(self, deployment, method, duration_s):
         self._ingress.labels(deployment, method).observe(duration_s)
@@ -242,8 +299,50 @@ class Metrics(NullMetrics):
             self._loop_lag_max_val = lag_ms
             self._loop_lag_max.set(lag_ms)
 
+    def retry(self, deployment, unit):
+        self._retries.labels(deployment, unit).inc()
+
+    def breaker(self, deployment, endpoint, state):
+        from seldon_core_tpu.engine.resilience import breaker_state_value
+
+        self._breaker_transitions.labels(deployment, endpoint, state).inc()
+        self._breaker_state.labels(deployment, endpoint).set(breaker_state_value(state))
+
+    def deadline_exceeded(self, deployment, unit):
+        self._deadline_exceeded.labels(deployment, unit).inc()
+
+    def degraded(self, deployment, mode):
+        self._degraded.labels(deployment, mode).inc()
+
+    def fault_injected(self, deployment, unit, kind):
+        self._faults.labels(deployment, unit, kind).inc()
+
     def export(self) -> bytes:
         return generate_latest(self.registry)
+
+
+class MetricsResilienceEvents:
+    """Adapter: the executor's ResilienceEvents contract -> the registry.
+    Servers construct one per deployment and hand it to build_executor."""
+
+    def __init__(self, metrics: NullMetrics, deployment: str):
+        self._metrics = metrics
+        self._deployment = deployment
+
+    def retry(self, unit: str, attempt: int) -> None:
+        self._metrics.retry(self._deployment, unit)
+
+    def breaker_transition(self, endpoint: str, state: str) -> None:
+        self._metrics.breaker(self._deployment, endpoint, state)
+
+    def deadline_exceeded(self, unit: str) -> None:
+        self._metrics.deadline_exceeded(self._deployment, unit)
+
+    def degraded(self, unit: str, mode: str) -> None:
+        self._metrics.degraded(self._deployment, mode)
+
+    def fault_injected(self, unit: str, kind: str) -> None:
+        self._metrics.fault_injected(self._deployment, unit, kind)
 
 
 async def run_loop_lag_probe(

@@ -273,6 +273,7 @@ class DeploymentManager:
 
     # ------------------------------------------------------------ factories
     def _default_service_factory(self, dep: SeldonDeployment, predictor):
+        from seldon_core_tpu.metrics.registry import MetricsResilienceEvents
         from seldon_core_tpu.engine import build_executor
         from seldon_core_tpu.parallel.mesh import mesh_from_spec
         from seldon_core_tpu.serving.batcher import make_batcher
@@ -307,6 +308,7 @@ class DeploymentManager:
             feedback_metrics_hook=feedback_hook,
             unit_call_hook=unit_call_hook,
             shadow_compare_hook=shadow_hook,
+            resilience_events=MetricsResilienceEvents(self.metrics, dep_name),
         )
         batcher = make_batcher(
             predictor.tpu,
@@ -322,6 +324,7 @@ class DeploymentManager:
             batcher=batcher,
             metrics=self.metrics,
             decode_npy=predictor.tpu.decode_npy_bindata,
+            deadline_ms=predictor.tpu.deadline_ms,
         )
 
     def _make_persister(self, name: str, services: dict):

@@ -30,6 +30,7 @@ import numpy as np
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.engine.resilience import DEADLINE, Deadline, current_deadline
 from seldon_core_tpu.metrics import NullMetrics
 
 
@@ -86,6 +87,10 @@ class _Pending:
     rows: int
     enqueued_at: float
     future: asyncio.Future
+    # the submitting request's deadline budget (engine/resilience.DEADLINE
+    # at submit time) — the merged walk runs under the LOOSEST batch-mate's
+    # budget; each request's own budget is enforced at its ingress
+    deadline: Deadline | None = None
 
 
 ExecuteFn = Callable[[SeldonMessage], Awaitable[SeldonMessage]]
@@ -165,7 +170,13 @@ class MicroBatcher:
         key = (arr.shape[1:], str(arr.dtype))
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        item = _Pending(msg=msg, rows=rows, enqueued_at=time.perf_counter(), future=fut)
+        item = _Pending(
+            msg=msg,
+            rows=rows,
+            enqueued_at=time.perf_counter(),
+            future=fut,
+            deadline=current_deadline(),
+        )
 
         bucket = self._pending.setdefault(key, [])
         bucket.append(item)
@@ -200,6 +211,16 @@ class MicroBatcher:
         task.add_done_callback(self._inflight.discard)
 
     async def _run_batch(self, items: list[_Pending]) -> None:
+        # Deadline for the MERGED walk: the loosest batch-mate's budget (or
+        # none, if any mate is unbudgeted). The flush task otherwise
+        # inherits the context of whichever request triggered the flush —
+        # running the shared walk under ONE mate's (possibly tightest)
+        # budget would cancel its batch-mates' work. Per-request budgets
+        # are still enforced at each request's own ingress wait_for.
+        if any(i.deadline is None for i in items):
+            DEADLINE.set(None)
+        else:
+            DEADLINE.set(max((i.deadline for i in items), key=lambda d: d.expires_at))
         now = time.perf_counter()
         total_rows = sum(i.rows for i in items)
         self.stat_batches += 1

@@ -52,6 +52,7 @@ _STATUS_LINES = {
     413: b"HTTP/1.1 413 Payload Too Large\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
     503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
 }
 
 

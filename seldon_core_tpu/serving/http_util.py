@@ -37,7 +37,15 @@ async def payload_dict(request: web.Request, invalid_code: ErrorCode) -> dict:
 
 
 def error_response(exc: APIException) -> web.Response:
-    return web.json_response(exc.to_status_json(), status=exc.error.http_status)
+    headers = {}
+    retry_after = exc.retry_after_header()
+    if retry_after is not None:
+        # open circuit breaker: tell clients when the next probe could be
+        # admitted instead of letting them hammer a known-down endpoint
+        headers["Retry-After"] = retry_after
+    return web.json_response(
+        exc.to_status_json(), status=exc.error.http_status, headers=headers
+    )
 
 
 def wire_failure(

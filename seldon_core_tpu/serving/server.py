@@ -69,12 +69,15 @@ class PredictorServer:
                 self.deployment_name, predictor.name, shadow_unit, agree
             )
 
+        from seldon_core_tpu.metrics.registry import MetricsResilienceEvents
+
         self.executor: GraphExecutor = build_executor(
             predictor,
             context=context,
             feedback_metrics_hook=feedback_hook,
             unit_call_hook=unit_call_hook,
             shadow_compare_hook=shadow_hook,
+            resilience_events=MetricsResilienceEvents(self.metrics, deployment_name),
         )
         # generative tier: a single-node decoder deployment with
         # tpu.decode_slots > 0 gets the continuous-batching decode loop;
@@ -108,6 +111,7 @@ class PredictorServer:
             metrics=self.metrics,
             decode_npy=predictor.tpu.decode_npy_bindata,
             decode_scheduler=self.decode_scheduler,
+            deadline_ms=predictor.tpu.deadline_ms,
         )
         self.state = {"paused": False}
         self.app = build_app(self.service, self.state, metrics=self.metrics)

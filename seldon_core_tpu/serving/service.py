@@ -6,12 +6,15 @@ Parity: reference engine PredictionService.java (:52-57 puid assignment,
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 from seldon_core_tpu.core.codec_npy import array_from_npy, is_npy, npy_from_array
+from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
 from seldon_core_tpu.core.puid import new_puid
 from seldon_core_tpu.engine.executor import GraphExecutor
+from seldon_core_tpu.engine.resilience import DEADLINE, Deadline
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu.serving.batcher import MicroBatcher
 
@@ -50,12 +53,19 @@ class PredictionService:
         metrics: NullMetrics | None = None,
         decode_npy: bool = True,
         decode_scheduler=None,
+        deadline_ms: float = 0.0,
     ):
         self.executor = executor
         self.deployment_name = deployment_name
         self.predictor_name = predictor_name
         self.batcher = batcher
         self.metrics = metrics or NullMetrics()
+        # per-request deadline BUDGET (tpu.deadline_ms): stamped here at the
+        # serving entrypoint, carried through the graph walk, used as the
+        # remote-call timeout, enforced by cancelling the in-flight subtree.
+        # 0 = disabled; requests may TIGHTEN it via meta.tags["deadline_ms"]
+        # (never widen — the server's budget is the ceiling).
+        self.deadline_ms = deadline_ms
         # per-deployment toggle (tpu.decode_npy_bindata): False keeps every
         # binData opaque — reference oneof passthrough for bytes-contract
         # graphs whose payloads could collide with the npy magic
@@ -64,6 +74,48 @@ class PredictionService:
         # (serving/decode_scheduler.py) — feeds per-token streaming and the
         # batcher's generative handoff; None for every other deployment
         self.decode_scheduler = decode_scheduler
+
+    def _request_deadline(self, msg: SeldonMessage) -> Deadline | None:
+        """The request's deadline budget: the deployment default
+        (tpu.deadline_ms), tightened — never widened — by an optional
+        meta.tags["deadline_ms"] override. None when neither is set."""
+        budget_ms = float(self.deadline_ms or 0.0)
+        tag = msg.meta.tags.get("deadline_ms")
+        if tag is not None:
+            try:
+                req_ms = float(tag)
+            except (TypeError, ValueError):
+                req_ms = 0.0
+            if req_ms > 0:
+                budget_ms = min(budget_ms, req_ms) if budget_ms > 0 else req_ms
+        return Deadline(budget_ms / 1000.0) if budget_ms > 0 else None
+
+    async def _execute_with_deadline(self, msg: SeldonMessage) -> SeldonMessage:
+        """Run the walk under the request's deadline budget. The budget is
+        stamped into the DEADLINE contextvar (every node call checks the
+        remaining budget; remote calls use it as their timeout) and ALSO
+        enforced here with wait_for: exhaustion cancels the in-flight
+        subtree — _gather_settled's all-settle semantics turn that into a
+        clean atomic unwind, no sibling left executing detached."""
+        run = (
+            self.batcher.submit(msg)
+            if self.batcher is not None
+            else self.executor.execute(msg)
+        )
+        deadline = self._request_deadline(msg)
+        if deadline is None:
+            return await run
+        token = DEADLINE.set(deadline)
+        try:
+            return await asyncio.wait_for(run, timeout=max(deadline.remaining(), 0.0))
+        except asyncio.TimeoutError:
+            self.metrics.deadline_exceeded(self.deployment_name, "ingress")
+            raise APIException(
+                ErrorCode.REQUEST_DEADLINE_EXCEEDED,
+                "request exceeded its deadline budget at the ingress",
+            ) from None
+        finally:
+            DEADLINE.reset(token)
 
     async def predict(self, msg: SeldonMessage, *, wire_npy: bool = False) -> SeldonMessage:
         start = time.perf_counter()
@@ -86,10 +138,7 @@ class PredictionService:
                     request_path=dict(msg.meta.request_path),
                 )
             )
-        if self.batcher is not None:
-            out = await self.batcher.submit(msg)
-        else:
-            out = await self.executor.execute(msg)
+        out = await self._execute_with_deadline(msg)
         # response carries the request puid (reference restores it :76)
         if out.meta.puid != msg.meta.puid:
             out = out.with_meta(

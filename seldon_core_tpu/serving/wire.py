@@ -158,8 +158,16 @@ def failure_response(
         e = APIException(fallback_code, str(e))
     if metrics_error is not None:
         metrics_error(e.error.code)
+    headers = {}
+    retry_after = e.retry_after_header()
+    if retry_after is not None:
+        # open circuit breaker: clients should back off until the breaker's
+        # next half-open probe window instead of hammering the endpoint
+        headers["Retry-After"] = retry_after
     return WireResponse(
-        status=e.error.http_status, body=json.dumps(e.to_status_json()).encode()
+        status=e.error.http_status,
+        body=json.dumps(e.to_status_json()).encode(),
+        headers=headers,
     )
 
 

@@ -55,6 +55,7 @@ async def soak(
     model: str = "iris_mlp",
     features: int = 4,
     batch: int = 4,
+    fault_spec=None,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -63,25 +64,23 @@ async def soak(
     from seldon_core_tpu.tools.loadtest import run_load
     from seldon_core_tpu.tools.stack import build_gateway_stack
 
+    graph: dict = {
+        "name": "m",
+        "type": "MODEL",
+        "implementation": "JAX_MODEL",
+        "parameters": [{"name": "model", "value": model, "type": "STRING"}],
+    }
+    if fault_spec is not None:
+        # the faulted leg exercises the resilience layer end-to-end: the
+        # model node gets a retry policy (absorbing injected transport
+        # errors) — what survives shows up in the reported error budget
+        graph["parameters"] += [
+            {"name": "retry_max_attempts", "value": "3", "type": "INT"},
+            {"name": "retry_backoff_ms", "value": "2", "type": "FLOAT"},
+            {"name": "retry_seed", "value": str(fault_spec.seed), "type": "INT"},
+        ]
     dep = SeldonDeployment.from_dict(
-        {
-            "spec": {
-                "name": "soak",
-                "predictors": [
-                    {
-                        "name": "p",
-                        "graph": {
-                            "name": "m",
-                            "type": "MODEL",
-                            "implementation": "JAX_MODEL",
-                            "parameters": [
-                                {"name": "model", "value": model, "type": "STRING"}
-                            ],
-                        },
-                    }
-                ],
-            }
-        }
+        {"spec": {"name": "soak", "predictors": [{"name": "p", "graph": graph}]}}
     )
     dep = default_deployment(dep)
     validate_deployment(dep)
@@ -93,6 +92,11 @@ async def soak(
         oauth_key="soak-key",
         oauth_secret="soak-secret",
     )
+    fault_schedules = {}
+    if fault_spec is not None:
+        from seldon_core_tpu.engine.faults import install_faults
+
+        fault_schedules = install_faults(server.executor, {"m": fault_spec})
 
     port = _free_port()
     fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
@@ -154,6 +158,9 @@ async def soak(
         if denom > 0:
             slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
     lag_sorted = sorted(lag_samples)
+    # s["requests"] counts only SUCCESSES (loadtest tallies errors apart);
+    # the budget denominator is all attempts, clamped only against div-by-0
+    attempts = max(int(s["requests"]) + int(s["errors"]), 1)
     return {
         "duration_s": duration_s,
         "users": users,
@@ -161,6 +168,14 @@ async def soak(
         "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
         "p99_ms": s["p99_ms"],
         "errors": s["errors"],
+        # error budget consumed: failed fraction of all requests (the SLO
+        # number the faulted leg is judged by)
+        "error_rate": round(s["errors"] / attempts, 4),
+        "faults_injected": (
+            sum(sch.injected for sch in fault_schedules.values())
+            if fault_schedules
+            else 0
+        ),
         "rss_start_mb": round(rss_samples[0][1], 1) if rss_samples else None,
         "rss_end_mb": round(rss_samples[-1][1], 1) if rss_samples else None,
         "rss_slope_mb_per_min": round(slope, 3),
@@ -183,16 +198,60 @@ def main(argv=None) -> None:
     ap.add_argument("--model", default="iris_mlp")
     ap.add_argument("--features", type=int, default=4)
     ap.add_argument("--batch", type=int, default=4)
-    args = ap.parse_args(argv)
-    out = asyncio.run(
-        soak(
-            duration_s=args.duration,
-            users=args.users,
-            model=args.model,
-            features=args.features,
-            batch=args.batch,
-        )
+    ap.add_argument(
+        "--faults",
+        action="store_true",
+        help="run the soak twice — faults off, then a seeded fault schedule "
+        "injected into the model node (retries enabled) — and report p99 + "
+        "error budget for both legs side by side",
     )
+    ap.add_argument("--fault-seed", type=int, default=1337)
+    ap.add_argument("--fault-error-rate", type=float, default=0.3)
+    ap.add_argument("--fault-latency-ms", type=float, default=0.0)
+    ap.add_argument(
+        "--fault-flap-period",
+        type=int,
+        default=0,
+        help="calls per unhealthy window (0 = steady error rate)",
+    )
+    args = ap.parse_args(argv)
+
+    def _run(fault_spec=None) -> dict:
+        return asyncio.run(
+            soak(
+                duration_s=args.duration,
+                users=args.users,
+                model=args.model,
+                features=args.features,
+                batch=args.batch,
+                fault_spec=fault_spec,
+            )
+        )
+
+    if not args.faults:
+        out = _run()
+    else:
+        from seldon_core_tpu.engine.faults import FaultSpec
+
+        spec = FaultSpec(
+            error_rate=args.fault_error_rate,
+            latency_ms=args.fault_latency_ms,
+            flap_period=args.fault_flap_period,
+            seed=args.fault_seed,
+        )
+        baseline = _run()
+        faulted = _run(fault_spec=spec)
+        out = {
+            "fault_seed": args.fault_seed,
+            "baseline": baseline,
+            "faulted": faulted,
+            # the resilience claim in one number: how much error budget the
+            # injected fault rate actually burned after retries absorbed it
+            "p99_delta_ms": round(faulted["p99_ms"] - baseline["p99_ms"], 2),
+            "error_rate_delta": round(
+                faulted["error_rate"] - baseline["error_rate"], 4
+            ),
+        }
     json.dump(out, sys.stdout)
     print()
 

@@ -21,6 +21,31 @@ ENGINE_SERVER_GRPC_PORT = "ENGINE_SERVER_GRPC_PORT"  # default 5000 (SeldonGrpcS
 PREDICTIVE_UNIT_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
 PREDICTIVE_UNIT_ID = "PREDICTIVE_UNIT_ID"
 SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
+# RemoteUnit REST transport timeouts (engine/remote._RestSession). The
+# reference bakes one 5 s total deadline into every call
+# (InternalPredictionService.java:77); here connect and total are separate —
+# a connect hang should fail in ~1 s while a legitimately slow model may use
+# the whole total budget — and both are tunable without a rebuild.
+ENGINE_REST_CONNECT_TIMEOUT_S = "ENGINE_REST_CONNECT_TIMEOUT_S"  # default 1.0
+ENGINE_REST_TOTAL_TIMEOUT_S = "ENGINE_REST_TOTAL_TIMEOUT_S"  # default 5.0
+
+
+def rest_timeouts(env: dict | None = None) -> tuple[float, float]:
+    """(connect_s, total_s) for the pooled REST session, env-tunable.
+    Falls back to the defaults on unset OR unparsable values — a typo'd
+    timeout must not take the data plane down at boot."""
+    env = env if env is not None else os.environ
+    out = []
+    for key, default in (
+        (ENGINE_REST_CONNECT_TIMEOUT_S, 1.0),
+        (ENGINE_REST_TOTAL_TIMEOUT_S, 5.0),
+    ):
+        try:
+            value = float(env.get(key, default))
+        except (TypeError, ValueError):
+            value = default
+        out.append(value if value > 0 else default)
+    return out[0], out[1]
 
 
 def encode_b64_json(obj: Any) -> str:

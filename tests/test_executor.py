@@ -613,3 +613,102 @@ async def test_shadow_agreement_metric_ticks():
     assert len([s for s in seen if s[0] == "same"]) == 3
     assert all(agree for n, agree in seen if n == "same")
     assert not any(agree for n, agree in seen if n in ("diff", "boom"))
+
+
+async def test_gather_settled_cancellation_no_detached_siblings():
+    """Deadline-driven cancellation semantics of _gather_settled: when the
+    budget cancels a walk mid-fan-out, NO sibling unit keeps executing
+    detached — side effects stop at the cancellation point. (A plain
+    gather-and-cancel would leave slow siblings running after the caller
+    already returned its error.)"""
+    import asyncio
+
+    from seldon_core_tpu.serving.service import PredictionService
+
+    events: list[str] = []
+
+    class Slow:
+        def __init__(self, name, delay_s):
+            self.n, self.delay_s = name, delay_s
+
+        async def predict(self, X, names):
+            events.append(f"{self.n}:start")
+            await asyncio.sleep(self.delay_s)
+            events.append(f"{self.n}:finish")
+            return np.ones((1, 3), np.float32)
+
+    graph = {
+        "name": "combo",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "fast", "type": "MODEL"},
+            {"name": "slow", "type": "MODEL"},
+        ],
+    }
+    cr = {"spec": {"name": "d", "predictors": [{"name": "p", "graph": graph}]}}
+    pred = SeldonDeployment.from_dict(cr).spec.predictors[0]
+    ex = build_executor(
+        pred,
+        context={"units": {"fast": Slow("fast", 0.01), "slow": Slow("slow", 5.0)}},
+    )
+    service = PredictionService(ex, deadline_ms=100.0)
+    with pytest.raises(APIException) as exc:
+        await service.predict(
+            SeldonMessage.from_array(np.ones((1, 4), np.float32))
+        )
+    assert exc.value.error.code == 304  # REQUEST_DEADLINE_EXCEEDED
+
+    # both siblings started; the fast one finished BEFORE the deadline; the
+    # slow one was cancelled mid-sleep and must never run its tail — wait
+    # long enough that a detached task would have finished and asserted
+    assert "fast:start" in events and "slow:start" in events
+    assert "fast:finish" in events
+    await asyncio.sleep(0.3)
+    assert "slow:finish" not in events, "sibling kept executing detached"
+
+
+async def test_gather_settled_sibling_failure_still_settles_all():
+    """The settle-before-reraise contract WITHOUT a deadline: a fast-failing
+    sibling does not strand the slow one mid-flight — the walk's error
+    surfaces only after every sibling settled (side-effect atomicity)."""
+    import asyncio
+
+    events: list[str] = []
+
+    class Boom:
+        async def predict(self, X, names):
+            events.append("boom")
+            from seldon_core_tpu.core import ErrorCode
+
+            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, "nope")
+
+    class Slow:
+        async def predict(self, X, names):
+            events.append("slow:start")
+            await asyncio.sleep(0.05)
+            events.append("slow:finish")
+            return np.ones((1, 3), np.float32)
+
+    graph = {
+        "name": "combo",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "boom", "type": "MODEL"},
+            {"name": "slow", "type": "MODEL"},
+        ],
+    }
+    cr = {"spec": {"name": "d", "predictors": [{"name": "p", "graph": graph}]}}
+    pred = SeldonDeployment.from_dict(cr).spec.predictors[0]
+    ex = build_executor(
+        pred, context={"units": {"boom": Boom(), "slow": Slow()}}
+    )
+    with pytest.raises(APIException):
+        await ex.execute(SeldonMessage.from_array(np.ones((1, 4), np.float32)))
+    # the slow sibling SETTLED before the error was re-raised
+    assert events == ["boom", "slow:start", "slow:finish"] or events == [
+        "slow:start",
+        "boom",
+        "slow:finish",
+    ]

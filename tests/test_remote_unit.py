@@ -170,3 +170,144 @@ async def test_internal_api_route_aggregate_feedback_endpoints(tmp_path):
             await grpc_server.stop(None)
         if runner is not None:
             await runner.cleanup()
+
+
+async def test_grpc_channel_invalidated_on_transport_failure_and_recovers():
+    """Satellite (ISSUE 2): a gRPC channel cached against a dead backend
+    used to be cached FOREVER — after the backend restarts the unit must
+    recover without a process bounce. The failing call invalidates the
+    cached channel; the next call rebuilds it against the live server."""
+    from tests.conftest import free_port
+
+    port = free_port()
+    ex = build_executor(_graph_with_remote(port, "GRPC"))
+    unit = ex.root.unit
+    msg = SeldonMessage.from_array(np.ones((1, 4), np.float32))
+
+    # nothing listening: UNAVAILABLE -> normalised transport error AND the
+    # cached channel dropped
+    try:
+        await ex.execute(msg)
+        raise AssertionError("expected transport failure")
+    except Exception:
+        pass
+    assert unit._grpc_channel is None
+    assert unit._stub_cache == {}
+
+    # backend comes up on the same port: the rebuilt channel serves
+    backend = PredictionService(build_executor(default_predictor()))
+    server = await start_grpc_server(backend, "127.0.0.1", port)
+    try:
+        out = await ex.execute(msg)
+        np.testing.assert_allclose(np.asarray(out.array), [[0.1, 0.9, 0.5]], rtol=1e-6)
+        assert unit._grpc_channel is not None  # healthy channel stays cached
+    finally:
+        await server.stop(None)
+        await unit.close()
+
+
+async def test_rest_session_close_get_race_and_split_timeouts(monkeypatch):
+    """Satellite (ISSUE 2): _RestSession.get/close are lock-serialized (a
+    close overlapping a get used to be able to hand back a session being
+    torn down), and connect/total timeouts are split + env-tunable."""
+    from seldon_core_tpu.engine.remote import _RestSession
+    from seldon_core_tpu.utils.env import (
+        ENGINE_REST_CONNECT_TIMEOUT_S,
+        ENGINE_REST_TOTAL_TIMEOUT_S,
+        rest_timeouts,
+    )
+
+    assert rest_timeouts({}) == (1.0, 5.0)
+    assert rest_timeouts(
+        {ENGINE_REST_CONNECT_TIMEOUT_S: "0.25", ENGINE_REST_TOTAL_TIMEOUT_S: "9"}
+    ) == (0.25, 9.0)
+    # unparsable / non-positive values fall back instead of crashing boot
+    assert rest_timeouts(
+        {ENGINE_REST_CONNECT_TIMEOUT_S: "nope", ENGINE_REST_TOTAL_TIMEOUT_S: "-1"}
+    ) == (1.0, 5.0)
+
+    monkeypatch.setenv(ENGINE_REST_CONNECT_TIMEOUT_S, "0.5")
+    monkeypatch.setenv(ENGINE_REST_TOTAL_TIMEOUT_S, "7")
+    try:
+        s = await _RestSession.get()
+        assert s.timeout.connect == 0.5 and s.timeout.total == 7.0
+
+        # hammer get/close concurrently: every get must return a session
+        # that is NOT closed at hand-back time, and nothing may raise
+        async def churn(i):
+            if i % 3 == 2:
+                await _RestSession.close()
+                return None
+            sess = await _RestSession.get()
+            assert not sess.closed
+            return sess
+
+        results = await asyncio.gather(*(churn(i) for i in range(30)))
+        assert any(r is not None for r in results)
+    finally:
+        await _RestSession.close()
+
+
+async def test_remote_4xx_is_deterministic_not_retried_not_breaker_counted():
+    """A remote backend answering 4xx is HEALTHY and deterministic: the
+    resilience layer must not replay the identical bad request nor count it
+    toward the endpoint's circuit breaker (a 5xx-class judgment)."""
+    from aiohttp import web
+
+    from tests.conftest import free_port
+
+    hits = []
+
+    async def predict(request):
+        hits.append(1)
+        return web.json_response({"status": "bad payload"}, status=400)
+
+    app = web.Application()
+    app.router.add_post("/predict", predict)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    try:
+        cr = {
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "remote-model",
+                            "type": "MODEL",
+                            "endpoint": {
+                                "service_host": "127.0.0.1",
+                                "service_port": port,
+                                "type": "REST",
+                            },
+                            "parameters": [
+                                {"name": "retry_max_attempts", "value": "3", "type": "INT"},
+                                {"name": "retry_backoff_ms", "value": "1", "type": "FLOAT"},
+                                {"name": "breaker_failure_threshold", "value": "1", "type": "INT"},
+                            ],
+                        },
+                    }
+                ],
+            }
+        }
+        from seldon_core_tpu.graph import SeldonDeployment as SD
+
+        ex = build_executor(SD.from_dict(cr).spec.predictors[0])
+        try:
+            await ex.execute(SeldonMessage.from_array(np.ones((1, 4), np.float32)))
+            raise AssertionError("expected 4xx failure")
+        except Exception as e:
+            assert getattr(e, "retryable", None) is False
+        assert len(hits) == 1, "deterministic 4xx must not be replayed"
+        assert ex.breaker_for("remote-model").state == "closed", (
+            "4xx must not open the breaker against a healthy endpoint"
+        )
+    finally:
+        from seldon_core_tpu.engine.remote import _RestSession
+
+        await _RestSession.close()
+        await runner.cleanup()

@@ -1,0 +1,544 @@
+"""Resilience layer: deadline budgets, retries, circuit breakers, graceful
+degradation, and the deterministic fault-injection harness that proves them
+(ISSUE 2 acceptance: the seeded chaos test at the bottom)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.engine.faults import FaultSchedule, FaultSpec, install_faults
+from seldon_core_tpu.engine.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    DEADLINE,
+    Deadline,
+    ResilienceEvents,
+)
+from seldon_core_tpu.graph import SeldonDeployment
+from seldon_core_tpu.graph.spec import BreakerSpec, ResilienceSpec
+from seldon_core_tpu.serving.service import PredictionService
+
+
+def _predictor(graph: dict):
+    cr = {"spec": {"name": "d", "predictors": [{"name": "p", "graph": graph}]}}
+    return SeldonDeployment.from_dict(cr).spec.predictors[0]
+
+
+def _msg(rows=1):
+    return SeldonMessage.from_array(np.ones((rows, 4), np.float32))
+
+
+class _Recorder(ResilienceEvents):
+    def __init__(self):
+        self.retries = []
+        self.transitions = []
+        self.deadlines = []
+        self.degradations = []
+
+    def retry(self, unit, attempt):
+        self.retries.append((unit, attempt))
+
+    def breaker_transition(self, endpoint, state):
+        self.transitions.append((endpoint, state))
+
+    def deadline_exceeded(self, unit):
+        self.deadlines.append(unit)
+
+    def degraded(self, unit, mode):
+        self.degradations.append((unit, mode))
+
+
+class FlakyModel:
+    """User-class model failing transport-class for the first N calls."""
+
+    def __init__(self, fail_first: int):
+        self.fail_first = fail_first
+        self.calls = 0
+
+    def predict(self, X, names):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, "flaky")
+        return np.full((np.atleast_2d(X).shape[0], 3), 0.5, np.float32)
+
+
+# ----------------------------------------------------------------- primitives
+
+
+def test_resilience_spec_parses_cr_parameters():
+    spec = ResilienceSpec.from_parameters(
+        {
+            "retry_max_attempts": 3,
+            "retry_backoff_ms": 10.0,
+            "breaker_failure_threshold": 4,
+            "breaker_reset_ms": 250.0,
+            "fallback_child": 1,
+            "quorum": 2,
+        }
+    )
+    assert spec.retry.max_attempts == 3 and spec.retry.backoff_ms == 10.0
+    assert spec.breaker.failure_threshold == 4 and spec.breaker.reset_ms == 250.0
+    assert spec.fallback_child == 1 and spec.quorum == 2
+    empty = ResilienceSpec.from_parameters({})
+    assert empty.retry is None and empty.breaker is None
+    assert empty.fallback_child is None and empty.quorum is None
+
+
+def test_circuit_breaker_state_machine_deterministic_clock():
+    now = [0.0]
+    transitions = []
+    cb = CircuitBreaker(
+        BreakerSpec(failure_threshold=3, reset_ms=1000.0, window=100),
+        clock=lambda: now[0],
+        on_transition=transitions.append,
+    )
+    assert cb.state == CLOSED and cb.allow()
+    for _ in range(3):
+        cb.record_failure()
+    assert cb.state == OPEN and not cb.allow() and cb.retry_after_s() > 0
+    # before the reset window: still open, fallback peek says open
+    now[0] = 0.5
+    assert cb.is_open() and not cb.allow()
+    # after the reset window: ONE half-open probe admits, the second is shed
+    now[0] = 1.1
+    assert not cb.is_open()  # peek must not divert the probe traffic
+    assert cb.allow() and cb.state == HALF_OPEN
+    assert not cb.allow()
+    cb.record_success()
+    assert cb.state == CLOSED
+    assert transitions == [OPEN, HALF_OPEN, CLOSED]
+    # half-open probe FAILURE re-opens
+    for _ in range(3):
+        cb.record_failure()
+    now[0] = 3.0
+    assert cb.allow()
+    cb.record_failure()
+    assert cb.state == OPEN
+
+
+def test_circuit_breaker_error_rate_window():
+    cb = CircuitBreaker(
+        BreakerSpec(failure_threshold=100, error_rate=0.5, window=10, reset_ms=1000)
+    )
+    # alternate success/failure: 50% error rate trips once the window fills
+    for _ in range(5):
+        cb.record_success()
+        cb.record_failure()
+    assert cb.state == OPEN
+
+
+def test_fault_schedule_is_deterministic():
+    spec = FaultSpec(error_rate=0.3, latency_ms=1.0, latency_jitter_ms=2.0, seed=42)
+    s1, s2 = FaultSchedule(spec), FaultSchedule(spec)
+    seq1 = [s1.next() for _ in range(200)]
+    seq2 = [s2.next() for _ in range(200)]
+    assert seq1 == seq2
+    assert any(d.action == "error" for d in seq1)
+    assert s1.injected == s2.injected > 0
+
+
+def test_fault_schedule_flapping_windows():
+    # flap_period=5, flap rate 1.0, base rate 0.0: calls 0-4 fail, 5-9 pass
+    s = FaultSchedule(FaultSpec(flap_period=5, flap_error_rate=1.0, seed=0))
+    actions = [s.next().action for _ in range(20)]
+    assert actions == (["error"] * 5 + ["ok"] * 5) * 2
+
+
+# ------------------------------------------------------------------- retries
+
+
+async def test_retry_recovers_transient_transport_failures():
+    events = _Recorder()
+    model = FlakyModel(fail_first=2)
+    graph = {
+        "name": "m",
+        "type": "MODEL",
+        "parameters": [
+            {"name": "retry_max_attempts", "value": "3", "type": "INT"},
+            {"name": "retry_backoff_ms", "value": "1", "type": "FLOAT"},
+            {"name": "retry_seed", "value": "7", "type": "INT"},
+        ],
+    }
+    ex = build_executor(
+        _predictor(graph), context={"units": {"m": model}}, resilience_events=events
+    )
+    out = await ex.execute(_msg())
+    assert np.asarray(out.array).shape == (1, 3)
+    assert model.calls == 3
+    assert events.retries == [("m", 1), ("m", 2)]
+
+
+async def test_retry_exhaustion_propagates_and_nonretryable_skips():
+    # exhaustion: 3 attempts, still failing -> the error surfaces
+    model = FlakyModel(fail_first=10)
+    graph = {
+        "name": "m",
+        "type": "MODEL",
+        "parameters": [{"name": "retry_max_attempts", "value": "3", "type": "INT"},
+                       {"name": "retry_backoff_ms", "value": "1", "type": "FLOAT"}],
+    }
+    ex = build_executor(_predictor(graph), context={"units": {"m": model}})
+    with pytest.raises(APIException):
+        await ex.execute(_msg())
+    assert model.calls == 3
+
+    # deterministic (non-transport) failures are NOT retried
+    class BadResponse:
+        calls = 0
+
+        def predict(self, X, names):
+            BadResponse.calls += 1
+            raise APIException(ErrorCode.ENGINE_INVALID_RESPONSE, "malformed")
+
+    ex2 = build_executor(_predictor(graph), context={"units": {"m": BadResponse()}})
+    with pytest.raises(APIException):
+        await ex2.execute(_msg())
+    assert BadResponse.calls == 1
+
+
+# ------------------------------------------------------------------ deadlines
+
+
+async def test_deadline_budget_cancels_walk_and_returns_504():
+    graph = {
+        "name": "slow",
+        "implementation": "SIMPLE_MODEL",
+        "parameters": [{"name": "delay_ms", "value": "2000", "type": "FLOAT"}],
+    }
+    service = PredictionService(build_executor(_predictor(graph)), deadline_ms=80.0)
+    t0 = time.perf_counter()
+    with pytest.raises(APIException) as exc:
+        await service.predict(_msg())
+    elapsed = time.perf_counter() - t0
+    assert exc.value.error is ErrorCode.REQUEST_DEADLINE_EXCEEDED
+    # budget overrun bounded by a scheduler tick, not the unit's latency
+    assert elapsed < 0.5
+
+
+async def test_request_tag_tightens_but_never_widens_deadline():
+    graph = {"name": "fast", "implementation": "SIMPLE_MODEL"}
+    service = PredictionService(build_executor(_predictor(graph)), deadline_ms=50.0)
+
+    def tagged(ms):
+        return SeldonMessage.from_array(
+            np.ones((1, 4), np.float32), meta=Meta(tags={"deadline_ms": ms})
+        )
+
+    # wider request tag: clamped to the server's 50 ms ceiling
+    d = service._request_deadline(tagged(10_000))
+    assert d is not None and d.remaining() <= 0.051
+    # tighter request tag wins
+    d2 = service._request_deadline(tagged(20))
+    assert d2 is not None and d2.remaining() <= 0.021
+    # no deadline configured and none requested -> unbudgeted
+    free = PredictionService(build_executor(_predictor(graph)))
+    assert free._request_deadline(_msg()) is None
+
+
+async def test_expired_deadline_fails_before_dispatch():
+    calls = []
+
+    class Spy:
+        def predict(self, X, names):
+            calls.append(1)
+            return X
+
+    ex = build_executor(
+        _predictor({"name": "m", "type": "MODEL"}), context={"units": {"m": Spy()}}
+    )
+    token = DEADLINE.set(Deadline(-1.0))  # already expired
+    try:
+        with pytest.raises(APIException) as exc:
+            await ex.execute(_msg())
+    finally:
+        DEADLINE.reset(token)
+    assert exc.value.error is ErrorCode.REQUEST_DEADLINE_EXCEEDED
+    assert calls == []
+
+
+# --------------------------------------------------------------- degradation
+
+
+async def test_router_fallback_on_child_failure_and_breaker_open():
+    events = _Recorder()
+    graph = {
+        "name": "r",
+        "type": "ROUTER",
+        "implementation": "SIMPLE_ROUTER",
+        "parameters": [{"name": "fallback_child", "value": "1", "type": "INT"}],
+        "children": [
+            {
+                "name": "a",
+                "type": "MODEL",
+                "parameters": [
+                    {"name": "breaker_failure_threshold", "value": "2", "type": "INT"},
+                    {"name": "breaker_reset_ms", "value": "60000", "type": "FLOAT"},
+                ],
+            },
+            {"name": "b", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    model = FlakyModel(fail_first=10**9)  # always failing
+    ex = build_executor(
+        _predictor(graph), context={"units": {"a": model}}, resilience_events=events
+    )
+    for _ in range(5):
+        out = await ex.execute(_msg())
+        # every request is served 2xx by the fallback branch, restamped
+        np.testing.assert_allclose(np.asarray(out.array), [[0.1, 0.9, 0.5]], rtol=1e-6)
+        assert out.meta.routing["r"] == 1
+        assert out.meta.tags["degraded"] == "router_fallback"
+    # breaker opened after 2 consecutive failures; later requests never
+    # dispatched to the broken child at all
+    assert ex.breaker_for("a").state == OPEN
+    assert model.calls == 2
+    assert ("a", OPEN) in events.transitions
+    assert all(m == "router_fallback" for _, m in events.degradations)
+
+
+async def test_combiner_quorum_aggregates_survivors():
+    events = _Recorder()
+    graph = {
+        "name": "combo",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "parameters": [{"name": "quorum", "value": "2", "type": "INT"}],
+        "children": [
+            {"name": "m1", "implementation": "SIMPLE_MODEL"},
+            {"name": "m2", "implementation": "SIMPLE_MODEL"},
+            {"name": "dead", "type": "MODEL"},
+        ],
+    }
+    ex = build_executor(
+        _predictor(graph),
+        context={"units": {"dead": FlakyModel(fail_first=10**9)}},
+        resilience_events=events,
+    )
+    out = await ex.execute(_msg())
+    np.testing.assert_allclose(np.asarray(out.array), [[0.1, 0.9, 0.5]], rtol=1e-6)
+    assert out.meta.tags["degraded"] == "quorum"
+    assert ("combo", "quorum") in events.degradations
+    # below quorum: the failure propagates
+    graph["parameters"] = [{"name": "quorum", "value": "3", "type": "INT"}]
+    ex2 = build_executor(
+        _predictor(graph), context={"units": {"dead": FlakyModel(fail_first=10**9)}}
+    )
+    with pytest.raises(APIException):
+        await ex2.execute(_msg())
+
+
+async def test_breaker_open_without_fallback_returns_503_with_retry_after():
+    graph = {
+        "name": "m",
+        "type": "MODEL",
+        "parameters": [
+            {"name": "breaker_failure_threshold", "value": "1", "type": "INT"},
+            {"name": "breaker_reset_ms", "value": "60000", "type": "FLOAT"},
+        ],
+    }
+    ex = build_executor(
+        _predictor(graph), context={"units": {"m": FlakyModel(fail_first=10**9)}}
+    )
+    with pytest.raises(APIException):
+        await ex.execute(_msg())
+    with pytest.raises(APIException) as exc:
+        await ex.execute(_msg())
+    assert exc.value.error is ErrorCode.ENGINE_BREAKER_OPEN
+    assert exc.value.retry_after_s is not None and exc.value.retry_after_s > 0
+    assert exc.value.error.http_status == 503
+
+
+# -------------------------------------------------- the chaos acceptance test
+
+
+@pytest.mark.chaos
+async def test_chaos_flapping_node_served_degraded_with_recovery():
+    """ISSUE 2 acceptance: one node flapping at 30% error rate behind a
+    router-with-fallback; every request returns 2xx (some degraded), the
+    breaker opens and half-open-recovers, no request overruns its deadline
+    budget by more than a scheduler tick, and retry/breaker/deadline
+    metrics land in the prometheus registry."""
+    from seldon_core_tpu.metrics import get_metrics
+    from seldon_core_tpu.metrics.registry import (
+        HAVE_PROMETHEUS,
+        MetricsResilienceEvents,
+    )
+
+    metrics = get_metrics(True)
+    events = _Recorder()
+
+    class Tee(ResilienceEvents):
+        def __init__(self, *sinks):
+            self.sinks = sinks
+
+        def retry(self, unit, attempt):
+            [s.retry(unit, attempt) for s in self.sinks]
+
+        def breaker_transition(self, endpoint, state):
+            [s.breaker_transition(endpoint, state) for s in self.sinks]
+
+        def deadline_exceeded(self, unit):
+            [s.deadline_exceeded(unit) for s in self.sinks]
+
+        def degraded(self, unit, mode):
+            [s.degraded(unit, mode) for s in self.sinks]
+
+    graph = {
+        "name": "r",
+        "type": "ROUTER",
+        "implementation": "SIMPLE_ROUTER",
+        "parameters": [{"name": "fallback_child", "value": "1", "type": "INT"}],
+        "children": [
+            {
+                "name": "flaky",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "parameters": [
+                    {"name": "quorum", "value": "2", "type": "INT"},
+                    {"name": "breaker_failure_threshold", "value": "3", "type": "INT"},
+                    {"name": "breaker_error_rate", "value": "0.5", "type": "FLOAT"},
+                    {"name": "breaker_window", "value": "10", "type": "INT"},
+                    {"name": "breaker_reset_ms", "value": "80", "type": "FLOAT"},
+                    {"name": "retry_max_attempts", "value": "2", "type": "INT"},
+                    {"name": "retry_backoff_ms", "value": "1", "type": "FLOAT"},
+                    {"name": "retry_seed", "value": "11", "type": "INT"},
+                ],
+                "children": [
+                    {"name": "e1", "implementation": "SIMPLE_MODEL"},
+                    {"name": "e2", "implementation": "SIMPLE_MODEL"},
+                    {"name": "e3", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+            {"name": "backup", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    ex = build_executor(
+        _predictor(graph),
+        resilience_events=Tee(events, MetricsResilienceEvents(metrics, "chaos")),
+    )
+    service = PredictionService(
+        ex, deployment_name="chaos", metrics=metrics, deadline_ms=500.0
+    )
+    # the COMBINER's aggregate flaps at 30%; one ensemble member flaps too
+    # (the quorum path), both on seeded schedules
+    install_faults(
+        ex,
+        {
+            "flaky": FaultSpec(error_rate=0.30, seed=1337),
+            "e3": FaultSpec(flap_period=6, flap_error_rate=1.0, seed=7),
+        },
+    )
+
+    budget_s = 0.5
+    tick_s = 0.25  # one generous scheduler tick of overrun allowance
+    statuses = []
+    for i in range(80):
+        t0 = time.perf_counter()
+        out = await service.predict(_msg())
+        elapsed = time.perf_counter() - t0
+        assert elapsed <= budget_s + tick_s, f"request {i} overran its budget"
+        assert not out.is_failure()
+        statuses.append(out.meta.tags.get("degraded"))
+        if i % 10 == 9:
+            # idle long enough for the breaker's reset window so half-open
+            # probes get their chance to recover it
+            await asyncio.sleep(0.1)
+
+    served_degraded = [s for s in statuses if s]
+    assert served_degraded, "expected some degraded 2xx responses"
+    assert None in statuses, "expected some non-degraded responses too"
+    # quorum degradation (partial ensemble) AND router fallback both occurred
+    modes = {m for _, m in events.degradations}
+    assert "quorum" in modes and "router_fallback" in modes
+    # breaker opened and half-open-recovered at least once
+    flaky_transitions = [s for e, s in events.transitions if e == "flaky"]
+    assert OPEN in flaky_transitions and HALF_OPEN in flaky_transitions
+    assert CLOSED in flaky_transitions, "breaker never recovered"
+    # retries were dispatched
+    assert events.retries
+    if HAVE_PROMETHEUS:
+        text = metrics.export().decode()
+        assert "seldon_tpu_retries_total" in text
+        assert 'seldon_tpu_breaker_transitions_total{deployment_name="chaos"' in text
+        assert "seldon_tpu_degraded_responses_total" in text
+        assert "seldon_tpu_breaker_state" in text
+
+
+@pytest.mark.chaos
+async def test_chaos_timeout_fault_is_reclaimed_by_deadline():
+    """An injected hang is cancelled by the deadline budget — the request
+    fails fast with 504 instead of occupying the walk for hang_s."""
+    graph = {"name": "m", "implementation": "SIMPLE_MODEL"}
+    ex = build_executor(_predictor(graph))
+    install_faults(ex, {"m": FaultSpec(timeout_rate=1.0, hang_s=30.0, seed=1)})
+    service = PredictionService(ex, deadline_ms=100.0)
+    t0 = time.perf_counter()
+    with pytest.raises(APIException) as exc:
+        await service.predict(_msg())
+    assert time.perf_counter() - t0 < 1.0
+    assert exc.value.error is ErrorCode.REQUEST_DEADLINE_EXCEEDED
+
+
+async def test_wire_surfaces_breaker_503_with_retry_after_header():
+    """The wire boundary: an open breaker surfaces as HTTP 503 status-JSON
+    with a Retry-After header on BOTH transports' shared wire core."""
+    from seldon_core_tpu.serving.wire import WireRequest, engine_predictions
+
+    graph = {
+        "name": "m",
+        "type": "MODEL",
+        "parameters": [
+            {"name": "breaker_failure_threshold", "value": "1", "type": "INT"},
+            {"name": "breaker_reset_ms", "value": "60000", "type": "FLOAT"},
+        ],
+    }
+    ex = build_executor(
+        _predictor(graph), context={"units": {"m": FlakyModel(fail_first=10**9)}}
+    )
+    service = PredictionService(ex)
+    body = b'{"data": {"ndarray": [[1.0, 1.0, 1.0, 1.0]]}}'
+
+    def req():
+        return WireRequest(
+            method="POST",
+            path="/api/v0.1/predictions",
+            headers={"content-type": "application/json"},
+            body=body,
+        )
+
+    first = await engine_predictions(service, req())  # trips the breaker
+    assert first.status == 500
+    second = await engine_predictions(service, req())
+    assert second.status == 503
+    assert "Retry-After" in second.headers
+    assert int(second.headers["Retry-After"]) >= 1
+    import json as _json
+
+    payload = _json.loads(second.body)
+    assert payload["status"] == "FAILURE" and payload["code"] == 305
+
+
+def test_half_open_probe_slot_released_when_probe_has_no_verdict():
+    """Regression: a half-open probe cancelled by the request deadline used
+    to leak its slot, wedging the breaker in half-open forever."""
+    now = [0.0]
+    cb = CircuitBreaker(
+        BreakerSpec(failure_threshold=1, reset_ms=1000.0, half_open_probes=1),
+        clock=lambda: now[0],
+    )
+    cb.record_failure()
+    assert cb.state == OPEN
+    now[0] = 1.1
+    assert cb.allow() and cb.state == HALF_OPEN
+    assert not cb.allow()  # the only slot is consumed
+    cb.release_probe()  # probe produced no verdict (deadline/cancel)
+    assert cb.allow()  # slot freed: the NEXT probe is admitted
+    cb.record_success()
+    assert cb.state == CLOSED
