@@ -46,6 +46,7 @@ from seldon_core_tpu.engine.resilience import (
     is_retryable,
 )
 from seldon_core_tpu.engine.units import ROUTE_ALL, Unit, UnitRegistry, default_registry
+from seldon_core_tpu import telemetry
 from seldon_core_tpu.graph.spec import (
     PredictiveUnit,
     PredictiveUnitMethod,
@@ -159,7 +160,7 @@ class GraphExecutor:
             if cb is None:
                 cb = CircuitBreaker(
                     n.policy.breaker,
-                    on_transition=lambda state, k=key: self._events.breaker_transition(
+                    on_transition=lambda state, k=key: self._on_breaker_transition(
                         k, state
                     ),
                 )
@@ -178,6 +179,13 @@ class GraphExecutor:
             self._breakers[n.name] = cb
             self._breaker_keys[n.name] = key
 
+    def _on_breaker_transition(self, key: str, state: str) -> None:
+        """Breaker state changes feed the metrics sink AND the trace of the
+        request that witnessed them (transitions fire inside record_failure/
+        record_success, i.e. within some request's walk)."""
+        self._events.breaker_transition(key, state)
+        telemetry.add_event("breaker_transition", {"endpoint": key, "state": state})
+
     def breaker_for(self, node_name: str) -> CircuitBreaker | None:
         """The breaker guarding a node's endpoint, if one is configured
         (tests and the router fallback check read state through this)."""
@@ -190,15 +198,18 @@ class GraphExecutor:
 
     # ------------------------------------------------------------- predict
     async def execute(self, msg: SeldonMessage) -> SeldonMessage:
-        # opt-in request tracing: a request tagged {"trace": ...} gets per-
-        # unit span timings back in tags["trace"], keyed by the puid trace id
-        spans: list[dict] | None = [] if "trace" in msg.meta.tags else None
-        out = await self._get_output(self.root, msg, spans)
-        if spans is not None:
-            out = out.with_meta(
-                out.meta.merged_with(Meta(tags={"trace": spans}))
+        # request tracing: spans are recorded through the ambient telemetry
+        # context (the serving ingress opens it). A request tagged
+        # {"trace": ...} executed WITHOUT an ambient trace (direct executor
+        # use) still gets per-unit spans back in tags["trace"] via a local
+        # store-less trace — the legacy opt-in contract.
+        if "trace" in msg.meta.tags and not telemetry.active():
+            with telemetry.local_trace(puid=msg.meta.puid) as buf:
+                out = await self._get_output(self.root, msg)
+            return out.with_meta(
+                out.meta.merged_with(Meta(tags={"trace": buf.tag_spans()}))
             )
-        return out
+        return await self._get_output(self.root, msg)
 
     # ------------------------------------------------- split-batch execution
     async def execute_many(self, msgs: list[SeldonMessage]) -> list[SeldonMessage]:
@@ -221,7 +232,21 @@ class GraphExecutor:
         shapes = {tuple(np.asarray(a).shape[1:]) for a in arrays}
         if len(shapes) != 1:
             return [await self.execute(m) for m in msgs]
-        return await self._get_output_many(self.root, list(msgs), None)
+        tagged = [i for i, m in enumerate(msgs) if "trace" in m.meta.tags]
+        if tagged and not telemetry.active():
+            # direct batched call with trace-tagged requests: give each its
+            # own local trace so the vectorized walk reports the SAME spans
+            # the scalar walk would (this used to silently drop tracing)
+            with telemetry.local_traces(
+                [msgs[i].meta.puid for i in tagged]
+            ) as bufs:
+                outs = await self._get_output_many(self.root, list(msgs))
+            for buf, i in zip(bufs, tagged):
+                outs[i] = outs[i].with_meta(
+                    outs[i].meta.merged_with(Meta(tags={"trace": buf.tag_spans()}))
+                )
+            return outs
+        return await self._get_output_many(self.root, list(msgs))
 
     @staticmethod
     def _merge_rows(msgs: list[SeldonMessage]) -> SeldonMessage:
@@ -288,14 +313,14 @@ class GraphExecutor:
         )
         return out.with_array(host)
 
-    async def _merged_call(self, node, method_name, method, msgs, spans):
+    async def _merged_call(self, node, method_name, method, msgs):
         merged = self._merge_rows(msgs)
-        out = await self._call(node, method_name, method, merged, spans=spans)
+        out = await self._call(node, method_name, method, merged)
         out = await self._settle_to_host(out)
         return self._scatter_rows(msgs, out)
 
     async def _get_output_many(
-        self, node: Node, msgs: list[SeldonMessage], spans: list | None
+        self, node: Node, msgs: list[SeldonMessage]
     ) -> list[SeldonMessage]:
         unit = node.unit
         msgs = [
@@ -305,7 +330,7 @@ class GraphExecutor:
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_INPUT):
             msgs = await self._merged_call(
-                node, "transform_input", unit.transform_input, msgs, spans
+                node, "transform_input", unit.transform_input, msgs
             )
 
         if not node.children:
@@ -315,7 +340,7 @@ class GraphExecutor:
         if _has_method(node, PredictiveUnitMethod.ROUTE):
             branches = []
             for m in msgs:
-                b = await self._call(node, "route", unit.route, m, spans=spans)
+                b = await self._call(node, "route", unit.route, m)
                 if shadow and b == ROUTE_ALL:
                     b = 0  # shadow default primary (matches the single path)
                 if b != ROUTE_ALL and not (0 <= b < len(node.children)):
@@ -349,20 +374,20 @@ class GraphExecutor:
             async def _run_group(b: int, idxs: list[int]):
                 sub = [msgs[i] for i in idxs]
                 if b == ROUTE_ALL:
-                    outs = await self._fanout_many(node, sub, spans)
+                    outs = await self._fanout_many(node, sub)
                 else:
                     fb = self._fallback_branch(node, b)
                     if fb is not None and self._branch_breaker_open(node, b):
-                        outs = await self._degraded_group(node, fb, sub, spans)
+                        outs = await self._degraded_group(node, fb, sub)
                     else:
                         try:
                             outs = await self._get_output_many(
-                                node.children[b], sub, spans
+                                node.children[b], sub
                             )
                         except Exception as e:  # noqa: BLE001 - gated below
                             if fb is None or not self._fallback_eligible(e):
                                 raise
-                            outs = await self._degraded_group(node, fb, sub, spans)
+                            outs = await self._degraded_group(node, fb, sub)
                 return idxs, outs
 
             results: list[SeldonMessage | None] = [None] * len(msgs)
@@ -378,11 +403,11 @@ class GraphExecutor:
                 )
             out_msgs = results  # type: ignore[assignment]
         else:
-            out_msgs = await self._fanout_many(node, msgs, spans)
+            out_msgs = await self._fanout_many(node, msgs)
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
             out_msgs = await self._merged_call(
-                node, "transform_output", unit.transform_output, out_msgs, spans
+                node, "transform_output", unit.transform_output, out_msgs
             )
         return out_msgs
 
@@ -403,12 +428,12 @@ class GraphExecutor:
             and _has_method(node, PredictiveUnitMethod.AGGREGATE)
             and len(ok) >= max(quorum, 1)
         ):
-            self._events.degraded(node.name, "quorum")
+            self._degraded_event(node, "quorum")
             return ok, True
         raise failures[0]
 
     async def _fanout_many(
-        self, node: Node, msgs: list[SeldonMessage], spans: list | None
+        self, node: Node, msgs: list[SeldonMessage]
     ) -> list[SeldonMessage]:
         """All-children fan-out for a batch: each child walks the whole batch,
         then AGGREGATE runs once on the row-aligned merged child outputs."""
@@ -416,15 +441,15 @@ class GraphExecutor:
         targets = node.children
         degraded = False
         if len(targets) == 1:
-            child_outs = [await self._get_output_many(targets[0], msgs, spans)]
+            child_outs = [await self._get_output_many(targets[0], msgs)]
         else:
             child_outs, degraded = await self._settle_quorum(
-                node, [self._get_output_many(c, msgs, spans) for c in targets]
+                node, [self._get_output_many(c, msgs) for c in targets]
             )
 
         if _has_method(node, PredictiveUnitMethod.AGGREGATE):
             merged_children = [self._merge_rows(co) for co in child_outs]
-            out = await self._call(node, "aggregate", unit.aggregate, merged_children, spans=spans)
+            out = await self._call(node, "aggregate", unit.aggregate, merged_children)
             out = await self._settle_to_host(out)
             if degraded:
                 out = out.with_meta(
@@ -464,25 +489,35 @@ class GraphExecutor:
                 return False
         return True
 
-    async def _call(self, node: Node, method: str, fn, *args, spans):
+    async def _call(self, node: Node, method: str, fn, *args):
         """One unit-method invocation through the resilience pipeline:
 
             deadline check -> breaker gate -> timed attempt -> retry loop
 
         Every attempt is timed individually (the per-unit observability
-        contract counts real dispatches, not logical calls). Retries apply
+        contract counts real dispatches, not logical calls) and recorded as
+        its OWN trace span — a retried call shows each dispatch, and the
+        span is opened BEFORE the dispatch so a remote transport's
+        traceparent header names it as the server-side parent. Retries apply
         only to idempotent methods on transport/5xx-class failures and
         never sleep past the request's remaining budget; breaker outcomes
         are recorded per attempt so a flapping endpoint opens its breaker
-        even while retries are absorbing the failures."""
+        even while retries are absorbing the failures. Resilience actions
+        (retries, breaker fast-fails, deadline exhaustion) are attached to
+        the trace as span events, so a trace shows not just where time went
+        but what this layer DID to the request."""
         d = current_deadline()
         if d is not None and d.expired():
             self._events.deadline_exceeded(node.name)
+            telemetry.add_event("deadline_exceeded", {"unit": node.name})
             raise deadline_exceeded(f"unit '{node.name}'.{method}")
         breaker = self._breakers.get(node.name)
         took_probe = False
         if breaker is not None and method != "send_feedback":
             if not breaker.allow():
+                telemetry.add_event(
+                    "breaker_open", {"endpoint": self._breaker_keys[node.name]}
+                )
                 raise breaker_open_error(self._breaker_keys[node.name], breaker)
             # allow() consumed a probe slot iff the breaker sits half-open
             took_probe = breaker.state == HALF_OPEN
@@ -491,10 +526,15 @@ class GraphExecutor:
         while True:
             attempt += 1
             t0 = time.perf_counter()
+            span_handle = telemetry.begin_spans(
+                f"{node.name}.{method}",
+                {"unit": node.name, "method": method, "attempt": attempt},
+            )
             try:
                 result = await fn(*args)
             except BaseException as e:
-                self._record_call(node, method, time.perf_counter() - t0, spans)
+                telemetry.end_spans(span_handle, error=True)
+                self._record_call(node, method, time.perf_counter() - t0)
                 if breaker is not None:
                     if self._counts_for_breaker(e):
                         breaker.record_failure()
@@ -507,10 +547,17 @@ class GraphExecutor:
                 backoff_s = retry.backoff(attempt) if retry is not None else 0.0
                 if retry is not None and retry.should_retry(method, attempt, e, backoff_s):
                     self._events.retry(node.name, attempt)
+                    telemetry.add_event(
+                        "retry", {"unit": node.name, "attempt": attempt}
+                    )
                     await asyncio.sleep(backoff_s)
                     if breaker is not None:
                         if not breaker.allow():
                             # the endpoint tripped open while we backed off
+                            telemetry.add_event(
+                                "breaker_open",
+                                {"endpoint": self._breaker_keys[node.name]},
+                            )
                             raise breaker_open_error(
                                 self._breaker_keys[node.name], breaker
                             ) from e
@@ -518,18 +565,15 @@ class GraphExecutor:
                     continue
                 raise
             else:
-                self._record_call(node, method, time.perf_counter() - t0, spans)
+                telemetry.end_spans(span_handle)
+                self._record_call(node, method, time.perf_counter() - t0)
                 if breaker is not None:
                     breaker.record_success()
                 return result
 
-    def _record_call(self, node: Node, method: str, dt: float, spans) -> None:
+    def _record_call(self, node: Node, method: str, dt: float) -> None:
         if self._unit_hook is not None:
             self._unit_hook(node.name, method, dt)
-        if spans is not None:
-            spans.append(
-                {"unit": node.name, "method": method, "ms": round(dt * 1e3, 3)}
-            )
 
     # ------------------------------------------------- graceful degradation
     def _fallback_branch(self, node: Node, chosen: int) -> int | None:
@@ -566,17 +610,21 @@ class GraphExecutor:
             )
         )
 
+    def _degraded_event(self, node: Node, mode: str) -> None:
+        self._events.degraded(node.name, mode)
+        telemetry.add_event("degraded", {"unit": node.name, "mode": mode})
+
     async def _degraded_group(
-        self, node: Node, fb: int, sub: list[SeldonMessage], spans
+        self, node: Node, fb: int, sub: list[SeldonMessage]
     ) -> list[SeldonMessage]:
         """Batched router fallback: walk the whole group down the fallback
         branch, restamping routing + the degraded tag per request."""
-        self._events.degraded(node.name, "router_fallback")
+        self._degraded_event(node, "router_fallback")
         sub = [self._degrade_meta(m, node.name, fb, "router_fallback") for m in sub]
-        return await self._get_output_many(node.children[fb], sub, spans)
+        return await self._get_output_many(node.children[fb], sub)
 
     async def _routed_walk(
-        self, node: Node, branch: int, msg: SeldonMessage, spans
+        self, node: Node, branch: int, msg: SeldonMessage
     ) -> SeldonMessage:
         """Walk the routed child with graceful degradation: when the chosen
         child's breaker is firmly open, serve the configured fallback branch
@@ -586,22 +634,20 @@ class GraphExecutor:
         down the path the request ACTUALLY took."""
         fb = self._fallback_branch(node, branch)
         if fb is not None and self._branch_breaker_open(node, branch):
-            self._events.degraded(node.name, "router_fallback")
+            self._degraded_event(node, "router_fallback")
             return await self._get_output(
                 node.children[fb],
                 self._degrade_meta(msg, node.name, fb, "router_fallback"),
-                spans,
             )
         try:
-            return await self._get_output(node.children[branch], msg, spans)
+            return await self._get_output(node.children[branch], msg)
         except Exception as e:  # noqa: BLE001 - gated by _fallback_eligible
             if fb is None or not self._fallback_eligible(e):
                 raise
-            self._events.degraded(node.name, "router_fallback")
+            self._degraded_event(node, "router_fallback")
             return await self._get_output(
                 node.children[fb],
                 self._degrade_meta(msg, node.name, fb, "router_fallback"),
-                spans,
             )
 
     @staticmethod
@@ -634,12 +680,14 @@ class GraphExecutor:
         async def _run():
             # shadows outlive the primary's response by design — the
             # request's deadline budget must not fail a slow candidate's
-            # mirror walk (that would read as disagreement, not latency)
+            # mirror walk (that would read as disagreement, not latency),
+            # and its spans must not land in a trace that already shipped
             DEADLINE.set(None)
+            telemetry.clear()
             try:
                 if isinstance(payload, list):
-                    return await self._get_output_many(child, payload, None)
-                return await self._get_output(child, payload, None)
+                    return await self._get_output_many(child, payload)
+                return await self._get_output(child, payload)
             except Exception as e:  # noqa: BLE001 - shadow failures are data, not errors
                 log.warning("shadow child '%s' failed: %s", child.name, e)
                 return None
@@ -717,7 +765,7 @@ class GraphExecutor:
         await asyncio.sleep(0)
 
     async def _get_output(
-        self, node: Node, msg: SeldonMessage, spans: list | None = None
+        self, node: Node, msg: SeldonMessage
     ) -> SeldonMessage:
         unit = node.unit
         # requestPath (reference Meta.requestPath: every node the request
@@ -727,7 +775,7 @@ class GraphExecutor:
         )
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_INPUT):
-            out = await self._call(node, "transform_input", unit.transform_input, msg, spans=spans)
+            out = await self._call(node, "transform_input", unit.transform_input, msg)
             msg = out.with_meta(msg.meta.merged_with(out.meta))
 
         if not node.children:
@@ -736,7 +784,7 @@ class GraphExecutor:
         branch = ROUTE_ALL
         routed = False
         if _has_method(node, PredictiveUnitMethod.ROUTE):
-            branch = await self._call(node, "route", unit.route, msg, spans=spans)
+            branch = await self._call(node, "route", unit.route, msg)
             routed = True
             # sanityCheckRouting (reference :244-250)
             if branch != ROUTE_ALL and not (0 <= branch < len(node.children)):
@@ -773,12 +821,12 @@ class GraphExecutor:
         degraded_quorum = False
         if len(targets) == 1:
             if routed and branch != ROUTE_ALL and not getattr(unit, "shadow_fanout", False):
-                child_outputs = [await self._routed_walk(node, branch, msg, spans)]
+                child_outputs = [await self._routed_walk(node, branch, msg)]
             else:
-                child_outputs = [await self._get_output(targets[0], msg, spans)]
+                child_outputs = [await self._get_output(targets[0], msg)]
         else:
             child_outputs, degraded_quorum = await self._settle_quorum(
-                node, [self._get_output(c, msg, spans) for c in targets]
+                node, [self._get_output(c, msg) for c in targets]
             )
 
         if getattr(unit, "shadow_fanout", False):
@@ -792,7 +840,7 @@ class GraphExecutor:
             merged_meta = merged_meta.merged_with(co.meta)
 
         if _has_method(node, PredictiveUnitMethod.AGGREGATE):
-            out = await self._call(node, "aggregate", unit.aggregate, child_outputs, spans=spans)
+            out = await self._call(node, "aggregate", unit.aggregate, child_outputs)
         elif len(child_outputs) == 1:
             out = child_outputs[0]
         else:
@@ -807,7 +855,7 @@ class GraphExecutor:
             )
 
         if _has_method(node, PredictiveUnitMethod.TRANSFORM_OUTPUT):
-            out = await self._call(node, "transform_output", unit.transform_output, msg, spans=spans)
+            out = await self._call(node, "transform_output", unit.transform_output, msg)
             msg = out.with_meta(msg.meta.merged_with(out.meta))
         return msg
 

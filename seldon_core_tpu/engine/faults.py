@@ -112,9 +112,17 @@ class ChaosUnit(Unit):
         return self.inner.ready()
 
     async def _perturb(self) -> None:
+        from seldon_core_tpu import telemetry
+
         d = self.schedule.next()
         if d.delay_s > 0:
             await asyncio.sleep(d.delay_s)
+        if d.action != "ok":
+            # injected faults show up in the request's trace as span
+            # events, so a chaos run's traces show what was DONE to them
+            telemetry.add_event(
+                "fault_injected", {"unit": self.name, "kind": d.action}
+            )
         if d.action == "timeout":
             if self._on_fault is not None:
                 self._on_fault(self.name, "timeout")
@@ -159,7 +167,11 @@ def install_faults(
     """Wrap named nodes of a BUILT executor in ChaosUnits. Returns the live
     schedules keyed by node name (tests read .calls/.injected off them).
     Unknown node names are an error — a chaos test silently injecting into
-    nothing would 'prove' resilience vacuously."""
+    nothing would 'prove' resilience vacuously. ``on_fault`` defaults to
+    the executor's resilience event sink, so injected faults tick
+    seldon_tpu_faults_injected_total without every caller re-wiring it."""
+    if on_fault is None:
+        on_fault = executor._events.fault_injected
     schedules: dict[str, FaultSchedule] = {}
     nodes = {n.name: n for n in executor.root.walk()}
     for name, spec in faults.items():

@@ -29,6 +29,7 @@ from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, SeldonMessage
 from seldon_core_tpu.engine.resilience import call_timeout, current_deadline
 from seldon_core_tpu.engine.units import ROUTE_ALL, Unit
+from seldon_core_tpu import telemetry
 from seldon_core_tpu.graph.spec import EndpointType, PredictiveUnit
 from seldon_core_tpu.utils.env import rest_timeouts
 
@@ -127,6 +128,12 @@ class RemoteUnit(Unit):
         # session default); unbudgeted requests ride the session default
         # without paying a per-call ClientTimeout construction
         kwargs = {}
+        # trace propagation: the server side extracts traceparent and
+        # continues this request's trace, so the hop's server-side spans
+        # stitch under the unit-call span that dispatched it
+        tp = telemetry.traceparent()
+        if tp is not None:
+            kwargs["headers"] = {"traceparent": tp}
         if current_deadline() is not None:
             import aiohttp
 
@@ -263,9 +270,14 @@ class RemoteUnit(Unit):
             stub = ServiceStub(channel, service, package="seldon.protos")
             self._stub_cache[service] = stub
         rpc_method = "Predict" if service == "Model" else method
+        # trace propagation over gRPC: same W3C traceparent, as metadata
+        tp = telemetry.traceparent()
+        metadata = (("traceparent", tp),) if tp is not None else None
         try:
             reply = await getattr(stub, rpc_method)(
-                request_pb, timeout=call_timeout(GRPC_DEADLINE_S)
+                request_pb,
+                timeout=call_timeout(GRPC_DEADLINE_S),
+                metadata=metadata,
             )
         except APIException:
             raise

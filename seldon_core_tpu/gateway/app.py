@@ -34,8 +34,15 @@ class Backend:
     # wire_npy: the gateway saw an EXPLICIT application/x-npy declaration —
     # backends must honor it (decode to the tensor arm / forward the raw
     # binary) even for deployments that opted out of binData sniffing
+    # traceparent: the client's W3C trace context header, forwarded so the
+    # engine continues the caller's trace (in-process: straight into the
+    # service; remote: re-sent as an HTTP header)
     async def predict(
-        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+        self,
+        deployment,
+        msg: SeldonMessage,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
     ) -> SeldonMessage:
         raise NotImplementedError
 
@@ -63,9 +70,15 @@ class InProcessBackend(Backend):
         return svc
 
     async def predict(
-        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+        self,
+        deployment,
+        msg: SeldonMessage,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
     ) -> SeldonMessage:
-        return await self._service(deployment).predict(msg, wire_npy=wire_npy)
+        return await self._service(deployment).predict(
+            msg, wire_npy=wire_npy, traceparent=traceparent
+        )
 
     async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
         return await self._service(deployment).send_feedback(fb)
@@ -112,7 +125,7 @@ class RemoteBackend(Backend):
                 kwargs = (
                     {"data": data, "headers": headers}
                     if data is not None
-                    else {"json": json_payload}
+                    else {"json": json_payload, "headers": headers}
                 )
                 async with session.post(url, **kwargs) as resp:
                     body = await resp.read()
@@ -150,13 +163,22 @@ class RemoteBackend(Backend):
             raise last_exc
         raise APIException(ErrorCode.APIFE_MICROSERVICE_ERROR, str(last_exc))
 
-    async def _post(self, deployment, path: str, payload: dict) -> dict:
-        body, _, _ = await self._roundtrip(deployment, path, json_payload=payload)
+    async def _post(
+        self, deployment, path: str, payload: dict, headers: dict | None = None
+    ) -> dict:
+        body, _, _ = await self._roundtrip(
+            deployment, path, json_payload=payload, headers=headers
+        )
         return json.loads(body)
 
     async def predict(
-        self, deployment, msg: SeldonMessage, wire_npy: bool = False
+        self,
+        deployment,
+        msg: SeldonMessage,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
     ) -> SeldonMessage:
+        tp_headers = {"traceparent": traceparent} if traceparent else None
         if wire_npy and msg.bin_data is not None:
             # keep the BINARY fast path across the network hop: raw npy with
             # the x-npy declaration (compact, no base64/JSON inflation; the
@@ -165,7 +187,7 @@ class RemoteBackend(Backend):
                 deployment,
                 "/api/v0.1/predictions",
                 data=msg.bin_data,
-                headers={"Content-Type": "application/x-npy"},
+                headers={"Content-Type": "application/x-npy", **(tp_headers or {})},
             )
             if ctype == "application/x-npy":
                 from seldon_core_tpu.core.codec_json import meta_from_dict
@@ -174,7 +196,12 @@ class RemoteBackend(Backend):
                 return SeldonMessage(bin_data=body, meta=meta)
             # bytes-out graph: the engine fell back to the JSON envelope
             return message_from_dict(json.loads(body))
-        out = await self._post(deployment, "/api/v0.1/predictions", message_to_dict(msg))
+        out = await self._post(
+            deployment,
+            "/api/v0.1/predictions",
+            message_to_dict(msg),
+            headers=tp_headers,
+        )
         return message_from_dict(out)
 
     async def feedback(self, deployment, fb: Feedback) -> SeldonMessage:
@@ -271,8 +298,9 @@ def build_gateway_app(gw: Gateway) -> web.Application:
         return web.Response(text="pong")
 
     async def prometheus(request: web.Request) -> web.Response:
-        body = gw.metrics.export() if gw.metrics is not None else b""
-        return web.Response(body=body, content_type="text/plain")
+        from seldon_core_tpu.serving.http_util import prometheus_response
+
+        return prometheus_response(request, gw.metrics)
 
     async def grpc_web_predict(request: web.Request) -> web.Response:
         from seldon_core_tpu.serving import wire

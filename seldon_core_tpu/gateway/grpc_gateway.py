@@ -67,7 +67,12 @@ def _gateway_methods(gw):
         try:
             principal, dep = _auth(metadata)
             msg = message_from_proto(request)
-            out = await gw.backend.predict(dep, msg)
+            # W3C trace context rides gRPC metadata exactly like the REST
+            # header — forwarded so the engine continues the caller's trace
+            tp = next(
+                (v for k, v in metadata or () if k == "traceparent"), None
+            )
+            out = await gw.backend.predict(dep, msg, traceparent=tp)
             gw.audit.send(principal, msg, out)
             return message_to_proto(out)
         except APIException as e:

@@ -38,7 +38,16 @@ _LATENCY_BUCKETS = (
 class NullMetrics:
     """No-op recorder (metrics disabled or prometheus_client absent)."""
 
-    def ingress_request(self, deployment: str, method: str, duration_s: float) -> None:
+    def ingress_request(
+        self,
+        deployment: str,
+        method: str,
+        duration_s: float,
+        trace_id: str | None = None,
+    ) -> None:
+        """``trace_id``: the request's telemetry trace id; real recorders
+        attach it as an exemplar so a slow histogram sample links to its
+        trace (metrics -> trace correlation, docs/observability.md)."""
         pass
 
     def ingress_error(self, deployment: str, method: str, code: int) -> None:
@@ -94,6 +103,9 @@ class NullMetrics:
         pass
 
     def export(self) -> bytes:
+        return b""
+
+    def export_openmetrics(self) -> bytes:
         return b""
 
 
@@ -251,8 +263,18 @@ class Metrics(NullMetrics):
             registry=registry,
         )
 
-    def ingress_request(self, deployment, method, duration_s):
-        self._ingress.labels(deployment, method).observe(duration_s)
+    def ingress_request(self, deployment, method, duration_s, trace_id=None):
+        h = self._ingress.labels(deployment, method)
+        if trace_id:
+            # trace exemplar on the histogram bucket: OpenMetrics scrapes
+            # (export_openmetrics / /metrics?format=openmetrics) surface it
+            # so a dashboard's slow sample links straight to GET /traces/{id}
+            try:
+                h.observe(duration_s, exemplar={"trace_id": trace_id})
+                return
+            except (TypeError, ValueError):  # older client / invalid exemplar
+                pass
+        h.observe(duration_s)
 
     def ingress_error(self, deployment, method, code):
         self._ingress_errors.labels(deployment, method, str(code)).inc()
@@ -319,6 +341,18 @@ class Metrics(NullMetrics):
 
     def export(self) -> bytes:
         return generate_latest(self.registry)
+
+    def export_openmetrics(self) -> bytes:
+        """OpenMetrics text exposition — the format that carries exemplars
+        (the classic Prometheus text format silently drops them). Falls
+        back to the classic exposition if the client lacks the module."""
+        try:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_latest,
+            )
+        except Exception:  # noqa: BLE001 - optional in older clients
+            return self.export()
+        return om_latest(self.registry)
 
 
 class MetricsResilienceEvents:

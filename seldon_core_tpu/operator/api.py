@@ -105,10 +105,43 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
             {"written": out_dir, "view": "xprof / tensorboard --logdir " + out_dir}
         )
 
+    # distributed-tracing read-out (telemetry/): the process-global trace
+    # store behind the debug surface. GET /traces lists retained trace
+    # summaries (?sort=slow|recent, ?n=, plus the store/sampler counters);
+    # GET /traces/{id} returns one full span tree, addressable by trace id
+    # OR by request puid.
+    async def list_traces(request: web.Request) -> web.Response:
+        from seldon_core_tpu.telemetry import get_tracer
+
+        store = get_tracer().store
+        sort = request.query.get("sort", "recent")
+        try:
+            n = int(request.query.get("n", "50"))
+        except ValueError:
+            n = 50
+        return web.json_response(
+            {
+                "stats": store.stats(),
+                "traces": [r.summary() for r in store.list(sort=sort, n=n)],
+            }
+        )
+
+    async def get_trace(request: web.Request) -> web.Response:
+        from seldon_core_tpu.telemetry import get_tracer
+
+        rec = get_tracer().store.get(request.match_info["id"])
+        if rec is None:
+            return web.json_response(
+                {"error": "trace not found (by trace_id or puid)"}, status=404
+            )
+        return web.json_response(rec.to_dict())
+
     app.router.add_post(BASE, apply_dep)
     app.router.add_put(BASE, apply_dep)
     app.router.add_get(BASE, list_deps)
     app.router.add_get(BASE + "/{name}", get_dep)
     app.router.add_delete(BASE + "/{name}", delete_dep)
+    app.router.add_get("/traces", list_traces)
+    app.router.add_get("/traces/{id}", get_trace)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)

@@ -75,8 +75,15 @@ class RunningDeployment:
                 return self.services[name]
         return self.services[self._weights[-1][0]]
 
-    async def predict(self, msg: SeldonMessage, wire_npy: bool = False) -> SeldonMessage:
-        return await self._pick().predict(msg, wire_npy=wire_npy)
+    async def predict(
+        self,
+        msg: SeldonMessage,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
+    ) -> SeldonMessage:
+        return await self._pick().predict(
+            msg, wire_npy=wire_npy, traceparent=traceparent
+        )
 
     async def send_feedback(self, fb: Feedback) -> SeldonMessage:
         # feedback follows the routing recorded in the response meta, which

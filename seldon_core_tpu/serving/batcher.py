@@ -32,6 +32,7 @@ from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.engine.resilience import DEADLINE, Deadline, current_deadline
 from seldon_core_tpu.metrics import NullMetrics
+from seldon_core_tpu import telemetry
 
 
 def make_batcher(
@@ -91,6 +92,11 @@ class _Pending:
     # at submit time) — the merged walk runs under the LOOSEST batch-mate's
     # budget; each request's own budget is enforced at its ingress
     deadline: Deadline | None = None
+    # the submitting request's trace context(s) + enqueue timestamp: the
+    # merged walk runs under EVERY batch-mate's trace at once, each mate's
+    # walk spans parented to its own "batcher" span (queue wait + walk)
+    trace_ctxs: tuple = ()
+    enq_ns: int = 0
 
 
 ExecuteFn = Callable[[SeldonMessage], Awaitable[SeldonMessage]]
@@ -154,10 +160,6 @@ class MicroBatcher:
             # shape-keyed coalescing — every row admits into a KV slot as
             # one becomes free, retires on EOS / its own max_new_tokens
             return await self._decode.execute_message(msg)
-        if "trace" in msg.meta.tags:
-            # traced requests bypass coalescing: spans must describe THIS
-            # request, and batch-mates must not inherit its trace tags
-            return await self._execute(msg)
         arr = np.asarray(arr)
         if arr.ndim < 2:
             arr = np.atleast_2d(arr)
@@ -176,6 +178,8 @@ class MicroBatcher:
             enqueued_at=time.perf_counter(),
             future=fut,
             deadline=current_deadline(),
+            trace_ctxs=telemetry.current_contexts(),
+            enq_ns=telemetry.now_ns(),
         )
 
         bucket = self._pending.setdefault(key, [])
@@ -231,6 +235,30 @@ class MicroBatcher:
         self.stat_queue_wait_s += sum(waits)
         self.stat_items += len(items)
         self._metrics.batch(self._deployment, total_rows, waits)
+        # one "batcher" span per traced batch-mate, opened at that mate's
+        # OWN enqueue time (it covers queue wait + the merged walk; the
+        # queue-wait share rides as an attr). The merged walk then runs
+        # under every mate's trace at once — its unit spans land in each
+        # mate's tree, parented to that mate's batcher span.
+        batch_spans = []
+        walk_ctxs = []
+        for i, w in zip(items, waits):
+            if not i.trace_ctxs:
+                continue
+            ctxs, spans = telemetry.child_contexts(
+                i.trace_ctxs,
+                "batcher",
+                {
+                    "rows": total_rows,
+                    "mates": len(items),
+                    "queue_wait_ms": round(w * 1e3, 3),
+                },
+                start_ns=i.enq_ns,
+            )
+            walk_ctxs.extend(ctxs)
+            batch_spans.extend(spans)
+        if walk_ctxs:
+            telemetry.TRACE.set(tuple(walk_ctxs))
         try:
             if len(items) > 1 and self._execute_many is not None:
                 # split-batch dispatch: data nodes run merged, route nodes
@@ -268,6 +296,12 @@ class MicroBatcher:
             for i in items:
                 if not i.future.done():
                     i.future.set_exception(e)
+            for s in batch_spans:
+                s.error = True
+        finally:
+            t_end = telemetry.now_ns()
+            for s in batch_spans:
+                s.end(t_end)
 
     def _resolve(self, item: _Pending, out: SeldonMessage, own_slice) -> None:
         if item.future.done():

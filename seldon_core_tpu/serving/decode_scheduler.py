@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.metrics import NullMetrics
+from seldon_core_tpu import telemetry
 from seldon_core_tpu.models.decoder import (
     decode_step,
     decoder_dims,
@@ -108,7 +109,7 @@ class _Seq:
     __slots__ = (
         "prompt", "max_new", "temperature", "top_k", "on_token", "future",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
-        "deadline",
+        "deadline", "trace_ctxs", "gen_spans",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, on_token, future):
@@ -125,6 +126,11 @@ class _Seq:
         self.t_first_token = 0.0
         self.t_last_token = 0.0
         self.deadline = 0.0  # admission deadline (0 = none)
+        # the submitter's trace context(s), captured at submit: the decode
+        # loop runs in its OWN task (no ambient request context), so spans
+        # are attached to each sequence's originating trace explicitly
+        self.trace_ctxs = telemetry.current_contexts()
+        self.gen_spans: list = []  # open "decode.generate" spans, one/ctx
 
 
 class DecodeScheduler:
@@ -323,6 +329,13 @@ class DecodeScheduler:
         if len(seq.tokens) == 1:
             seq.t_first_token = now
             self._metrics.decode_ttft(self._deployment, now - seq.t_enqueued)
+            # TTFT as a trace event on the sequence's generate span — the
+            # latency contract a streaming client actually feels
+            for sp in seq.gen_spans:
+                sp.add_event(
+                    "first_token",
+                    {"ttft_ms": round((now - seq.t_enqueued) * 1e3, 3)},
+                )
         else:
             self._metrics.decode_inter_token(self._deployment, now - seq.t_last_token)
         seq.t_last_token = now
@@ -348,6 +361,13 @@ class DecodeScheduler:
         self._free.append(slot)
         self.stat_retired += 1
         if seq is not None:
+            if seq.gen_spans:
+                t = telemetry.now_ns()
+                for sp in seq.gen_spans:
+                    if sp.attrs is not None:
+                        sp.attrs["tokens"] = len(seq.tokens)
+                    sp.end(t)
+                seq.gen_spans = []
             self._resolve(seq)
 
     def _next_tick(self) -> np.int32:
@@ -392,6 +412,7 @@ class DecodeScheduler:
                 temps[r] = seq.temperature
                 topks[r] = seq.top_k
             tick = self._next_tick()
+            t_wave0 = telemetry.now_ns()
 
             def _do_admit():
                 toks, ck, cv = self._admit_fn(
@@ -401,11 +422,33 @@ class DecodeScheduler:
                 return np.asarray(toks), ck, cv
 
             toks, self._ck, self._cv = await self._device_call(_do_admit)
+            t_wave1 = telemetry.now_ns()
             for r, (seq, slot) in enumerate(zip(wave, taken)):
                 seq.slot = slot
                 seq.pos = self.seq_len  # the first generated token's position
                 self._slots[slot] = seq
                 self.stat_admitted += 1
+                # per-sequence spans on the ORIGINATING request's trace: the
+                # shared prefill wave dispatch, then an open generate span
+                # that accumulates tokens until retirement (TTFT rides it
+                # as an event; steps are one fused dispatch for ALL slots,
+                # so per-step attribution lives in attrs, not span-per-step)
+                for c in seq.trace_ctxs:
+                    ps = c.buf.begin(
+                        "decode.prefill",
+                        c.span.span_id,
+                        {"wave": len(wave), "bucket": bucket, "slot": slot},
+                        start_ns=t_wave0,
+                    )
+                    ps.end(t_wave1)
+                    seq.gen_spans.append(
+                        c.buf.begin(
+                            "decode.generate",
+                            c.span.span_id,
+                            {"slot": slot},
+                            start_ns=t_wave1,
+                        )
+                    )
                 self._emit(seq, int(toks[r]))
                 if self._finished(seq, int(toks[r])):
                     self._retire(slot)
@@ -486,7 +529,13 @@ class DecodeScheduler:
         except Exception as e:  # noqa: BLE001 - fail every waiter, not just one
             log.exception("decode loop failed")
             for seq in list(self._slots) + list(self._waiting):
-                if seq is not None and not seq.future.done():
+                if seq is None:
+                    continue
+                for sp in seq.gen_spans:
+                    sp.error = True
+                    sp.end()
+                seq.gen_spans = []
+                if not seq.future.done():
                     seq.future.set_exception(
                         APIException(ErrorCode.ENGINE_MICROSERVICE_ERROR, str(e))
                     )

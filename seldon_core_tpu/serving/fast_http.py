@@ -245,12 +245,21 @@ class HttpProtocol(asyncio.Protocol):
         # gRPC-Web paths carry auth as arbitrary metadata headers (the
         # reference's oauth_token key) that the C parser's two fixed
         # capture slots don't cover — keep the validated head for a
-        # targeted scan below, before the buffer is consumed
+        # targeted scan below, before the buffer is consumed. The W3C
+        # traceparent propagation header (trace continuation across remote
+        # engine hops) gets the same treatment, gated on a copy-free find
+        # so untraced traffic pays nothing; the header name is lowercase
+        # per the W3C spec (the Python fallback parser captures any case).
         head_bytes = (
             bytes(buf[: parsed.body_start])
             if parsed.path.startswith("/seldon.")
             else b""
         )
+        if not head_bytes and (
+            buf.find(b"traceparent", 0, parsed.body_start) != -1
+            or buf.find(b"Traceparent", 0, parsed.body_start) != -1
+        ):
+            head_bytes = bytes(buf[: parsed.body_start])
         del buf[: parsed.body_start + clen]
 
         headers: dict[str, str] = {}
@@ -262,6 +271,9 @@ class HttpProtocol(asyncio.Protocol):
             token = _header_from_head(head_bytes, b"oauth_token")
             if token is not None:
                 headers["oauth_token"] = token
+            tp = _header_from_head(head_bytes, b"traceparent")
+            if tp is not None:
+                headers["traceparent"] = tp
         path = parsed.path.split("?", 1)[0]
         req = WireRequest(
             method=method,

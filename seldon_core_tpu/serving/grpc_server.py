@@ -40,6 +40,18 @@ def _wrap(fn):
     return handler
 
 
+def _traceparent(context) -> str | None:
+    """W3C trace context from the call's gRPC metadata (the remote client
+    sends it as a ``traceparent`` metadata key, mirroring the HTTP header)."""
+    try:
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                return value
+    except Exception:  # noqa: BLE001 - metadata access must never fail a call
+        pass
+    return None
+
+
 async def start_grpc_server(
     service: PredictionService, host: str = "0.0.0.0", port: int = 5000
 ) -> grpc.aio.Server:
@@ -50,33 +62,51 @@ async def start_grpc_server(
         ]
     )
 
+    def _unit_trace(context, method: str):
+        """Server-side trace continuation for the per-unit-type services
+        (this process standing in for a reference model microservice): the
+        remote engine's gRPC metadata carries traceparent exactly like the
+        REST internal API."""
+        return service.tracer.request_trace(
+            f"ingress:{method}",
+            parent=_traceparent(context),
+            attrs={"deployment": service.deployment_name, "method": method},
+        )
+
     @_wrap
     async def predict(request, context):
-        out = await service.predict(message_from_proto(request))
+        out = await service.predict(
+            message_from_proto(request), traceparent=_traceparent(context)
+        )
         return message_to_proto(out)
 
     @_wrap
     async def send_feedback(request, context):
-        out = await service.send_feedback(feedback_from_proto(request))
+        out = await service.send_feedback(
+            feedback_from_proto(request), traceparent=_traceparent(context)
+        )
         return message_to_proto(out)
 
     @_wrap
     async def transform_input(request, context):
-        out = await service.executor.root.unit.transform_input(
-            message_from_proto(request)
-        )
+        with _unit_trace(context, "transform-input"):
+            out = await service.executor.root.unit.transform_input(
+                message_from_proto(request)
+            )
         return message_to_proto(out)
 
     @_wrap
     async def transform_output(request, context):
-        out = await service.executor.root.unit.transform_output(
-            message_from_proto(request)
-        )
+        with _unit_trace(context, "transform-output"):
+            out = await service.executor.root.unit.transform_output(
+                message_from_proto(request)
+            )
         return message_to_proto(out)
 
     @_wrap
     async def route(request, context):
-        branch = await service.executor.root.unit.route(message_from_proto(request))
+        with _unit_trace(context, "route"):
+            branch = await service.executor.root.unit.route(message_from_proto(request))
         import numpy as np
 
         return message_to_proto(
@@ -85,7 +115,10 @@ async def start_grpc_server(
 
     @_wrap
     async def aggregate(request, context):
-        out = await service.executor.root.unit.aggregate(message_list_from_proto(request))
+        with _unit_trace(context, "aggregate"):
+            out = await service.executor.root.unit.aggregate(
+                message_list_from_proto(request)
+            )
         return message_to_proto(out)
 
     async def server_info(request, context):

@@ -109,6 +109,24 @@ async def classify_binary_body(
     return ("json", None)
 
 
+def prometheus_response(request: web.Request, metrics) -> web.Response:
+    """/metrics response with format negotiation, shared by the engine REST
+    app and the gateway app so the two cannot drift: ?format=openmetrics or
+    an OpenMetrics Accept header selects the exposition that carries trace
+    exemplars on the latency histograms (docs/observability.md)."""
+    if metrics is None:
+        return web.Response(body=b"", content_type="text/plain")
+    if (
+        request.query.get("format") == "openmetrics"
+        or "application/openmetrics-text" in request.headers.get("Accept", "")
+    ):
+        return web.Response(
+            body=metrics.export_openmetrics(),
+            content_type="application/openmetrics-text",
+        )
+    return web.Response(body=metrics.export(), content_type="text/plain")
+
+
 async def to_wire_request(request: web.Request):
     """aiohttp request -> transport-neutral WireRequest (serving/wire.py).
     aiohttp reports octet-stream for header-less requests, so declared_ctype

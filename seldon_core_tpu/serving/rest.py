@@ -63,9 +63,9 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
         return web.Response(text="unpaused")
 
     async def prometheus(request: web.Request) -> web.Response:
-        m = metrics or getattr(service, "metrics", None)
-        body = m.export() if m is not None else b""
-        return web.Response(body=body, content_type="text/plain")
+        from seldon_core_tpu.serving.http_util import prometheus_response
+
+        return prometheus_response(request, metrics or getattr(service, "metrics", None))
 
     # internal microservice API (reference internal-api.md): the endpoints
     # an engine's RemoteUnit dispatches to when THIS process is a wrapped

@@ -13,10 +13,13 @@ from seldon_core_tpu.core.codec_npy import array_from_npy, is_npy, npy_from_arra
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Feedback, Meta, SeldonMessage
 from seldon_core_tpu.core.puid import new_puid
-from seldon_core_tpu.engine.executor import GraphExecutor
+from seldon_core_tpu.engine.executor import DEGRADED_TAG, GraphExecutor
 from seldon_core_tpu.engine.resilience import DEADLINE, Deadline
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu.serving.batcher import MicroBatcher
+from seldon_core_tpu.telemetry import get_tracer
+from seldon_core_tpu.telemetry.access_log import enabled as access_log_enabled
+from seldon_core_tpu.telemetry.access_log import log_request
 
 
 def mirror_npy_kind(out: SeldonMessage) -> SeldonMessage:
@@ -42,6 +45,15 @@ def mirror_npy_kind(out: SeldonMessage) -> SeldonMessage:
     )
 
 
+def _batch_rows(msg: SeldonMessage) -> int:
+    """Request batch size for the access log (tensor leading dim, else 1)."""
+    if msg.data is not None and msg.data.array is not None:
+        shape = msg.data.shape
+        if shape:
+            return int(shape[0])
+    return 1
+
+
 class PredictionService:
     def __init__(
         self,
@@ -54,12 +66,17 @@ class PredictionService:
         decode_npy: bool = True,
         decode_scheduler=None,
         deadline_ms: float = 0.0,
+        tracer=None,
     ):
         self.executor = executor
         self.deployment_name = deployment_name
         self.predictor_name = predictor_name
         self.batcher = batcher
         self.metrics = metrics or NullMetrics()
+        # request tracing: the serving entrypoints open the ingress root
+        # span here; defaults to the process-global tracer so every
+        # deployment's traces land in one store behind GET /traces
+        self.tracer = tracer or get_tracer()
         # per-request deadline BUDGET (tpu.deadline_ms): stamped here at the
         # serving entrypoint, carried through the graph walk, used as the
         # remote-call timeout, enforced by cancelling the in-flight subtree.
@@ -117,7 +134,13 @@ class PredictionService:
         finally:
             DEADLINE.reset(token)
 
-    async def predict(self, msg: SeldonMessage, *, wire_npy: bool = False) -> SeldonMessage:
+    async def predict(
+        self,
+        msg: SeldonMessage,
+        *,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
+    ) -> SeldonMessage:
         start = time.perf_counter()
         # binary tensor fast path: npy binData decodes to the tensor arm
         # before the batcher; the response mirrors the request's kind.
@@ -138,7 +161,56 @@ class PredictionService:
                     request_path=dict(msg.meta.request_path),
                 )
             )
-        out = await self._execute_with_deadline(msg)
+        # ingress root span: one per request, whichever transport delivered
+        # it (REST, fast ingress, gRPC all land here). ``traceparent``
+        # continues a remote caller's trace — that's how a multi-pod graph
+        # walk stitches into one tree. A request tagged {"trace": ...} is
+        # force-traced + force-retained regardless of sampling.
+        buf = None
+        status = 200
+        degraded = ""
+        try:
+            with self.tracer.request_trace(
+                "ingress",
+                puid=msg.meta.puid,
+                parent=traceparent,
+                attrs={
+                    "deployment": self.deployment_name,
+                    "predictor": self.predictor_name,
+                    "method": "predict",
+                },
+                force="trace" in msg.meta.tags,
+            ) as buf:
+                out = await self._execute_with_deadline(msg)
+                degraded = str(out.meta.tags.get(DEGRADED_TAG) or "")
+                if buf is not None and degraded:
+                    buf.flags.add("degraded")
+        except APIException as e:
+            status = e.error.http_status
+            raise
+        except BaseException:
+            status = 500
+            raise
+        finally:
+            if access_log_enabled():
+                log_request(
+                    deployment=self.deployment_name,
+                    method="predict",
+                    puid=msg.meta.puid,
+                    trace_id=buf.trace_id if buf is not None else "",
+                    status=status,
+                    duration_ms=(time.perf_counter() - start) * 1e3,
+                    batch=_batch_rows(msg),
+                    degraded=degraded,
+                    retries=buf.event_count("retry") if buf is not None else 0,
+                )
+        if buf is not None and "trace" in msg.meta.tags:
+            # the legacy opt-in contract, now fed by the telemetry spans:
+            # per-unit timings ride back in tags["trace"], identical on the
+            # scalar and batched walks; the full tree is GET /traces/{id}
+            out = out.with_meta(
+                out.meta.merged_with(Meta(tags={"trace": buf.tag_spans()}))
+            )
         # response carries the request puid (reference restores it :76)
         if out.meta.puid != msg.meta.puid:
             out = out.with_meta(
@@ -152,11 +224,20 @@ class PredictionService:
         if npy_requested:
             out = mirror_npy_kind(out)
         self.metrics.ingress_request(
-            self.deployment_name, "predict", time.perf_counter() - start
+            self.deployment_name,
+            "predict",
+            time.perf_counter() - start,
+            trace_id=buf.trace_id if buf is not None else None,
         )
         return out
 
-    async def predict_stream(self, msg: SeldonMessage, *, wire_npy: bool = False):
+    async def predict_stream(
+        self,
+        msg: SeldonMessage,
+        *,
+        wire_npy: bool = False,
+        traceparent: str | None = None,
+    ):
         """Per-token streaming predict for generative deployments: an async
         generator of JSON-able events —
             {"row": r, "index": i, "token": t}   per generated token
@@ -187,7 +268,7 @@ class PredictionService:
         puid = msg.meta.puid
         sched = self.decode_scheduler
         if sched is None:
-            out = await self.predict(msg)
+            out = await self.predict(msg, traceparent=traceparent)
             arr = out.array
             ev = {
                 "done": True,
@@ -201,14 +282,27 @@ class PredictionService:
             yield ev
             return
         if msg.array is None:
-            from seldon_core_tpu.core.errors import APIException, ErrorCode
-
             raise APIException(
                 ErrorCode.ENGINE_INVALID_JSON,
                 "streaming predict needs tensor token ids",
             )
         rows = np.atleast_2d(np.asarray(msg.array)).astype(np.int32)
         overrides = sched.request_params_from_meta(msg.meta)
+        # streaming ingress span: the decode scheduler picks the trace
+        # context up at submit() and attaches its prefill/generate spans +
+        # TTFT events per row; closed (and tail-sampled) in the finally
+        buf, troot, ttoken = self.tracer.begin_request(
+            "ingress",
+            puid=puid,
+            parent=traceparent,
+            attrs={
+                "deployment": self.deployment_name,
+                "predictor": self.predictor_name,
+                "method": "predict_stream",
+            },
+            force="trace" in msg.meta.tags,
+        )
+        trace_err: BaseException | None = None
         queue: asyncio.Queue = asyncio.Queue()
 
         def on_token(row: int):
@@ -252,16 +346,75 @@ class PredictionService:
                     "puid": puid,
                 }
                 break
+        except BaseException as e:
+            trace_err = e
+            raise
         finally:
             runner.cancel()
+            self.tracer.finish_request(buf, troot, ttoken, error=trace_err)
+            status = 200
+            if isinstance(trace_err, APIException):
+                status = trace_err.error.http_status
+            elif trace_err is not None:
+                status = 500
+            if access_log_enabled():
+                log_request(
+                    deployment=self.deployment_name,
+                    method="predict_stream",
+                    puid=puid,
+                    trace_id=buf.trace_id if buf is not None else "",
+                    status=status,
+                    duration_ms=(time.perf_counter() - start) * 1e3,
+                    batch=int(rows.shape[0]),
+                )
             self.metrics.ingress_request(
-                self.deployment_name, "predict_stream", time.perf_counter() - start
+                self.deployment_name,
+                "predict_stream",
+                time.perf_counter() - start,
+                trace_id=buf.trace_id if buf is not None else None,
             )
 
-    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+    async def send_feedback(
+        self, feedback: Feedback, *, traceparent: str | None = None
+    ) -> SeldonMessage:
         start = time.perf_counter()
-        await self.executor.send_feedback(feedback)
+        puid = ""
+        if feedback.response is not None:
+            puid = feedback.response.meta.puid
+        buf = None
+        status = 200
+        try:
+            with self.tracer.request_trace(
+                "ingress",
+                puid=puid,
+                parent=traceparent,
+                attrs={
+                    "deployment": self.deployment_name,
+                    "predictor": self.predictor_name,
+                    "method": "feedback",
+                },
+            ) as buf:
+                await self.executor.send_feedback(feedback)
+        except APIException as e:
+            status = e.error.http_status
+            raise
+        except BaseException:
+            status = 500
+            raise
+        finally:
+            if access_log_enabled():
+                log_request(
+                    deployment=self.deployment_name,
+                    method="feedback",
+                    puid=puid,
+                    trace_id=buf.trace_id if buf is not None else "",
+                    status=status,
+                    duration_ms=(time.perf_counter() - start) * 1e3,
+                )
         self.metrics.ingress_request(
-            self.deployment_name, "feedback", time.perf_counter() - start
+            self.deployment_name,
+            "feedback",
+            time.perf_counter() - start,
+            trace_id=buf.trace_id if buf is not None else None,
         )
         return SeldonMessage(meta=Meta(puid=new_puid()))

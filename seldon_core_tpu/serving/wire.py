@@ -195,9 +195,15 @@ async def engine_predictions(service, req: WireRequest) -> WireResponse:
         kind = classify_binary_bytes(
             ctype, req.declared_ctype, req.body, sniff_npy=service.decode_npy
         )
+        # W3C trace propagation: a remote engine's RemoteUnit (or any
+        # tracing client) sends traceparent; the service continues that
+        # trace so multi-pod graph walks stitch into one tree
+        tp = req.headers.get("traceparent")
         if kind != "json":
             out = await service.predict(
-                SeldonMessage(bin_data=req.body), wire_npy=kind == "npy"
+                SeldonMessage(bin_data=req.body),
+                wire_npy=kind == "npy",
+                traceparent=tp,
             )
             if kind == "npy" and is_npy(out.bin_data):
                 return npy_wire_response(out)
@@ -206,7 +212,7 @@ async def engine_predictions(service, req: WireRequest) -> WireResponse:
             msg = message_from_json_fast(req.body)
         else:
             msg = message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-        out = await service.predict(msg)
+        out = await service.predict(msg, traceparent=tp)
         return WireResponse(body=message_to_json_fast(out))
     except Exception as e:  # noqa: BLE001 - wire boundary
         return failure_response(
@@ -244,7 +250,9 @@ async def engine_predictions_stream(service, req: WireRequest):
             msg = message_from_json_fast(req.body)
         else:
             msg = message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-        gen = service.predict_stream(msg, wire_npy=kind == "npy")
+        gen = service.predict_stream(
+            msg, wire_npy=kind == "npy", traceparent=req.headers.get("traceparent")
+        )
         first = await gen.__anext__()
     except StopAsyncIteration:
         return WireResponse(status=500, body=b'{"status":"FAILURE"}')
@@ -279,7 +287,9 @@ async def engine_predictions_stream(service, req: WireRequest):
 async def engine_feedback(service, req: WireRequest) -> WireResponse:
     try:
         fb = feedback_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-        out = await service.send_feedback(fb)
+        out = await service.send_feedback(
+            fb, traceparent=req.headers.get("traceparent")
+        )
         return WireResponse(body=message_to_json_fast(out))
     except Exception as e:  # noqa: BLE001 - wire boundary
         return failure_response(
@@ -310,30 +320,43 @@ async def engine_unit_method(service, req: WireRequest, method: str) -> WireResp
         return await engine_predictions(service, req)
     try:
         unit = service.executor.root.unit
-        if method == "transform-input":
-            out = await unit.transform_input(
-                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-            )
-        elif method == "transform-output":
-            out = await unit.transform_output(
-                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-            )
-        elif method == "route":
-            branch = await unit.route(
-                message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-            )
-            out = SeldonMessage.from_array(np.asarray([[branch]], dtype=np.float32))
-        elif method == "aggregate":
-            obj = payload_obj(req, ErrorCode.ENGINE_INVALID_JSON)
-            msgs = [
-                message_from_dict(m) for m in obj.get("seldonMessages", [])
-            ]
-            out = await unit.aggregate(msgs)
-        elif method == "send-feedback":
+        if method == "send-feedback":
             fb = feedback_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
-            out = await service.send_feedback(fb)
-        else:  # pragma: no cover - route tables only register the above
-            raise APIException(ErrorCode.ENGINE_INVALID_JSON, f"unknown method {method}")
+            out = await service.send_feedback(
+                fb, traceparent=req.headers.get("traceparent")
+            )
+            return WireResponse(body=message_to_json_fast(out))
+        # server-side trace continuation for the internal API: a remote
+        # engine's RemoteUnit sends traceparent on transform/route/aggregate
+        # hops exactly like /predict — this span is the hop's server half
+        with service.tracer.request_trace(
+            f"ingress:{method}",
+            parent=req.headers.get("traceparent"),
+            attrs={"deployment": service.deployment_name, "method": method},
+        ):
+            if method == "transform-input":
+                out = await unit.transform_input(
+                    message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+                )
+            elif method == "transform-output":
+                out = await unit.transform_output(
+                    message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+                )
+            elif method == "route":
+                branch = await unit.route(
+                    message_from_dict(payload_obj(req, ErrorCode.ENGINE_INVALID_JSON))
+                )
+                out = SeldonMessage.from_array(np.asarray([[branch]], dtype=np.float32))
+            elif method == "aggregate":
+                obj = payload_obj(req, ErrorCode.ENGINE_INVALID_JSON)
+                msgs = [
+                    message_from_dict(m) for m in obj.get("seldonMessages", [])
+                ]
+                out = await unit.aggregate(msgs)
+            else:  # pragma: no cover - route tables only register the above
+                raise APIException(
+                    ErrorCode.ENGINE_INVALID_JSON, f"unknown method {method}"
+                )
         return WireResponse(body=message_to_json_fast(out))
     except Exception as e:  # noqa: BLE001 - wire boundary
         return failure_response(
@@ -381,7 +404,9 @@ async def gateway_predictions(gw, req: WireRequest) -> WireResponse:
             msg = message_from_json_fast(req.body)
         else:
             msg = message_from_dict(payload_obj(req, ErrorCode.APIFE_INVALID_JSON))
-        out = await gw.backend.predict(dep, msg, wire_npy=npy)
+        out = await gw.backend.predict(
+            dep, msg, wire_npy=npy, traceparent=req.headers.get("traceparent")
+        )
         gw.audit.send(principal, msg, out)  # RestClientController.java:164
         if gw.metrics is not None:
             gw.metrics.ingress_request(dep.name, "predict", _time.perf_counter() - start)

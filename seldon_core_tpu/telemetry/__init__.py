@@ -1,0 +1,71 @@
+"""End-to-end distributed tracing + correlated telemetry (ISSUE 3).
+
+The pieces:
+
+- spans.py    — Span / TraceBuf model, monotonic epoch clock, ids
+- context.py  — contextvar trace carrier, span()/add_event()/mark(),
+                W3C traceparent propagation helpers
+- store.py    — bounded ring store with tail-based sampling
+- tracer.py   — request lifecycle + the process-global tracer
+- export.py   — optional OTLP-JSON file export
+- access_log.py — env-gated structured JSON access logs
+
+Servers open an ingress root span per request (serving/service.py), the
+executor/batcher/decode-scheduler record spans through the contextvar, the
+remote transports propagate/continue the trace across pods, and the
+operator API reads the store back out (GET /traces, GET /traces/{id}).
+"""
+
+from seldon_core_tpu.telemetry.context import (
+    TRACE,
+    TraceContext,
+    active,
+    add_event,
+    begin_spans,
+    child_contexts,
+    clear,
+    end_spans,
+    current_contexts,
+    local_trace,
+    local_traces,
+    mark,
+    parse_traceparent,
+    span,
+    traceparent,
+)
+from seldon_core_tpu.telemetry.spans import Span, TraceBuf, new_trace_id, now_ns
+from seldon_core_tpu.telemetry.store import SpanStore, TraceRecord
+from seldon_core_tpu.telemetry.tracer import (
+    Tracer,
+    configure,
+    get_tracer,
+    tracer_from_env,
+)
+
+__all__ = [
+    "TRACE",
+    "TraceContext",
+    "Span",
+    "TraceBuf",
+    "SpanStore",
+    "TraceRecord",
+    "Tracer",
+    "active",
+    "add_event",
+    "begin_spans",
+    "child_contexts",
+    "end_spans",
+    "clear",
+    "configure",
+    "current_contexts",
+    "get_tracer",
+    "local_trace",
+    "local_traces",
+    "mark",
+    "new_trace_id",
+    "now_ns",
+    "parse_traceparent",
+    "span",
+    "traceparent",
+    "tracer_from_env",
+]

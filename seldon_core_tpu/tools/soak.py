@@ -56,6 +56,7 @@ async def soak(
     features: int = 4,
     batch: int = 4,
     fault_spec=None,
+    trace_summary: int = 0,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -86,6 +87,13 @@ async def soak(
     validate_deployment(dep)
     predictor = dep.spec.predictors[0]
 
+    if trace_summary > 0:
+        # fresh process-global trace store per run: --faults runs two legs
+        # in one process, and the faulted leg's summary must rank ITS
+        # traces, not the union of both legs
+        import seldon_core_tpu.telemetry as telemetry
+
+        telemetry.configure(telemetry.tracer_from_env())
     server, gw, oauth, _token = build_gateway_stack(
         predictor,
         deployment_name="soak",
@@ -161,6 +169,14 @@ async def soak(
     # s["requests"] counts only SUCCESSES (loadtest tallies errors apart);
     # the budget denominator is all attempts, clamped only against div-by-0
     attempts = max(int(s["requests"]) + int(s["errors"]), 1)
+    traces = None
+    if trace_summary > 0:
+        # built-in attribution for soak/chaos runs: the slowest retained
+        # traces (tail sampling keeps errors + slowest-N), each with its
+        # top spans by SELF time — where the tail latency actually went
+        from seldon_core_tpu.telemetry import get_tracer
+
+        traces = get_tracer().store.slowest_summaries(n=trace_summary)
     return {
         "duration_s": duration_s,
         "users": users,
@@ -188,6 +204,7 @@ async def soak(
             lag_sorted[min(len(lag_sorted) - 1, int(0.99 * len(lag_sorted)))], 2
         ) if lag_sorted else None,
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
+        **({"trace_summary": traces} if traces is not None else {}),
     }
 
 
@@ -204,6 +221,16 @@ def main(argv=None) -> None:
         help="run the soak twice — faults off, then a seeded fault schedule "
         "injected into the model node (retries enabled) — and report p99 + "
         "error budget for both legs side by side",
+    )
+    ap.add_argument(
+        "--trace-summary",
+        type=int,
+        nargs="?",
+        const=5,
+        default=0,
+        metavar="N",
+        help="after the run, include the slowest-N retained traces (id, "
+        "total ms, top-3 spans by self-time) in the report (default N=5)",
     )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
@@ -225,6 +252,7 @@ def main(argv=None) -> None:
                 features=args.features,
                 batch=args.batch,
                 fault_spec=fault_spec,
+                trace_summary=args.trace_summary,
             )
         )
 

@@ -28,6 +28,16 @@ SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
 # the whole total budget — and both are tunable without a rebuild.
 ENGINE_REST_CONNECT_TIMEOUT_S = "ENGINE_REST_CONNECT_TIMEOUT_S"  # default 1.0
 ENGINE_REST_TOTAL_TIMEOUT_S = "ENGINE_REST_TOTAL_TIMEOUT_S"  # default 5.0
+# telemetry (telemetry/tracer.py reads these): process-wide tracing toggle,
+# tail-sampling pool bounds, optional OTLP-JSON trace export, and the
+# structured access log gate (telemetry/access_log.py)
+ENGINE_TELEMETRY = "ENGINE_TELEMETRY"  # "off" disables tracing (default on)
+ENGINE_TRACE_MAX_ERRORS = "ENGINE_TRACE_MAX_ERRORS"  # default 128
+ENGINE_TRACE_SLOW_KEEP = "ENGINE_TRACE_SLOW_KEEP"  # default 32
+ENGINE_TRACE_MAX_SAMPLED = "ENGINE_TRACE_MAX_SAMPLED"  # default 64
+ENGINE_TRACE_SAMPLE_RATE = "ENGINE_TRACE_SAMPLE_RATE"  # default 0.05
+ENGINE_OTLP_FILE = "ENGINE_OTLP_FILE"  # path; unset = no export
+ENGINE_ACCESS_LOG = "ENGINE_ACCESS_LOG"  # "json" enables; default off
 
 
 def rest_timeouts(env: dict | None = None) -> tuple[float, float]:

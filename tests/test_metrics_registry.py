@@ -1,0 +1,158 @@
+"""metrics/registry.py export conformance: label escaping, histogram bucket
+boundaries, and that export() round-trips through a minimal Prometheus text
+exposition parser; plus the trace-exemplar link on the OpenMetrics form."""
+
+import math
+import re
+
+import pytest
+
+try:
+    import prometheus_client  # noqa: F401
+
+    HAVE_PROM = True
+except Exception:  # noqa: BLE001
+    HAVE_PROM = False
+
+pytestmark = pytest.mark.skipif(not HAVE_PROM, reason="prometheus_client absent")
+
+from seldon_core_tpu.metrics.registry import _LATENCY_BUCKETS, Metrics
+
+# ------------------------------------------------------- a minimal parser
+# Prometheus text exposition (version 0.0.4): comment/HELP/TYPE lines, then
+# sample lines `name{label="value",...} value [timestamp]`. Label values
+# escape backslash, double-quote and newline.
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(rf"^({_NAME})(?:\{{(.*)\}})? ([^ ]+)(?: [0-9.e+-]+)?$")
+_LABEL_RE = re.compile(rf'({_NAME})="((?:[^"\\\n]|\\\\|\\"|\\n)*)"(?:,|$)')
+
+
+def parse_exposition(text: str) -> list[tuple[str, dict, float]]:
+    """(metric_name, labels, value) per sample; raises on malformed lines."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m is not None, f"unparsable sample line: {line!r}"
+        name, labelstr, value = m.group(1), m.group(2) or "", m.group(3)
+        labels = {}
+        consumed = 0
+        for lm in _LABEL_RE.finditer(labelstr):
+            labels[lm.group(1)] = (
+                lm.group(2)
+                .replace("\\n", "\n")
+                .replace('\\"', '"')
+                .replace("\\\\", "\\")
+            )
+            consumed = lm.end()
+        assert consumed == len(labelstr), f"unparsed labels in: {line!r}"
+        samples.append((name, labels, float(value)))
+    return samples
+
+
+def _samples(metrics: Metrics):
+    return parse_exposition(metrics.export().decode())
+
+
+def test_label_escaping_round_trips():
+    m = Metrics()
+    nasty = 'dep"with\\quotes\nand-newline'
+    m.ingress_request(nasty, "predict", 0.01)
+    samples = _samples(m)
+    found = [
+        labels
+        for name, labels, _ in samples
+        if name.startswith("seldon_api_ingress_server_requests_duration_seconds")
+    ]
+    assert found, "no ingress samples exported"
+    # the parser unescapes back to the EXACT original label value
+    assert all(lbl["deployment_name"] == nasty for lbl in found)
+
+
+def test_histogram_bucket_boundaries_and_counts():
+    m = Metrics()
+    # one observation per configured bucket midpoint + one overflow
+    obs = [b * 0.99 for b in _LATENCY_BUCKETS] + [99.0]
+    for v in obs:
+        m.ingress_request("d", "predict", v)
+    samples = _samples(m)
+    buckets = {
+        labels["le"]: value
+        for name, labels, value in samples
+        if name == "seldon_api_ingress_server_requests_duration_seconds_bucket"
+        and labels["deployment_name"] == "d"
+    }
+    # boundaries are exactly the configured ladder + +Inf
+    parsed_bounds = sorted(
+        float(le) for le in buckets if le != "+Inf"
+    )
+    assert parsed_bounds == sorted(float(b) for b in _LATENCY_BUCKETS)
+    assert "+Inf" in buckets
+    # cumulative counts: monotone non-decreasing, +Inf == _count == len(obs)
+    ordered = [buckets[le] for le in sorted(buckets, key=lambda x: math.inf if x == "+Inf" else float(x))]
+    assert all(a <= b for a, b in zip(ordered, ordered[1:]))
+    assert buckets["+Inf"] == len(obs)
+    count = next(
+        value
+        for name, labels, value in samples
+        if name == "seldon_api_ingress_server_requests_duration_seconds_count"
+        and labels["deployment_name"] == "d"
+    )
+    assert count == len(obs)
+    total = next(
+        value
+        for name, labels, value in samples
+        if name == "seldon_api_ingress_server_requests_duration_seconds_sum"
+        and labels["deployment_name"] == "d"
+    )
+    assert total == pytest.approx(sum(obs), rel=1e-6)
+
+
+def test_full_export_parses_and_covers_every_metric_family():
+    """Exercise one recorder of each family, then round-trip the whole
+    exposition through the parser (no line may fail to parse)."""
+    m = Metrics()
+    m.ingress_request("d", "predict", 0.01)
+    m.ingress_error("d", "predict", 103)
+    m.unit_call("d", "p", "u", "transform_input", 0.002)
+    m.feedback("d", "p", "u", -1.5)  # negative reward must export fine
+    m.batch("d", 8, [0.001, 0.002])
+    m.decode_step("d", 3, 8)
+    m.decode_ttft("d", 0.05)
+    m.decode_inter_token("d", 0.01)
+    m.compile("d", 16, 1.2)
+    m.shadow_compare("d", "p", "cand", True)
+    m.loop_lag(2.5)
+    m.retry("d", "u")
+    m.breaker("d", "ep:9000", "open")
+    m.deadline_exceeded("d", "u")
+    m.degraded("d", "quorum")
+    m.fault_injected("d", "u", "error")
+    samples = _samples(m)
+    names = {n for n, _, _ in samples}
+    for family in (
+        "seldon_api_ingress_server_requests_duration_seconds_bucket",
+        "seldon_api_engine_client_requests_duration_seconds_count",
+        "seldon_api_model_feedback_reward",
+        "seldon_tpu_batch_size_bucket",
+        "seldon_tpu_decode_ttft_seconds_count",
+        "seldon_tpu_retries_total",
+        "seldon_tpu_breaker_state",
+        "seldon_tpu_degraded_responses_total",
+        "seldon_tpu_faults_injected_total",
+    ):
+        assert family in names, f"{family} missing from export"
+    reward = next(v for n, l, v in samples if n == "seldon_api_model_feedback_reward")
+    assert reward == -1.5
+
+
+def test_ingress_exemplar_links_trace_id_on_openmetrics():
+    m = Metrics()
+    m.ingress_request("d", "predict", 0.2, trace_id="ab" * 16)
+    # classic exposition: ignores exemplars but still parses clean
+    _samples(m)
+    om = m.export_openmetrics().decode()
+    assert om.rstrip().endswith("# EOF")
+    assert 'trace_id="' + "ab" * 16 + '"' in om

@@ -1,0 +1,578 @@
+"""End-to-end distributed tracing (telemetry/): single-tree traces across
+ingress -> batcher -> graph walk -> remote hop, batched/scalar span parity,
+tail-based sampling retention, the /traces debug API, and OTLP export."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import telemetry
+from seldon_core_tpu.core.codec_json import message_from_dict
+from seldon_core_tpu.engine import build_executor
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.serving.batcher import MicroBatcher
+from seldon_core_tpu.serving.service import PredictionService
+from seldon_core_tpu.telemetry import SpanStore, Tracer
+from seldon_core_tpu.utils.env import default_predictor
+from tests.conftest import free_port
+
+
+def _fresh_tracer(**store_kwargs) -> Tracer:
+    kwargs = {"max_errors": 64, "slow_keep": 8, "max_sampled": 8, "sample_rate": 1.0}
+    kwargs.update(store_kwargs)
+    return telemetry.configure(Tracer(store=SpanStore(**kwargs)))
+
+
+def _assert_single_tree(spans: list[dict]):
+    """One root, every other span parented inside the trace, and
+    parent/child timestamps nested monotonically."""
+    by_id = {s["span_id"]: s for s in spans}
+    roots = [s for s in spans if not s["parent_id"] or s["parent_id"] not in by_id]
+    assert len(roots) == 1, f"expected one root, got {[s['name'] for s in roots]}"
+    for s in spans:
+        assert s["start_ns"] <= s["end_ns"]
+        if s is roots[0]:
+            continue
+        parent = by_id[s["parent_id"]]
+        assert s["start_ns"] >= parent["start_ns"], (s["name"], parent["name"])
+        assert s["end_ns"] <= parent["end_ns"], (s["name"], parent["name"])
+    return roots[0], by_id
+
+
+def _fanout_with_remote(port: int) -> PredictorSpec:
+    return PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "combine",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "children": [
+                    {"name": "local", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {
+                        "name": "remote",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": port,
+                            "type": "REST",
+                        },
+                    },
+                ],
+            },
+        }
+    )
+
+
+async def test_fanout_trace_with_remote_hop_is_single_tree():
+    """The acceptance tree: a traced request through a fan-out graph with one
+    REMOTE child (in-process server) yields ONE trace tree — ingress span ->
+    batcher span -> per-unit spans, with the remote hop CONTINUED server-side
+    via the traceparent header (the child server's ingress span parents under
+    the client's unit-call span), correct links, monotonic timestamps."""
+    from aiohttp import web
+
+    from seldon_core_tpu.serving.rest import build_app
+
+    tracer = _fresh_tracer()
+    # the remote child: a full PredictionService on a real local port,
+    # sharing the process-global tracer (same store -> fragments merge)
+    child = PredictionService(
+        build_executor(default_predictor()), deployment_name="child", tracer=tracer
+    )
+    runner = web.AppRunner(build_app(child, {"paused": False}))
+    await runner.setup()
+    port = free_port()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    try:
+        ex = build_executor(_fanout_with_remote(port))
+        batcher = MicroBatcher(
+            ex.execute, execute_many=ex.execute_many, max_batch=8, batch_timeout_ms=5.0
+        )
+        service = PredictionService(
+            ex, deployment_name="parent", batcher=batcher, tracer=tracer
+        )
+        msg = message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1.0, 1.0, 1.0, 1.0]]}}
+        )
+        out = await service.predict(msg)
+        assert out.meta.tags["trace"]
+
+        rec = tracer.store.get(out.meta.puid)
+        assert rec is not None, "forced trace must be retained"
+        spans = rec.to_dict()["trace"]
+        root, by_id = _assert_single_tree(spans)
+        assert root["name"] == "ingress"
+        names = [s["name"] for s in spans]
+        assert "batcher" in names
+        # both fan-out children show up as unit-method spans
+        assert "local.transform_input" in names
+        assert "remote.transform_input" in names
+        assert "combine.aggregate" in names
+        # the remote hop continued SERVER-side: the child service's ingress
+        # span is in the same tree, parented under the client's unit span
+        child_ingress = [
+            s
+            for s in spans
+            if s["name"] == "ingress" and s.get("attrs", {}).get("deployment") == "child"
+        ]
+        assert len(child_ingress) == 1
+        hop_parent = by_id[child_ingress[0]["parent_id"]]
+        assert hop_parent["name"] == "remote.transform_input"
+        # and the child's own unit work is below its ingress
+        child_unit = [s for s in spans if s["name"].startswith("simple-model.")]
+        assert child_unit and all(
+            by_id[s["parent_id"]]["name"] == "ingress" for s in child_unit
+        )
+    finally:
+        from seldon_core_tpu.engine.remote import _RestSession
+
+        await _RestSession.close()
+        await runner.cleanup()
+
+
+async def test_batched_path_reports_same_span_set_per_request():
+    """Two traced requests that coalesce into ONE merged walk each get a
+    complete trace: ingress -> batcher -> the same per-unit span set the
+    scalar walk produces, one tree per request."""
+    tracer = _fresh_tracer()
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "scale",
+                "type": "TRANSFORMER",
+                "implementation": "MEAN_TRANSFORMER",
+                "parameters": [{"name": "means", "value": "0.0", "type": "STRING"}],
+                "children": [
+                    {"name": "clf", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+                ],
+            },
+        }
+    )
+    # scalar reference: what one request's unit spans look like un-batched
+    ex_ref = build_executor(pred)
+    ref = await ex_ref.execute(
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1.0, 2.0]]}}
+        )
+    )
+    ref_units = sorted((s["unit"], s["method"]) for s in ref.meta.tags["trace"])
+
+    ex = build_executor(pred)
+    batcher = MicroBatcher(
+        ex.execute, execute_many=ex.execute_many, max_batch=8, batch_timeout_ms=20.0
+    )
+    service = PredictionService(
+        ex, deployment_name="d", batcher=batcher, tracer=tracer
+    )
+    reqs = [
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[float(i), 2.0]]}}
+        )
+        for i in range(2)
+    ]
+    outs = await asyncio.gather(*(service.predict(m) for m in reqs))
+    assert batcher.stat_batches == 1 and batcher.stat_items == 2  # truly coalesced
+
+    for out in outs:
+        rec = tracer.store.get(out.meta.puid)
+        assert rec is not None
+        spans = rec.to_dict()["trace"]
+        root, by_id = _assert_single_tree(spans)
+        assert root["name"] == "ingress"
+        batch_spans = [s for s in spans if s["name"] == "batcher"]
+        assert len(batch_spans) == 1
+        assert by_id[batch_spans[0]["parent_id"]]["name"] == "ingress"
+        units = sorted(
+            (s["attrs"]["unit"], s["attrs"]["method"])
+            for s in spans
+            if "attrs" in s and "unit" in s["attrs"]
+        )
+        assert units == ref_units
+        # unit spans hang off THIS request's batcher span
+        for s in spans:
+            if "attrs" in s and "unit" in s["attrs"]:
+                assert by_id[s["parent_id"]]["name"] == "batcher"
+        # the client-visible tag list matches the trace's unit spans
+        tag_units = sorted(
+            (t["unit"], t["method"]) for t in out.meta.tags["trace"]
+        )
+        assert tag_units == ref_units
+
+
+@pytest.mark.chaos
+async def test_tail_sampling_retains_every_failed_request_within_bound():
+    """Under a seeded fault schedule every ERRORED request's trace is
+    retained while the store stays within its hard bound; ok traces are
+    sampled/slowest-N only."""
+    from seldon_core_tpu.engine.faults import FaultSpec, install_faults
+
+    tracer = _fresh_tracer(
+        max_errors=64, slow_keep=4, max_sampled=4, sample_rate=0.1
+    )
+    ex = build_executor(default_predictor())
+    install_faults(ex, {"simple-model": FaultSpec(error_rate=0.5, seed=7)})
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+
+    failed_puids, ok_puids = [], []
+    for i in range(100):
+        msg = message_from_dict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}})
+        try:
+            out = await service.predict(msg)
+            ok_puids.append(out.meta.puid)
+        except Exception:
+            # puid was assigned inside predict; recover it from the trace
+            # store by scanning is impossible for drops — track via meta
+            failed_puids.append(msg.meta.puid or None)
+    # count failures via the store's error pool instead of puids (the
+    # request's puid is minted inside predict for unstamped requests)
+    stats = tracer.store.stats()
+    assert stats["retained"] <= tracer.store.capacity
+    errors = [r for r in tracer.store.list(n=1000) if "error" in r.flags]
+    assert len(errors) == 100 - len(ok_puids), (
+        "every failed request's trace must be retained "
+        f"(failed={100 - len(ok_puids)}, retained errors={len(errors)})"
+    )
+    assert 0 < len(ok_puids) < 100  # the seed actually mixed outcomes
+    for rec in errors:
+        assert any(s.error for s in rec.spans)
+
+
+async def test_degraded_response_trace_is_retained():
+    """A quorum-degraded fan-out response flags its trace 'degraded' and the
+    tail sampler always keeps it."""
+    from seldon_core_tpu.engine.faults import FaultSpec, install_faults
+
+    tracer = _fresh_tracer(sample_rate=0.0, slow_keep=0, max_sampled=0)
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "combine",
+                "type": "COMBINER",
+                "implementation": "AVERAGE_COMBINER",
+                "parameters": [{"name": "quorum", "value": "1", "type": "INT"}],
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+    )
+    ex = build_executor(pred)
+    install_faults(ex, {"b": FaultSpec(error_rate=1.0, seed=1)})
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+    out = await service.predict(
+        message_from_dict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}})
+    )
+    assert out.meta.tags.get("degraded") == "quorum"
+    rec = tracer.store.get(out.meta.puid)
+    assert rec is not None and "degraded" in rec.flags
+    # the resilience layer's actions are visible as span events
+    event_names = {e.name for s in rec.spans for e in (s.events or [])}
+    assert "fault_injected" in event_names
+    assert "degraded" in event_names
+
+
+async def test_retry_events_ride_the_trace():
+    """Retries absorbed by the resilience layer appear as span events and
+    each dispatched attempt is its own span."""
+    tracer = _fresh_tracer()
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "m",
+                "type": "MODEL",
+                "implementation": "SIMPLE_MODEL",
+                "parameters": [
+                    {"name": "retry_max_attempts", "value": "3", "type": "INT"},
+                    {"name": "retry_backoff_ms", "value": "1", "type": "FLOAT"},
+                    {"name": "retry_seed", "value": "0", "type": "INT"},
+                ],
+            },
+        }
+    )
+    from seldon_core_tpu.engine.faults import FaultSpec, install_faults
+
+    ex = build_executor(pred)
+    # flapping: first call of each 2-cycle fails, so attempt 1 fails and
+    # attempt 2 succeeds deterministically
+    install_faults(ex, {"m": FaultSpec(flap_period=1, seed=3)})
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+    out = await service.predict(
+        message_from_dict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}})
+    )
+    rec = tracer.store.get(out.meta.puid)
+    assert rec is not None
+    attempts = [s for s in rec.spans if s.name == "m.transform_input"]
+    assert len(attempts) == 2  # failed attempt + successful retry
+    assert attempts[0].error and not attempts[1].error
+    retry_events = [
+        e for s in rec.spans for e in (s.events or []) if e.name == "retry"
+    ]
+    assert len(retry_events) == 1
+
+
+async def test_deadline_exceeded_trace_flagged_and_retained():
+    tracer = _fresh_tracer(sample_rate=0.0, slow_keep=0, max_sampled=0)
+    from seldon_core_tpu.engine.faults import FaultSpec, install_faults
+
+    ex = build_executor(default_predictor())
+    install_faults(
+        ex, {"simple-model": FaultSpec(timeout_rate=1.0, hang_s=5.0, seed=0)}
+    )
+    service = PredictionService(ex, deployment_name="d", tracer=tracer, deadline_ms=50)
+    from seldon_core_tpu.core.errors import APIException, ErrorCode
+
+    with pytest.raises(APIException) as ei:
+        await service.predict(
+            message_from_dict({"data": {"ndarray": [[1.0, 2.0, 3.0, 4.0]]}})
+        )
+    assert ei.value.error is ErrorCode.REQUEST_DEADLINE_EXCEEDED
+    recs = tracer.store.list(n=10)
+    assert len(recs) == 1 and "deadline" in recs[0].flags
+
+
+# ---------------------------------------------------------------- unit level
+
+
+def test_traceparent_roundtrip_and_rejects():
+    from seldon_core_tpu.telemetry import parse_traceparent
+
+    with telemetry.local_trace() as buf:
+        header = telemetry.traceparent()
+        parsed = parse_traceparent(header)
+        assert parsed == (buf.trace_id, buf.spans[0].span_id)
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("00-zz-11-01") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") is None
+    ok = parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert ok == ("a" * 32, "b" * 16)
+
+
+def test_store_bound_slowest_and_fragment_merge():
+    from seldon_core_tpu.telemetry.spans import TraceBuf, new_trace_id
+
+    store = SpanStore(max_errors=4, slow_keep=3, max_sampled=2, sample_rate=0.0)
+
+    def mk(duration_ms: float, flags=(), trace_id=None, parent=""):
+        buf = TraceBuf(trace_id or new_trace_id())
+        s = buf.begin("ingress", parent)
+        s.end(s.start_ns + int(duration_ms * 1e6))
+        buf.flags |= set(flags)
+        return buf
+
+    # 20 ok traces with increasing durations: only the slowest 3 retained
+    for i in range(20):
+        store.offer(mk(float(i + 1)))
+    assert len(store) == 3
+    kept = sorted(r.duration_ms for r in store.list(sort="slow", n=10))
+    assert kept == [18.0, 19.0, 20.0]
+    # error traces always keep, within their own bound
+    for i in range(6):
+        store.offer(mk(0.1, flags=("error",)))
+    assert len(store) <= store.capacity
+    assert sum(1 for r in store.list(n=100) if "error" in r.flags) == 4
+    # fragment offered BEFORE its root waits pending, then merges
+    tid = new_trace_id()
+    frag = TraceBuf(tid)
+    child = frag.begin("ingress", "f" * 16)  # parent outside the buf
+    child.end()
+    assert store.offer(frag) is False
+    root = mk(999.0, trace_id=tid)
+    assert store.offer(root) is True
+    rec = store.get(tid)
+    assert rec is not None and len(rec.spans) == 2
+    # a FLAGGED fragment retains immediately (a multi-pod child's error
+    # half must be debuggable even though its root lives in another pod)
+    err_frag = mk(0.1, flags=("error",), parent="e" * 16)
+    assert store.offer(err_frag) is True
+    assert store.get(err_frag.trace_id) is not None
+
+
+async def test_operator_traces_endpoints(tmp_path):
+    """GET /traces lists retained summaries; GET /traces/{id} returns the
+    span tree by trace id or puid; unknown ids 404."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.operator import DeploymentManager
+    from seldon_core_tpu.operator.api import add_operator_routes
+
+    tracer = _fresh_tracer()
+    ex = build_executor(default_predictor())
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+    out = await service.predict(
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1, 2, 3, 4]]}}
+        )
+    )
+
+    app = web.Application()
+    add_operator_routes(app, DeploymentManager())
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        r = await client.get("/traces?sort=slow")
+        assert r.status == 200
+        body = await r.json()
+        assert body["stats"]["retained"] >= 1
+        entry = next(t for t in body["traces"] if t["puid"] == out.meta.puid)
+        assert entry["root"] == "ingress" and "forced" in entry["flags"]
+
+        r = await client.get(f"/traces/{entry['trace_id']}")
+        assert r.status == 200
+        tree = await r.json()
+        assert tree["trace"] and tree["trace"][0]["name"] == "ingress"
+
+        r = await client.get(f"/traces/{out.meta.puid}")  # by puid too
+        assert r.status == 200
+
+        r = await client.get("/traces/nope")
+        assert r.status == 404
+    finally:
+        await client.close()
+
+
+async def test_otlp_file_export(tmp_path):
+    path = str(tmp_path / "traces.jsonl")
+    tracer = telemetry.configure(
+        Tracer(store=SpanStore(sample_rate=1.0), otlp_path=path)
+    )
+    ex = build_executor(default_predictor())
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+    out = await service.predict(
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1, 2, 3, 4]]}}
+        )
+    )
+    lines = [json.loads(l) for l in open(path).read().splitlines() if l]
+    assert lines
+    spans = lines[-1]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert any(s["name"] == "ingress" for s in spans)
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    res_attrs = {
+        a["key"]: a["value"] for a in lines[-1]["resourceSpans"][0]["resource"]["attributes"]
+    }
+    assert res_attrs["seldon.puid"]["stringValue"] == out.meta.puid
+
+
+async def test_access_log_emits_one_json_line(monkeypatch):
+    import logging
+
+    from seldon_core_tpu.telemetry.access_log import access_logger
+
+    monkeypatch.setenv("ENGINE_ACCESS_LOG", "json")
+    records: list[str] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture()
+    access_logger().addHandler(handler)
+    try:
+        tracer = _fresh_tracer()
+        ex = build_executor(default_predictor())
+        service = PredictionService(ex, deployment_name="dep", tracer=tracer)
+        out = await service.predict(
+            message_from_dict({"data": {"ndarray": [[1, 2, 3, 4], [5, 6, 7, 8]]}})
+        )
+    finally:
+        access_logger().removeHandler(handler)
+    assert len(records) == 1
+    line = json.loads(records[0])
+    assert line["puid"] == out.meta.puid
+    assert line["deployment"] == "dep" and line["method"] == "predict"
+    assert line["status"] == 200 and line["duration_ms"] > 0
+    assert line["batch"] == 2
+    assert line["trace_id"]  # correlates to GET /traces/{id}
+
+
+async def test_telemetry_off_means_no_tracing_work(monkeypatch):
+    """ENGINE_TELEMETRY=off: no spans, no store writes, predict unaffected
+    (the bench A/B toggle)."""
+    from seldon_core_tpu.telemetry.tracer import tracer_from_env
+
+    monkeypatch.setenv("ENGINE_TELEMETRY", "off")
+    tracer = telemetry.configure(tracer_from_env())
+    assert not tracer.enabled
+    ex = build_executor(default_predictor())
+    service = PredictionService(ex, deployment_name="d", tracer=tracer)
+    out = await service.predict(
+        message_from_dict({"data": {"ndarray": [[1, 2, 3, 4]]}})
+    )
+    assert out.array is not None
+    assert len(tracer.store) == 0
+    # the legacy tag opt-in still forces a trace even when sampling is off
+    out = await service.predict(
+        message_from_dict(
+            {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1, 2, 3, 4]]}}
+        )
+    )
+    assert out.meta.tags["trace"]
+    assert len(tracer.store) == 1
+
+
+async def test_grpc_remote_hop_continues_trace():
+    """gRPC transport parity for propagation: the remote hop's server-side
+    ingress span stitches into the caller's tree via gRPC metadata."""
+    from seldon_core_tpu.graph import SeldonDeployment
+    from seldon_core_tpu.serving.grpc_server import start_grpc_server
+
+    tracer = _fresh_tracer()
+    child = PredictionService(
+        build_executor(default_predictor()), deployment_name="child", tracer=tracer
+    )
+    port = free_port()
+    server = await start_grpc_server(child, "127.0.0.1", port)
+    try:
+        cr = {
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "remote-model",
+                            "type": "MODEL",
+                            "endpoint": {
+                                "service_host": "127.0.0.1",
+                                "service_port": port,
+                                "type": "GRPC",
+                            },
+                        },
+                    }
+                ],
+            }
+        }
+        pred = SeldonDeployment.from_dict(cr).spec.predictors[0]
+        service = PredictionService(
+            build_executor(pred), deployment_name="parent", tracer=tracer
+        )
+        out = await service.predict(
+            message_from_dict(
+                {"meta": {"tags": {"trace": True}}, "data": {"ndarray": [[1, 1, 1, 1]]}}
+            )
+        )
+        rec = tracer.store.get(out.meta.puid)
+        assert rec is not None
+        spans = rec.to_dict()["trace"]
+        root, by_id = _assert_single_tree(spans)
+        child_ingress = [
+            s
+            for s in spans
+            if s["name"] == "ingress" and s.get("attrs", {}).get("deployment") == "child"
+        ]
+        assert len(child_ingress) == 1
+        assert by_id[child_ingress[0]["parent_id"]]["name"].startswith("remote-model.")
+    finally:
+        await server.stop(None)

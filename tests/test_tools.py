@@ -677,3 +677,43 @@ def test_soak_harness_reports_stability_signals():
     assert out["rss_end_mb"] > 0 and out["rss_start_mb"] > 0
     assert out["loop_lag_p99_ms"] is not None
     assert "rss_slope_net_mb_per_min" in out
+
+
+@pytest.mark.chaos
+def test_soak_trace_summary_attributes_slowest_traces():
+    """tools/soak.py --trace-summary under a seeded fault schedule: the
+    report ships per-trace attribution (slowest retained traces, top spans
+    by self-time) so chaos runs come with built-in "where did the tail go".
+    Subprocess for the same GC-policy reason as the soak smoke test."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    out_raw = subprocess.run(
+        [
+            sys.executable, "-m", "seldon_core_tpu.tools.soak",
+            "--duration", "2", "--users", "4",
+            "--trace-summary", "3",
+            "--faults", "--fault-error-rate", "0.3", "--fault-seed", "1337",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert out_raw.returncode == 0, out_raw.stderr[-1500:]
+    out = json.loads(out_raw.stdout.strip().splitlines()[-1])
+    assert out["faulted"]["faults_injected"] > 0
+    for leg in ("baseline", "faulted"):
+        summary = out[leg]["trace_summary"]
+        assert summary, f"{leg} leg retained no traces"
+        assert len(summary) <= 3
+        for entry in summary:
+            assert entry["trace_id"] and entry["total_ms"] > 0
+            assert 1 <= len(entry["top_spans"]) <= 3
+            for span in entry["top_spans"]:
+                assert span["name"] and span["self_ms"] >= 0
+        # slowest-first ordering
+        totals = [e["total_ms"] for e in summary]
+        assert totals == sorted(totals, reverse=True)

@@ -91,15 +91,18 @@ async def test_untraced_request_has_no_span_overhead():
     assert "trace" not in out.meta.tags
 
 
-async def test_traced_request_bypasses_batcher():
-    """A traced request must not coalesce: its spans describe itself only,
-    and batch-mates never inherit its trace tags."""
+async def test_traced_request_coalesces_and_keeps_its_spans():
+    """Traced requests ride the micro-batch like everyone else (the old
+    bypass skewed exactly the requests being debugged) and still get their
+    own spans back; batch-mates never inherit the trace tags."""
     import asyncio
 
     from seldon_core_tpu.serving.batcher import MicroBatcher
 
     ex = build_executor(_ab_predictor())
-    batcher = MicroBatcher(ex.execute, max_batch=8, batch_timeout_ms=20.0)
+    batcher = MicroBatcher(
+        ex.execute, execute_many=ex.execute_many, max_batch=8, batch_timeout_ms=20.0
+    )
 
     plain = message_from_dict({"data": {"ndarray": [[1.0, 2.0]]}})
     traced = message_from_dict(
@@ -109,4 +112,7 @@ async def test_traced_request_bypasses_batcher():
         batcher.submit(plain), batcher.submit(traced)
     )
     assert "trace" not in out_plain.meta.tags
-    assert out_traced.meta.tags["trace"]
+    spans = out_traced.meta.tags["trace"]
+    assert spans and any(s["method"] == "route" for s in spans)
+    # the two requests coalesced into one batch (no bypass)
+    assert batcher.stat_batches == 1 and batcher.stat_items == 2
