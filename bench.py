@@ -854,7 +854,16 @@ def serving_gen_cpu(
     scheduler's own metrics hooks (what production prometheus exports —
     the per-token SSE transport is covered by the e2e streaming test).
     The scan path has no first-token concept: its request latency IS its
-    time-to-first-visible-token."""
+    time-to-first-visible-token.
+
+    A third leg reruns the scheduler with draft-model speculation
+    (decode_draft_model + decode_spec_k): the decoder pair uses the
+    depth-scaled residual init (resid_scale) under which a seed-shared
+    1-of-4-layer draft is a faithful early-exit approximation of the
+    target — the untrained-weights analogue of a distilled draft pair,
+    giving a realistic high-but-imperfect accept rate. Greedy speculative
+    output is bit-identical to the plain scheduler (the equivalence the
+    tests pin), so its tokens/s is apples-to-apples DELIVERED tokens."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # runs inside the CPU subprocess
@@ -880,7 +889,10 @@ def serving_gen_cpu(
         def decode_inter_token(self, deployment, duration_s):
             self.itls.append(duration_s)
 
-    def _pred(decode_slots: int):
+    spec_k = 4
+    resid_scale = 0.1
+
+    def _pred(decode_slots: int, spec: bool = False):
         tpu = {
             "max_batch": n_slots,
             "batch_buckets": [n_slots],
@@ -892,6 +904,13 @@ def serving_gen_cpu(
         }
         if decode_slots:
             tpu["decode_slots"] = decode_slots
+        if spec:
+            # seed-shared 1-layer truncation of the target (same seed/
+            # vocab/hidden/ffn/max_len => shared embeddings + first layer)
+            tpu["decode_draft_model"] = (
+                f"zoo://draft?hidden=256&ffn=1024&layers=1&resid_scale={resid_scale}"
+            )
+            tpu["decode_spec_k"] = spec_k
         return _graph_predictor(
             {
                 "name": "gpt",
@@ -906,6 +925,7 @@ def serving_gen_cpu(
                     {"name": "layers", "value": "4", "type": "INT"},
                     {"name": "ffn", "value": "1024", "type": "INT"},
                     {"name": "max_len", "value": str(seq + max_new), "type": "INT"},
+                    {"name": "resid_scale", "value": str(resid_scale), "type": "FLOAT"},
                 ],
             },
             tpu,
@@ -923,8 +943,10 @@ def serving_gen_cpu(
         vals = sorted(vals)
         return round(vals[min(len(vals) - 1, int(q / 100 * len(vals)))] * 1e3, 2)
 
-    async def run_scheduler() -> dict:
-        server = PredictorServer(_pred(n_slots), deployment_name="gen")
+    async def run_scheduler(spec: bool = False) -> dict:
+        server = PredictorServer(
+            _pred(n_slots, spec=spec), deployment_name="gen-spec" if spec else "gen"
+        )
         server.warmup()
         rec = _LatencyRecorder()
         server.decode_scheduler._metrics = rec
@@ -949,6 +971,14 @@ def serving_gen_cpu(
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
             "steps": sched.stat_steps,
         }
+        if spec:
+            out["accept_rate"] = round(
+                sched.stat_spec_accepted / max(sched.stat_spec_proposed, 1), 3
+            )
+            out["tokens_per_dispatch"] = round(
+                sched.stat_spec_emitted / max(sched.stat_spec_dispatches, 1), 2
+            )
+            out["spec_dispatches"] = sched.stat_spec_dispatches
         await sched.close()
         if server.batcher is not None:
             await server.batcher.close()
@@ -982,10 +1012,16 @@ def serving_gen_cpu(
         return out
 
     sched = asyncio.run(run_scheduler())
+    spec = asyncio.run(run_scheduler(spec=True))
     scan = asyncio.run(run_scan())
     speedup = (
         round(sched["tokens_per_sec"] / scan["tokens_per_sec"], 2)
         if scan["tokens_per_sec"]
+        else 0.0
+    )
+    spec_speedup = (
+        round(spec["tokens_per_sec"] / sched["tokens_per_sec"], 2)
+        if sched["tokens_per_sec"]
         else 0.0
     )
     return {
@@ -996,10 +1032,15 @@ def serving_gen_cpu(
             "max_new_cap": max_new,
             "budgets": "choice(8,16,32,64; p=.4/.3/.2/.1)",
             "stagger_ms": stagger_ms,
+            "spec_k": spec_k,
+            "resid_scale": resid_scale,
+            "draft": "1-of-4 layers, seed-shared",
         },
         "scheduler": sched,
+        "spec": spec,
         "scan": scan,
         "tokens_per_sec_speedup": speedup,
+        "spec_tokens_per_sec_speedup": spec_speedup,
     }
 
 
@@ -1435,6 +1476,7 @@ def compact_record(full: dict) -> dict:
     if gen:
         gs = gen.get("scheduler") or {}
         gn = gen.get("scan") or {}
+        gp = gen.get("spec") or {}
         c["gen"] = {
             "tok_s": gs.get("tokens_per_sec"),
             "tok_s_scan": gn.get("tokens_per_sec"),
@@ -1447,6 +1489,14 @@ def compact_record(full: dict) -> dict:
             "recompiles": gs.get("recompiles_after_warmup"),
             "slots": (gen.get("scenario") or {}).get("n_slots"),
         }
+        if gp:
+            # speculative leg: delivered tokens/s, accept rate, and the
+            # realized tokens-per-target-dispatch amortization
+            c["gen"]["spec_tok_s"] = gp.get("tokens_per_sec")
+            c["gen"]["accept_rate"] = gp.get("accept_rate")
+            c["gen"]["tok_disp"] = gp.get("tokens_per_dispatch")
+            c["gen"]["spec_speedup"] = gen.get("spec_tokens_per_sec_speedup")
+            c["gen"]["spec_k"] = (gen.get("scenario") or {}).get("spec_k")
     pallas = srv.get("pallas_long_seq") or {}
     if pallas:
         # named scalars only (a verbatim passthrough could silently eat the

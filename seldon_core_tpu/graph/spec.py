@@ -273,6 +273,17 @@ class TpuSpec(_Spec):
     decode_temperature: float = 0.0
     decode_top_k: int = 0
     decode_seed: int = 0
+    # Draft-model speculative decoding for the decode scheduler: a zoo URI
+    # (e.g. "zoo://draft?layers=1") naming a small decoder that shares the
+    # target's vocabulary (vocab/max_len are injected from the target when
+    # the URI doesn't pin them), and the number of tokens it proposes per
+    # target dispatch. BOTH must be set to opt in; greedy output stays
+    # bit-identical to the non-speculative scheduler, temperature > 0 uses
+    # the residual-resampling acceptance rule so the output distribution
+    # is unchanged. Requests may tighten (never widen) k with a
+    # meta.tags["spec_k"] override; spec_k=0 there opts a request out.
+    decode_draft_model: str = ""
+    decode_spec_k: int = 0
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

@@ -74,6 +74,15 @@ class NullMetrics:
     def decode_inter_token(self, deployment: str, duration_s: float) -> None:
         pass
 
+    def decode_spec(
+        self, deployment: str, proposed: int, accepted: int, emitted: int
+    ) -> None:
+        """One speculative verify dispatch: ``proposed`` draft tokens
+        entered acceptance, ``accepted`` survived, ``emitted`` tokens
+        (accepted + one bonus per active slot) were emitted. Accept rate =
+        accepted_total / proposed_total."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -214,6 +223,28 @@ class Metrics(NullMetrics):
             registry=registry,
             buckets=_LATENCY_BUCKETS,
         )
+        # speculative decoding: accept rate = accepted_total/proposed_total;
+        # the per-dispatch histogram is the amortization actually achieved
+        # (how many tokens each target dispatch paid for)
+        self._spec_proposed = Counter(
+            "seldon_tpu_decode_spec_proposed_total",
+            "Draft tokens proposed to speculative verification",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._spec_accepted = Counter(
+            "seldon_tpu_decode_spec_accepted_total",
+            "Draft tokens accepted by speculative verification",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._spec_emitted = Histogram(
+            "seldon_tpu_decode_spec_tokens_per_dispatch",
+            "Tokens emitted per speculative verify dispatch (accepted + bonus)",
+            ["deployment_name"],
+            registry=registry,
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
         # SHADOW router candidate validation: per-shadow-child prediction
         # agreement with the primary (argmax match on classifier outputs)
         self._shadow = Counter(
@@ -306,6 +337,11 @@ class Metrics(NullMetrics):
 
     def decode_inter_token(self, deployment, duration_s):
         self._decode_itl.labels(deployment).observe(duration_s)
+
+    def decode_spec(self, deployment, proposed, accepted, emitted):
+        self._spec_proposed.labels(deployment).inc(proposed)
+        self._spec_accepted.labels(deployment).inc(accepted)
+        self._spec_emitted.labels(deployment).observe(emitted)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

@@ -56,7 +56,17 @@ def init_decoder(
     layers: int = 2,
     ffn: int = 256,
     max_len: int = 128,
+    resid_scale: float = 1.0,
 ) -> dict:
+    """``resid_scale`` scales the residual-branch output projections
+    (attn_out, mlp_out) after drawing them — GPT-2/µP-style depth-scaled
+    init. At 1.0 (default) the params are bit-identical to earlier builds.
+    Scaling happens AFTER the rng draws, so two builds that differ only in
+    ``layers`` share their embedding + leading-layer weights verbatim (the
+    generator stream is positional): a fewer-layers build IS the deeper
+    build's prefix — what makes a seed-shared truncated draft model a
+    faithful early-exit approximation of its target for speculative
+    decoding (serving/decode_scheduler.py)."""
     heads = _heads_for(hidden)
     if hidden % heads:
         raise ValueError(
@@ -65,6 +75,12 @@ def init_decoder(
             "at first trace otherwise"
         )
     rng = np.random.default_rng(seed)
+
+    def _resid(p: dict) -> dict:
+        if resid_scale != 1.0:
+            p["w"] = (p["w"] * np.float32(resid_scale)).astype(np.float32)
+        return p
+
     return {
         "tok_emb": (rng.standard_normal((vocab, hidden)) * 0.02).astype(np.float32),
         "pos_emb": (rng.standard_normal((max_len, hidden)) * 0.02).astype(np.float32),
@@ -72,10 +88,10 @@ def init_decoder(
             {
                 "ln1": _ln_init(hidden),
                 "qkv": _dense(rng, hidden, 3 * hidden),
-                "attn_out": _dense(rng, hidden, hidden),
+                "attn_out": _resid(_dense(rng, hidden, hidden)),
                 "ln2": _ln_init(hidden),
                 "mlp_in": _dense(rng, hidden, ffn),
-                "mlp_out": _dense(rng, ffn, hidden),
+                "mlp_out": _resid(_dense(rng, ffn, hidden)),
             }
             for _ in range(layers)
         ],
@@ -255,6 +271,9 @@ def _embed_one(params, tok: jax.Array, pos) -> jax.Array:
 #   decode_step()  one token for EVERY slot at per-slot positions — batch
 #                  composition changes between steps without shape changes
 #   sample_tokens  per-slot temperature/top-k sampling, greedy at temp<=0
+#   draft_propose / verify_step / speculative_accept
+#                  draft-model speculation: k proposed tokens per slot and
+#                  their one-dispatch verification against the same cache
 # All shapes are static in (n_slots, max_ctx), so one XLA program per
 # function serves every batch composition (zero recompiles after warmup).
 
@@ -313,18 +332,21 @@ def write_prefill(
 
 
 def _layer_step_slots(p, x, cache_k, cache_v, positions, h):
-    """_layer_step generalized to PER-SLOT positions. x: [n, 1, d]; cache
-    [n, h, max_ctx, hd]; positions: [n] (slot i's token sits at
-    positions[i]; cache entries <= positions[i] are valid)."""
+    """_layer_step generalized to PER-SLOT positions and m queries per
+    slot. x: [n, m, d]; cache [n, h, max_ctx, hd]; positions: [n] — slot
+    i's query j sits at positions[i] + j, writes its K/V there, and
+    attends to cache entries <= positions[i] + j (the in-block causal
+    mask: speculative query j sees the keys queries 0..j-1 of the same
+    dispatch just wrote). The serving decode step is the m=1 case."""
     normed = _ln(p["ln1"], x)
     qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = _split_heads(q, h)  # [n, h, 1, hd]
+    q = _split_heads(q, h)  # [n, h, m, hd]
     k = _split_heads(k, h)
     v = _split_heads(v, h)
     # per-slot scatter: vmap over the slot axis turns the per-sequence
     # dynamic_update_slice into one batched scatter — no host loop, no
-    # per-slot programs
+    # per-slot programs; the m-wide K/V block lands at positions[i]..+m-1
     write = jax.vmap(lambda c, kk, pos: lax.dynamic_update_slice(c, kk, (0, pos, 0)))
     cache_k = write(cache_k, k, positions)
     cache_v = write(cache_v, v, positions)
@@ -332,8 +354,10 @@ def _layer_step_slots(p, x, cache_k, cache_v, positions, h):
     s = jnp.einsum(
         "nhqd,nhkd->nhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale
-    valid = jnp.arange(cache_k.shape[2])[None, :] <= positions[:, None]  # [n, max_ctx]
-    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    m = x.shape[1]
+    q_pos = positions[:, None] + jnp.arange(m)[None, :]  # [n, m]
+    valid = jnp.arange(cache_k.shape[2])[None, None, :] <= q_pos[:, :, None]
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
     p_attn = jax.nn.softmax(s, axis=-1)
     ctx = jnp.einsum("nhqk,nhkd->nhqd", p_attn, cache_v.astype(jnp.float32))
     ctx = _merge_heads(ctx.astype(x.dtype))
@@ -373,6 +397,25 @@ def decode_step(
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
+def _transform_logits(logits: jax.Array, temperature, top_k) -> jax.Array:
+    """The per-row sampling transform shared by ``sample_tokens`` and the
+    speculative acceptance rule (both MUST agree, or the draft's proposal
+    distribution q would differ from the one acceptance corrects against):
+    top_k restriction (<= 0 = full vocabulary) then temperature scaling.
+    ``temperature``/``top_k`` broadcast against logits' leading axes;
+    top_k is data, not shape — the cutoff is looked up in the sorted
+    logits, so one compiled program serves every per-request k."""
+    vocab = logits.shape[-1]
+    temperature = jnp.broadcast_to(temperature, logits.shape[:-1])
+    top_k = jnp.broadcast_to(top_k, logits.shape[:-1])
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    thresh = jnp.take_along_axis(sorted_desc, k_idx[..., None], axis=-1)  # [..., 1]
+    restricted = jnp.where(logits < thresh, -jnp.inf, logits)
+    masked = jnp.where(top_k[..., None] > 0, restricted, logits)
+    return masked / jnp.maximum(temperature, 1e-6)[..., None].astype(logits.dtype)
+
+
 def sample_tokens(
     logits: jax.Array,
     temperature: jax.Array,
@@ -382,18 +425,162 @@ def sample_tokens(
     """Per-row sampling: greedy argmax where temperature <= 0 (the serving
     default — what the fused oracle computes), else temperature-scaled
     categorical restricted to the top_k logits (top_k <= 0 means the full
-    vocabulary). top_k is data, not shape: the cutoff is looked up in the
-    sorted logits, so one compiled program serves every per-request k."""
+    vocabulary)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    vocab = logits.shape[-1]
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
-    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [n, 1]
-    restricted = jnp.where(logits < thresh, -jnp.inf, logits)
-    masked = jnp.where(top_k[:, None] > 0, restricted, logits)
-    scaled = masked / jnp.maximum(temperature, 1e-6)[:, None].astype(logits.dtype)
+    scaled = _transform_logits(logits, temperature, top_k)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+# ----------------------------------------------------- speculative decoding
+# Draft-model speculation (Leviathan et al.; Chen et al.): a cheap draft
+# decoder proposes k tokens per slot in ONE dispatch, the target model
+# scores all k+1 queries against the same slot cache in ONE widened
+# dispatch, and the longest valid prefix is accepted — amortizing the
+# per-dispatch cost over several emitted tokens. Speculative cache writes
+# need no rollback copy: positions only advance by the ACCEPTED length, so
+# rejected entries sit beyond every later attention mask until the next
+# consumed token overwrites them.
+
+
+def verify_step(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """decode_step widened to m queries per slot: consume tokens[n, m]
+    (the last emitted token + the m-1 draft proposals) with slot i's query
+    j at positions[i] + j, return (logits[n, m, vocab], cache_k, cache_v)
+    with every query's K/V written at its own position.
+
+    logits[i, j] is the target's next-token distribution AFTER consuming
+    query j — exactly what j sequential decode_step calls would produce
+    for the same prefix, which is what makes greedy acceptance bit-exact.
+    Junk queries (beyond a slot's accept limit, or free slots) may index
+    the position table out of range; the lookup clips and their logits are
+    never used."""
+    heads = _heads(params)
+    m = tokens.shape[1]
+    max_len = params["pos_emb"].shape[0]
+    x = jnp.asarray(params["tok_emb"])[tokens]  # [n, m, d]
+    pidx = jnp.clip(positions[:, None] + jnp.arange(m)[None, :], 0, max_len - 1)
+    x = x + jnp.asarray(params["pos_emb"])[pidx]
+    new_k, new_v = [], []
+    for li, lp in enumerate(params["layers"]):
+        x, ck, cv = _layer_step_slots(lp, x, cache_k[li], cache_v[li], positions, heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    logits = _logits(params, x)  # [n, m, vocab]
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def draft_propose(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """k autoregressive draft steps in ONE program: starting from the last
+    emitted token of every slot, propose (draft_tokens[n, k],
+    draft_logits[n, k, vocab], cache_k, cache_v). ``k`` is static (the
+    deployment's decode_spec_k), so the loop unrolls at trace time and the
+    whole proposal chain costs one dispatch. Greedy rows (temperature <=
+    0) propose argmax; sampled rows propose from the same transformed
+    distribution sample_tokens serves — the q the acceptance rule corrects
+    against."""
+    toks = tokens
+    drafts, logit_steps = [], []
+    for j in range(k):
+        logits, cache_k, cache_v = decode_step(
+            params, cache_k, cache_v, toks, positions + j
+        )
+        toks = sample_tokens(logits, temperature, top_k, jax.random.fold_in(key, j))
+        drafts.append(toks)
+        logit_steps.append(logits)
+    # one extra cache-fill step consuming the LAST proposal at pos+k
+    # (logits discarded): a fully-accepted round advances the slot past
+    # pos+k without ever consuming d_k here, and without this write the
+    # draft cache keeps a permanent zero/stale hole inside every later
+    # attention mask — accept rate silently decays. On partial accepts
+    # the entry is junk-then-overwritten like every speculative write.
+    _, cache_k, cache_v = decode_step(params, cache_k, cache_v, toks, positions + k)
+    return (
+        jnp.stack(drafts, axis=1),
+        jnp.stack(logit_steps, axis=1),
+        cache_k,
+        cache_v,
+    )
+
+
+def speculative_accept(
+    target_logits: jax.Array,
+    draft_tokens: jax.Array,
+    draft_logits: jax.Array,
+    limits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """The acceptance rule: given the widened target logits [n, k+1, V]
+    (position j scored AFTER consuming query j), the draft's proposals
+    [n, k] and raw logits [n, k, V], and per-slot accept limits [n]
+    (0..k — the tighten-only spec_k override and the remaining token
+    budget), return (out_tokens [n, k+1], n_accepted [n]): slot i emits
+    out_tokens[i, :n_accepted[i] + 1].
+
+    Greedy rows (temperature <= 0) accept the longest draft prefix that
+    matches the target's own argmax chain and emit the target argmax at
+    the first mismatch — bit-identical to sequential greedy decoding by
+    induction (query 0 consumed the true last token, so a match at j
+    makes query j+1's context exact too). Sampled rows use standard
+    speculative sampling: accept d_j with probability min(1, p(d_j) /
+    q(d_j)) and resample a TRUE rejection from the residual
+    max(p - q, 0) — the emitted distribution is exactly the target's
+    (Leviathan et al. Thm 1). A limit clamp is NOT a rejection (nothing
+    was proposed there): its bonus token samples p directly."""
+    n, kp1, vocab = target_logits.shape
+    k = kp1 - 1
+    rows = jnp.arange(n)
+    greedy_t = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # [n, k+1]
+    p = jax.nn.softmax(
+        _transform_logits(target_logits, temperature[:, None], top_k[:, None]), axis=-1
+    )
+    greedy_ok = draft_tokens == greedy_t[:, :k]  # [n, k]
+    q = jax.nn.softmax(
+        _transform_logits(draft_logits, temperature[:, None], top_k[:, None]), axis=-1
+    )
+    p_d = jnp.take_along_axis(p[:, :k], draft_tokens[..., None], axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(q, draft_tokens[..., None], axis=-1)[..., 0]
+    key_u, key_b = jax.random.split(key)
+    u = jax.random.uniform(key_u, (n, k))
+    sampled_ok = u * q_d < p_d  # u < p/q without the division
+    ok = jnp.where(temperature[:, None] > 0, sampled_ok, greedy_ok)
+    ok = ok & (jnp.arange(k)[None, :] < limits[:, None])
+    n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    # bonus token at index n_acc
+    p_a = p[rows, n_acc]  # [n, vocab]
+    q_a = jnp.where(
+        (n_acc < k)[:, None], q[rows, jnp.minimum(n_acc, k - 1)], jnp.float32(0.0)
+    )
+    true_reject = n_acc < limits  # a draft existed here and lost
+    residual = jnp.maximum(p_a - q_a, 0.0)
+    rsum = jnp.sum(residual, axis=-1, keepdims=True)
+    residual = jnp.where(rsum > 1e-9, residual / jnp.maximum(rsum, 1e-9), p_a)
+    dist = jnp.where(true_reject[:, None], residual, p_a)
+    bonus_sampled = jax.random.categorical(
+        key_b, jnp.log(dist + 1e-38), axis=-1
+    ).astype(jnp.int32)
+    bonus = jnp.where(temperature > 0, bonus_sampled, greedy_t[rows, n_acc])
+    out = jnp.concatenate([draft_tokens, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    out = out.at[rows, n_acc].set(bonus)
+    return out, n_acc.astype(jnp.int32)
 
 
 def reference_generate(params: dict, ids: np.ndarray, max_new_tokens: int) -> np.ndarray:

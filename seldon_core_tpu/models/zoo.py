@@ -387,6 +387,7 @@ def build_tiny_gpt(
     max_len: int = 128,
     seq: int = 32,
     max_new_tokens: int = 16,
+    resid_scale: float = 1.0,
     **_,
 ) -> ModelSpec:
     """Generative SERVING model (greenfield tier — the reference serves no
@@ -408,7 +409,8 @@ def build_tiny_gpt(
             f"max_len={max_len} — raise max_len"
         )
     params = init_decoder(
-        seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn, max_len=max_len
+        seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn, max_len=max_len,
+        resid_scale=resid_scale,
     )
     return ModelSpec(
         partial(_apply_tiny_gpt, max_new_tokens=max_new_tokens),
@@ -417,6 +419,41 @@ def build_tiny_gpt(
         (),
         int_inputs="ids",
         generative={"seq": seq, "max_new_tokens": max_new_tokens},
+    )
+
+
+@register_model("draft")
+def build_draft(
+    seed: int = 0,
+    vocab: int = 512,
+    hidden: int = 128,
+    layers: int = 1,
+    ffn: int = 256,
+    max_len: int = 128,
+    resid_scale: float = 1.0,
+    seq: int = 32,
+    max_new_tokens: int = 16,
+    **_,
+) -> ModelSpec:
+    """Draft decoder for speculative decoding (tpu.decode_draft_model):
+    the same GPT-style architecture as tiny_gpt, defaulting to ONE layer.
+    Because init_decoder draws weights positionally from a single seeded
+    generator, a draft built with the target's seed/vocab/hidden/ffn/
+    max_len (the decode scheduler injects vocab and max_len from the
+    target automatically) IS the target's embeddings + leading layers
+    verbatim — early-exit self-speculation, the untrained-weights
+    analogue of a distilled draft. With the default depth-unscaled init
+    the truncated layers dominate the logits and the accept rate is low;
+    builds meant as drafts should set resid_scale (on BOTH target and
+    draft) so the shared prefix carries the prediction — see
+    docs/generative.md. Serves standalone like any other zoo entry —
+    it IS tiny_gpt with a 1-layer default, so it delegates (any change to
+    the target's ModelSpec wiring automatically carries to the draft,
+    which the truncation property depends on)."""
+    return build_tiny_gpt(
+        seed=seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
+        max_len=max_len, seq=seq, max_new_tokens=max_new_tokens,
+        resid_scale=resid_scale,
     )
 
 

@@ -16,11 +16,21 @@ scheduling and a vLLM-style slot KV cache into the stack:
   zero recompiles — active-slot masking, never shape changes.
 - Tokens stream to the caller as they are chosen (``on_token``), which is
   what the fast ingress's SSE endpoint forwards to clients.
+- Draft-model speculation (``tpu.decode_draft_model`` + ``decode_spec_k``)
+  amortizes each target dispatch over k proposed tokens: a small draft
+  decoder proposes k tokens per slot in ONE fused dispatch, the target
+  scores all k+1 queries in ONE widened verify dispatch against the same
+  slot cache, and slots advance by their accepted length. Rejected cache
+  writes need no copy-rollback — positions only advance over accepted
+  tokens, so stale entries sit beyond every later attention mask until
+  the next consumed token overwrites them.
 
 Equivalence contract: with greedy sampling the scheduler produces token-
 for-token the fused oracle's output for every sequence, regardless of when
-each sequence was admitted (tests/test_decode_scheduler.py proves this
-against ``generate``).
+each sequence was admitted — speculative or not (acceptance keeps exactly
+the draft prefix matching the target's own argmax chain); temperature > 0
+speculation uses residual resampling so the output distribution is the
+target's (tests/test_decode_scheduler.py proves this against ``generate``).
 
 Compile discipline: every device program is compiled once at ``warmup()``;
 ``compile_counts()`` exposes the jit cache sizes so serving can assert zero
@@ -48,9 +58,12 @@ from seldon_core_tpu import telemetry
 from seldon_core_tpu.models.decoder import (
     decode_step,
     decoder_dims,
+    draft_propose,
     init_slot_cache,
     prefill,
     sample_tokens,
+    speculative_accept,
+    verify_step,
 )
 
 log = logging.getLogger(__name__)
@@ -71,22 +84,16 @@ def _fused_step(params, cache_k, cache_v, tokens, positions, temps, topks, seed,
     return sample_tokens(logits, temps, topks, key), cache_k, cache_v
 
 
-def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed, tick):
-    """One device program per admission WAVE: batched prompt prefill +
-    per-row K/V writes into each row's own slot + first-token sampling,
-    all in one dispatch. ``ids`` is a [k, s] bucket (k from a fixed
-    power-of-two ladder so admissions of any size reuse a warmed program);
-    padding rows have valid=False and rewrite their target slot's CURRENT
+def _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid):
+    """Per-row K/V writes of a prefill wave into each row's own slot.
+    Padding rows have valid=False and rewrite their target slot's CURRENT
     content (a select against a same-shape dynamic_slice — a generalized
     scatter with dropped rows measured ~25 ms/call on the CPU backend
-    where this pair of small slices is sub-ms). The write loop unrolls at
-    trace time (bucket size is static). Batching matters: short-generation
-    workloads are admission-bound, and one wave of 8 prompts costs one
-    prefill program like the fused scan's, not 8 serial ones."""
+    where this pair of small slices is sub-ms). The loop unrolls at trace
+    time (bucket size is static)."""
     from jax import lax
 
-    logits, k_new, v_new = prefill(params, ids)  # [L, k, h, s, hd]
-    for r in range(ids.shape[0]):
+    for r in range(k_new.shape[1]):
         start = (0, slots[r], 0, 0, 0)
         kk = k_new[:, r : r + 1]
         vv = v_new[:, r : r + 1]
@@ -98,25 +105,85 @@ def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed
         cache_v = lax.dynamic_update_slice(
             cache_v, jnp.where(valid[r], vv, cur_v), start
         )
+    return cache_k, cache_v
+
+
+def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed, tick):
+    """One device program per admission WAVE: batched prompt prefill +
+    per-row K/V writes into each row's own slot + first-token sampling,
+    all in one dispatch. ``ids`` is a [k, s] bucket (k from a fixed
+    power-of-two ladder so admissions of any size reuse a warmed
+    program). Batching matters: short-generation workloads are
+    admission-bound, and one wave of 8 prompts costs one prefill program
+    like the fused scan's, not 8 serial ones."""
+    logits, k_new, v_new = prefill(params, ids)  # [L, k, h, s, hd]
+    cache_k, cache_v = _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid)
     key = jax.random.fold_in(jax.random.key(seed), tick)
     toks = sample_tokens(logits, temps, topks, key)
     return toks, cache_k, cache_v
+
+
+def _fused_spec_admit(
+    params, draft_params, cache_k, cache_v, dcache_k, dcache_v,
+    ids, slots, valid, temps, topks, seed, tick,
+):
+    """_fused_admit + the DRAFT model's prefill of the same prompts into
+    its own slot cache, still one dispatch per wave. The first token comes
+    from the TARGET's prefill logits exactly as on the plain path, so
+    admission stays bit-identical with speculation on."""
+    logits, k_new, v_new = prefill(params, ids)
+    cache_k, cache_v = _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid)
+    _, dk_new, dv_new = prefill(draft_params, ids)
+    dcache_k, dcache_v = _scatter_prefill_rows(
+        dcache_k, dcache_v, dk_new, dv_new, slots, valid
+    )
+    key = jax.random.fold_in(jax.random.key(seed), tick)
+    toks = sample_tokens(logits, temps, topks, key)
+    return toks, cache_k, cache_v, dcache_k, dcache_v
+
+
+def _fused_draft(params, cache_k, cache_v, tokens, positions, temps, topks, seed, tick, k):
+    """One device program per speculation round, draft side: k
+    autoregressive draft steps (models/decoder.draft_propose) with the
+    per-tick RNG stream forked from the step programs' (fold_in 1)."""
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 1)
+    return draft_propose(
+        params, cache_k, cache_v, tokens, positions, temps, topks, key, k
+    )
+
+
+def _fused_verify(
+    params, cache_k, cache_v, tokens, drafts, draft_logits,
+    positions, limits, temps, topks, seed, tick,
+):
+    """One device program per speculation round, target side: the widened
+    [n, k+1] verify step + the acceptance rule, reading back only
+    (out_tokens [n, k+1], n_accepted [n]). The draft's proposals and raw
+    logits stay on device between the two dispatches."""
+    queries = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [n, k+1]
+    logits, cache_k, cache_v = verify_step(params, cache_k, cache_v, queries, positions)
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
+    out, acc = speculative_accept(
+        logits, drafts, draft_logits, limits, temps, topks, key
+    )
+    return out, acc, cache_k, cache_v
 
 
 class _Seq:
     """One in-flight generation request."""
 
     __slots__ = (
-        "prompt", "max_new", "temperature", "top_k", "on_token", "future",
+        "prompt", "max_new", "temperature", "top_k", "spec_k", "on_token", "future",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
         "deadline", "trace_ctxs", "gen_spans",
     )
 
-    def __init__(self, prompt, max_new, temperature, top_k, on_token, future):
+    def __init__(self, prompt, max_new, temperature, top_k, spec_k, on_token, future):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.top_k = top_k
+        self.spec_k = spec_k
         self.on_token = on_token
         self.future = future
         self.tokens: list[int] = []
@@ -154,6 +221,8 @@ class DecodeScheduler:
         top_k: int = 0,
         seed: int = 0,
         queue_timeout_s: float = 0.0,
+        draft_params=None,
+        spec_k: int = 0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         dtype=jnp.float32,
@@ -162,6 +231,10 @@ class DecodeScheduler:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if spec_k > 0 and draft_params is None:
+            raise ValueError(
+                f"spec_k={spec_k} needs a draft model (decode_draft_model)"
+            )
         dims = decoder_dims(params)
         self.max_ctx = seq_len + max_new_tokens
         if self.max_ctx > dims["max_len"]:
@@ -188,12 +261,44 @@ class DecodeScheduler:
         # inside the compiled programs (a traced scalar — never a recompile)
         self._tick = 0
 
+        # speculation state: spec_k proposed tokens per round, a draft
+        # slot cache beside the target's, and k columns of cache headroom —
+        # the widened verify writes a fixed [k+1]-wide K/V block at each
+        # slot's position, and a slot one token from its budget must not
+        # have that block clamp backwards over accepted entries
+        self.spec_enabled = draft_params is not None and spec_k >= 1
+        self.spec_k = int(spec_k) if self.spec_enabled else 0
+        self.draft_params = draft_params if self.spec_enabled else None
+        self._cache_ctx = self.max_ctx + self.spec_k
+        if self.spec_enabled:
+            ddims = decoder_dims(draft_params)
+            if ddims["vocab"] != dims["vocab"]:
+                raise ValueError(
+                    f"draft vocab {ddims['vocab']} != target vocab "
+                    f"{dims['vocab']} — speculation needs a shared vocabulary"
+                )
+            if self.max_ctx > ddims["max_len"]:
+                raise ValueError(
+                    f"draft position table ({ddims['max_len']}) is smaller "
+                    f"than seq_len + max_new_tokens ({self.max_ctx})"
+                )
+
         # compiled programs — the caches are donated so slot updates are
         # in-place in HBM. The step program is ONE executable; the admit
         # program is one per wave bucket (power-of-two ladder up to
-        # n_slots), all compiled at warmup()
+        # n_slots), all compiled at warmup(). With speculation on, the
+        # admit ladder runs the spec variant (target + draft prefill) and
+        # two more programs join: the k-step draft loop and the widened
+        # verify. The plain step program stays warm either way — it serves
+        # rounds where every active slot's effective spec_k is 0.
         self._admit_fn = jax.jit(_fused_admit, donate_argnums=(1, 2))
         self._step_fn = jax.jit(_fused_step, donate_argnums=(1, 2))
+        if self.spec_enabled:
+            self._spec_admit_fn = jax.jit(_fused_spec_admit, donate_argnums=(2, 3, 4, 5))
+            self._draft_fn = jax.jit(
+                _fused_draft, donate_argnums=(1, 2), static_argnums=(9,)
+            )
+            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1, 2))
         buckets = []
         b = 1
         while b < n_slots:
@@ -201,7 +306,11 @@ class DecodeScheduler:
             b *= 2
         self.admit_buckets = tuple(buckets) + (n_slots,)
 
-        self._ck, self._cv = init_slot_cache(params, n_slots, self.max_ctx, dtype)
+        self._ck, self._cv = init_slot_cache(params, n_slots, self._cache_ctx, dtype)
+        if self.spec_enabled:
+            self._dck, self._dcv = init_slot_cache(
+                draft_params, n_slots, self._cache_ctx, dtype
+            )
         # on an accelerator, device dispatch + token readback block the
         # calling thread for the device-step latency — run them on the
         # shared compute pool so the serving event loop (ingress, batcher
@@ -224,6 +333,12 @@ class DecodeScheduler:
         self.stat_retired = 0
         self.stat_occupancy_sum = 0.0  # active-slot fraction summed per step
         self.stat_peak_active = 0
+        # speculation attribution: accept rate = accepted/proposed, and
+        # emitted/dispatches is the realized tokens-per-target-dispatch
+        self.stat_spec_dispatches = 0
+        self.stat_spec_proposed = 0
+        self.stat_spec_accepted = 0
+        self.stat_spec_emitted = 0
 
     # ---------------------------------------------------------------- warmup
     def warmup(self) -> None:
@@ -235,20 +350,45 @@ class DecodeScheduler:
         for b in self.admit_buckets:
             # all-padding wave (valid all-False): warming writes nothing
             # into live slots
-            toks, self._ck, self._cv = self._admit_fn(
-                self.params, self._ck, self._cv,
-                np.zeros((b, self.seq_len), np.int32),
-                np.zeros(b, np.int32),
-                np.zeros(b, bool),
-                np.zeros(b, np.float32), np.zeros(b, np.int32),
-                self._seed, np.int32(0),
-            )
+            if self.spec_enabled:
+                toks, self._ck, self._cv, self._dck, self._dcv = self._spec_admit_fn(
+                    self.params, self.draft_params,
+                    self._ck, self._cv, self._dck, self._dcv,
+                    np.zeros((b, self.seq_len), np.int32),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, bool),
+                    np.zeros(b, np.float32), np.zeros(b, np.int32),
+                    self._seed, np.int32(0),
+                )
+            else:
+                toks, self._ck, self._cv = self._admit_fn(
+                    self.params, self._ck, self._cv,
+                    np.zeros((b, self.seq_len), np.int32),
+                    np.zeros(b, np.int32),
+                    np.zeros(b, bool),
+                    np.zeros(b, np.float32), np.zeros(b, np.int32),
+                    self._seed, np.int32(0),
+                )
         many, self._ck, self._cv = self._step_fn(
             self.params, self._ck, self._cv,
             np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
             np.zeros(self.n_slots, np.float32), np.zeros(self.n_slots, np.int32),
             self._seed, np.int32(0),
         )
+        if self.spec_enabled:
+            # the speculative round pair: draft K/V junk lands in free
+            # slots at positions the next admission's prefill overwrites
+            zi = np.zeros(self.n_slots, np.int32)
+            zf = np.zeros(self.n_slots, np.float32)
+            drafts, dlogits, self._dck, self._dcv = self._draft_fn(
+                self.draft_params, self._dck, self._dcv,
+                zi, zi, zf, zi, self._seed, np.int32(0), self.spec_k,
+            )
+            out_t, acc, self._ck, self._cv = self._verify_fn(
+                self.params, self._ck, self._cv,
+                zi, drafts, dlogits, zi, zi, zf, zi, self._seed, np.int32(0),
+            )
+            jax.block_until_ready(out_t)
         jax.block_until_ready(many)
         # record the compile cost on the existing compile metric (bucket
         # label = slot count)
@@ -260,10 +400,15 @@ class DecodeScheduler:
         UNDERLYING function, so counts accumulate across scheduler
         instances in one process (multi-tenant) — the zero-recompile
         assertion is therefore relative: recompiles_since_warmup()."""
-        return {
+        counts = {
             "admit": self._admit_fn._cache_size(),
             "step": self._step_fn._cache_size(),
         }
+        if self.spec_enabled:
+            counts["spec_admit"] = self._spec_admit_fn._cache_size()
+            counts["draft"] = self._draft_fn._cache_size()
+            counts["verify"] = self._verify_fn._cache_size()
+        return counts
 
     def recompiles_since_warmup(self) -> int:
         """Number of XLA compiles since warmup() — the serving invariant is
@@ -287,12 +432,15 @@ class DecodeScheduler:
         max_new_tokens: int | None = None,
         temperature: float | None = None,
         top_k: int | None = None,
+        spec_k: int | None = None,
         on_token: OnToken | None = None,
     ) -> np.ndarray:
         """Generate for one prompt [seq_len]; resolves with the full int32
         sequence (prompt echoed, generated ids appended). ``on_token`` is
         called inline from the decode loop per generated token — keep it
-        cheap (the streaming endpoint pushes into an asyncio.Queue)."""
+        cheap (the streaming endpoint pushes into an asyncio.Queue).
+        ``spec_k`` tightens (never widens) the deployment's speculative
+        proposal length; 0 opts this request out of speculation."""
         if self._closed:
             raise APIException(
                 ErrorCode.ENGINE_MICROSERVICE_ERROR, "decode scheduler closed"
@@ -308,8 +456,9 @@ class DecodeScheduler:
         max_new = max(1, min(max_new, self.max_new_tokens))
         temp = float(temperature) if temperature is not None else self.default_temperature
         k = int(top_k) if top_k is not None else self.default_top_k
+        sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
-        seq = _Seq(prompt, max_new, temp, k, on_token, loop.create_future())
+        seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
         if self.queue_timeout_s > 0:
             seq.deadline = seq.t_enqueued + self.queue_timeout_s
         self._waiting.append(seq)
@@ -414,14 +563,27 @@ class DecodeScheduler:
             tick = self._next_tick()
             t_wave0 = telemetry.now_ns()
 
-            def _do_admit():
-                toks, ck, cv = self._admit_fn(
-                    self.params, self._ck, self._cv, ids, slots, valid, temps,
-                    topks, self._seed, tick,
-                )
-                return np.asarray(toks), ck, cv
+            if self.spec_enabled:
+                def _do_admit():
+                    toks, ck, cv, dck, dcv = self._spec_admit_fn(
+                        self.params, self.draft_params,
+                        self._ck, self._cv, self._dck, self._dcv,
+                        ids, slots, valid, temps, topks, self._seed, tick,
+                    )
+                    return np.asarray(toks), ck, cv, dck, dcv
 
-            toks, self._ck, self._cv = await self._device_call(_do_admit)
+                toks, self._ck, self._cv, self._dck, self._dcv = (
+                    await self._device_call(_do_admit)
+                )
+            else:
+                def _do_admit():
+                    toks, ck, cv = self._admit_fn(
+                        self.params, self._ck, self._cv, ids, slots, valid, temps,
+                        topks, self._seed, tick,
+                    )
+                    return np.asarray(toks), ck, cv
+
+                toks, self._ck, self._cv = await self._device_call(_do_admit)
             t_wave1 = telemetry.now_ns()
             for r, (seq, slot) in enumerate(zip(wave, taken)):
                 seq.slot = slot
@@ -469,6 +631,68 @@ class DecodeScheduler:
                     )
         self.stat_peak_active = max(self.stat_peak_active, self.active)
 
+    async def _spec_round(self, toks, pos, temps, topks, limits, tick) -> None:
+        """One speculative round: ONE draft dispatch proposes spec_k
+        tokens per slot, ONE widened target dispatch verifies them, and
+        every slot advances by its accepted length + the bonus token
+        (limit-0 slots — per-request opt-outs, budget edges, free slots —
+        ride the same round and get exactly their plain-step token).
+        Emission, EOS/budget retirement, and per-token streaming run
+        token-by-token exactly as on the plain path, so mid-burst
+        retirement and SSE keep working."""
+
+        def _do_spec():
+            drafts, dlogits, dck, dcv = self._draft_fn(
+                self.draft_params, self._dck, self._dcv, toks, pos, temps,
+                topks, self._seed, tick, self.spec_k,
+            )
+            out_t, acc, ck, cv = self._verify_fn(
+                self.params, self._ck, self._cv, toks, drafts, dlogits, pos,
+                limits, temps, topks, self._seed, tick,
+            )
+            return np.asarray(out_t), np.asarray(acc), ck, cv, dck, dcv
+
+        t0 = telemetry.now_ns()
+        out_t, acc, self._ck, self._cv, self._dck, self._dcv = (
+            await self._device_call(_do_spec)
+        )
+        t1 = telemetry.now_ns()
+        self.stat_steps += 1
+        self.stat_spec_dispatches += 1
+        active = self.active
+        self.stat_occupancy_sum += active / self.n_slots
+        self._metrics.decode_step(self._deployment, active, self.n_slots)
+        proposed = int(limits.sum())
+        accepted = int(acc.sum())  # limit-0 and free slots contribute 0
+        emitted = 0
+        for i, seq in enumerate(list(self._slots)):
+            if seq is None:
+                continue
+            # one decode.verify span per round on the sequence's own
+            # trace(s), the accept count as an event — per-round, not
+            # per-token, so a k=4 generation adds ~len/5 spans
+            for c in seq.trace_ctxs:
+                vs = c.buf.begin(
+                    "decode.verify",
+                    c.span.span_id,
+                    {"slot": i, "proposed": int(limits[i])},
+                    start_ns=t0,
+                )
+                vs.add_event("accept", {"accepted": int(acc[i])})
+                vs.end(t1)
+            for j in range(int(acc[i]) + 1):
+                tok = int(out_t[i, j])
+                seq.pos += 1
+                self._emit(seq, tok)
+                emitted += 1
+                if self._finished(seq, tok):
+                    self._retire(i)
+                    break
+        self.stat_spec_proposed += proposed
+        self.stat_spec_accepted += accepted
+        self.stat_spec_emitted += emitted
+        self._metrics.decode_spec(self._deployment, proposed, accepted, emitted)
+
     async def _run(self) -> None:
         try:
             while True:
@@ -499,7 +723,25 @@ class DecodeScheduler:
                     topks[i] = seq.top_k
                 if self.active == 0:
                     continue
+                limits = None
+                if self.spec_enabled:
+                    limits = np.zeros(self.n_slots, np.int32)
+                    for i, seq in enumerate(self._slots):
+                        if seq is None:
+                            continue
+                        # propose at most what the remaining budget can
+                        # still emit beyond the bonus token (a round emits
+                        # accepted + 1 tokens) — a slot one token from its
+                        # budget rides the round with limit 0
+                        limits[i] = max(
+                            0, min(seq.spec_k, seq.max_new - len(seq.tokens) - 1)
+                        )
                 tick = self._next_tick()
+
+                if limits is not None and limits.any():
+                    await self._spec_round(toks, pos, temps, topks, limits, tick)
+                    await asyncio.sleep(0)
+                    continue
 
                 def _do_step():
                     nxt, ck, cv = self._step_fn(
@@ -547,8 +789,12 @@ class DecodeScheduler:
             # admission with 'array has been deleted'. Reallocate so the
             # scheduler recovers (slot state above is already reset).
             self._ck, self._cv = init_slot_cache(
-                self.params, self.n_slots, self.max_ctx, self._dtype
+                self.params, self.n_slots, self._cache_ctx, self._dtype
             )
+            if self.spec_enabled:
+                self._dck, self._dcv = init_slot_cache(
+                    self.draft_params, self.n_slots, self._cache_ctx, self._dtype
+                )
 
     async def close(self) -> None:
         """Drain: stop accepting NEW work, finish everything in flight AND
@@ -567,13 +813,16 @@ class DecodeScheduler:
     def request_params_from_meta(self, meta: Meta) -> dict:
         """Per-request sampling overrides ride meta.tags (the JSON envelope's
         ``meta.tags`` — no schema change for existing clients): temperature,
-        top_k, max_new_tokens. Values clamp to the deployment's caps."""
+        top_k, max_new_tokens, spec_k. Values clamp to the deployment's caps
+        (spec_k is tighten-only: it can reduce or disable speculation for a
+        request, never widen past decode_spec_k)."""
         tags = meta.tags or {}
         out: dict = {}
         for key, cast in (
             ("max_new_tokens", int),
             ("temperature", float),
             ("top_k", int),
+            ("spec_k", int),
         ):
             if key in tags:
                 try:
@@ -659,6 +908,37 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
             "back to the fused whole-batch path"
         )
         return None
+    draft_uri = str(getattr(tpu_spec, "decode_draft_model", "") or "")
+    spec_k = int(getattr(tpu_spec, "decode_spec_k", 0))
+    draft_params = None
+    if draft_uri and spec_k > 0:
+        from seldon_core_tpu.models.zoo import _parse_zoo_uri, get_model
+
+        if draft_uri.startswith("zoo://"):
+            dname, dkw = _parse_zoo_uri(draft_uri)
+        else:
+            dname, dkw = draft_uri, {}
+        # the draft must share the target's vocabulary and position-table
+        # reach — inject both from the target unless the URI pins them
+        dims = decoder_dims(runtime.params)
+        dkw = {"vocab": dims["vocab"], "max_len": dims["max_len"], **dkw}
+        dspec = get_model(dname, **dkw)
+        if not (isinstance(dspec.params, dict) and "tok_emb" in dspec.params):
+            log.warning(
+                "decode_draft_model=%r is not a decoder (models/decoder.py "
+                "layout) — speculation disabled",
+                draft_uri,
+            )
+            spec_k = 0
+        else:
+            draft_params = jax.device_put(dspec.params)
+    elif draft_uri or spec_k > 0:
+        log.warning(
+            "speculative decoding needs BOTH decode_draft_model and "
+            "decode_spec_k > 0 (got %r / %s) — speculation disabled",
+            draft_uri, spec_k,
+        )
+        spec_k = 0
     return DecodeScheduler(
         runtime.params,
         seq_len=int(gen["seq"]),
@@ -669,6 +949,8 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         top_k=int(getattr(tpu_spec, "decode_top_k", 0)),
         seed=int(getattr(tpu_spec, "decode_seed", 0)),
         queue_timeout_s=float(getattr(tpu_spec, "queue_timeout_ms", 0.0)) / 1000.0,
+        draft_params=draft_params,
+        spec_k=spec_k if draft_params is not None else 0,
         metrics=metrics,
         deployment_name=deployment_name,
         dtype=runtime.dtype,

@@ -57,6 +57,7 @@ async def soak(
     batch: int = 4,
     fault_spec=None,
     trace_summary: int = 0,
+    spec_k: int = 0,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -71,6 +72,34 @@ async def soak(
         "implementation": "JAX_MODEL",
         "parameters": [{"name": "model", "value": model, "type": "STRING"}],
     }
+    predictor_extra: dict = {}
+    if spec_k > 0:
+        if model != "iris_mlp":
+            import sys as _sys
+
+            print(
+                f"soak: --spec-k overrides --model (speculative soaks run "
+                f"tiny_gpt, ignoring {model!r})",
+                file=_sys.stderr,
+            )
+        # speculative-decoding soak: a generative deployment (prompt bucket
+        # = --features) served by the decode scheduler with a seed-shared
+        # 1-layer draft, so sustained load drives the draft + widened
+        # verify programs instead of the iris classifier. The load
+        # generator's float payloads cast to token id 0 through the ids
+        # wire policy — a fixed prompt is fine, the soak's signals are RSS
+        # slope / loop lag / error budget, not model quality.
+        graph["parameters"] = [
+            {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+            {"name": "seq", "value": str(features), "type": "INT"},
+            {"name": "max_new_tokens", "value": "16", "type": "INT"},
+            {"name": "resid_scale", "value": "0.1", "type": "FLOAT"},
+        ]
+        predictor_extra["tpu"] = {
+            "decode_slots": 4,
+            "decode_spec_k": spec_k,
+            "decode_draft_model": "zoo://draft?layers=1&resid_scale=0.1",
+        }
     if fault_spec is not None:
         # the faulted leg exercises the resilience layer end-to-end: the
         # model node gets a retry policy (absorbing injected transport
@@ -81,7 +110,12 @@ async def soak(
             {"name": "retry_seed", "value": str(fault_spec.seed), "type": "INT"},
         ]
     dep = SeldonDeployment.from_dict(
-        {"spec": {"name": "soak", "predictors": [{"name": "p", "graph": graph}]}}
+        {
+            "spec": {
+                "name": "soak",
+                "predictors": [{"name": "p", "graph": graph, **predictor_extra}],
+            }
+        }
     )
     dep = default_deployment(dep)
     validate_deployment(dep)
@@ -143,6 +177,8 @@ async def soak(
         await sampler_task
         fast.close()
         await fast.wait_closed()
+        if getattr(server, "decode_scheduler", None) is not None:
+            await server.decode_scheduler.close()
         if server.batcher is not None:
             await server.batcher.close()
 
@@ -177,10 +213,24 @@ async def soak(
         from seldon_core_tpu.telemetry import get_tracer
 
         traces = get_tracer().store.slowest_summaries(n=trace_summary)
+    spec_stats = None
+    sched = getattr(server, "decode_scheduler", None)
+    if spec_k > 0 and sched is not None:
+        spec_stats = {
+            "spec_k": spec_k,
+            "spec_dispatches": sched.stat_spec_dispatches,
+            "accept_rate": round(
+                sched.stat_spec_accepted / max(sched.stat_spec_proposed, 1), 3
+            ),
+            "tokens_per_dispatch": round(
+                sched.stat_spec_emitted / max(sched.stat_spec_dispatches, 1), 2
+            ),
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
     return {
         "duration_s": duration_s,
         "users": users,
-        "model": model,
+        "model": "tiny_gpt" if spec_k > 0 else model,
         "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
         "p99_ms": s["p99_ms"],
         "errors": s["errors"],
@@ -205,6 +255,7 @@ async def soak(
         ) if lag_sorted else None,
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
+        **({"spec": spec_stats} if spec_stats is not None else {}),
     }
 
 
@@ -232,6 +283,14 @@ def main(argv=None) -> None:
         help="after the run, include the slowest-N retained traces (id, "
         "total ms, top-3 spans by self-time) in the report (default N=5)",
     )
+    ap.add_argument(
+        "--spec-k",
+        type=int,
+        default=0,
+        help="run the soak against a generative deployment with draft-model "
+        "speculative decoding (k proposals per dispatch); the report gains "
+        "accept_rate / tokens_per_dispatch under 'spec'",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -253,6 +312,7 @@ def main(argv=None) -> None:
                 batch=args.batch,
                 fault_spec=fault_spec,
                 trace_summary=args.trace_summary,
+                spec_k=args.spec_k,
             )
         )
 

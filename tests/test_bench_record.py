@@ -113,6 +113,9 @@ def worst_case_full_record() -> dict:
             "max_new_cap": 64,
             "budgets": "choice(8,16,32,64; p=.4/.3/.2/.1)",
             "stagger_ms": 2.0,
+            "spec_k": 4,
+            "resid_scale": 0.1,
+            "draft": "1-of-4 layers, seed-shared",
         },
         "scheduler": {
             "tokens_per_sec": 1690.42,
@@ -123,12 +126,25 @@ def worst_case_full_record() -> dict:
             "recompiles_after_warmup": 0,
             "steps": 1234,
         },
+        "spec": {
+            "tokens_per_sec": 2890.13,
+            "ttft_p50_ms": 601.22,
+            "ttft_p99_ms": 1103.44,
+            "inter_token_p99_ms": 31.02,
+            "slot_occupancy_mean": 0.881,
+            "recompiles_after_warmup": 0,
+            "steps": 412,
+            "accept_rate": 0.941,
+            "tokens_per_dispatch": 4.31,
+            "spec_dispatches": 410,
+        },
         "scan": {
             "tokens_per_sec": 261.63,
             "ttft_p50_ms": 3279.11,
             "ttft_p99_ms": 4411.92,
         },
         "tokens_per_sec_speedup": 2.64,
+        "spec_tokens_per_sec_speedup": 1.71,
     }
     return {
         "metric": "resnet50_predictions_per_sec",
@@ -210,7 +226,8 @@ def test_compact_record_carries_every_headline():
     assert c["mt"]["homo_p99s"] == [88.16, 88.16, 88.16]
     assert c["pallas"]["speedup"] == 2.08
     assert c["pallas"]["causal_speedup"] == 2.51
-    # generative tier: scheduler-vs-scan tokens/s + latency contracts
+    # generative tier: scheduler-vs-scan tokens/s + latency contracts +
+    # the speculative leg (delivered tokens/s, accept rate, amortization)
     assert c["gen"] == {
         "tok_s": 1690.42,
         "tok_s_scan": 261.63,
@@ -222,6 +239,11 @@ def test_compact_record_carries_every_headline():
         "occ": 0.893,
         "recompiles": 0,
         "slots": 8,
+        "spec_tok_s": 2890.13,
+        "accept_rate": 0.941,
+        "tok_disp": 4.31,
+        "spec_speedup": 1.71,
+        "spec_k": 4,
     }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77

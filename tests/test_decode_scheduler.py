@@ -412,6 +412,231 @@ async def test_streaming_e2e_through_fast_ingress():
             await server.batcher.close()
 
 
+# ------------------------------------------------------------ speculation
+
+
+def _draft_pair():
+    """(target, draft) with the depth-scaled residual init: the draft is
+    the target's seed-shared 1-of-2-layer truncation (init_decoder draws
+    positionally, so same seed/vocab/hidden/ffn/max_len + fewer layers =
+    the deeper build's prefix) — a high-accept pair."""
+    from seldon_core_tpu.models.decoder import init_decoder
+
+    tgt = init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=2, ffn=128, max_len=64, resid_scale=0.1
+    )
+    drf = init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=1, ffn=128, max_len=64, resid_scale=0.1
+    )
+    return tgt, drf
+
+
+def _unrelated_draft():
+    """A draft with no relation to the target — accept rate ~0, so every
+    round exercises the reject + bonus path."""
+    return init_decoder(seed=99, vocab=VOCAB, hidden=64, layers=1, ffn=128, max_len=64)
+
+
+def _spec_scheduler(params, draft, n_slots=2, spec_k=3, **kw) -> DecodeScheduler:
+    s = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=n_slots,
+        draft_params=draft, spec_k=spec_k, **kw
+    )
+    s.warmup()
+    return s
+
+
+@pytest.mark.parametrize("pair", ["high_accept", "low_accept"])
+async def test_speculative_greedy_bit_identical_midstream(pair):
+    """The speculative acceptance invariant: greedy output is bit-identical
+    to the non-speculative scheduler, the fused scan oracle, AND the
+    cache-less reference — for ANY draft (acceptance only keeps proposals
+    matching the target's own argmax chain), under mid-stream admission
+    and retirement."""
+    from seldon_core_tpu.models.decoder import reference_generate
+
+    if pair == "high_accept":
+        params, draft = _draft_pair()
+    else:
+        params, draft = _params(), _unrelated_draft()
+    ids = _prompts(4, seed=21)
+    oracle = _oracle(params, ids)
+    np.testing.assert_array_equal(oracle, reference_generate(params, ids, MAX_NEW))
+    plain = _scheduler(params, n_slots=2)
+    plain_outs = await asyncio.gather(*(plain.submit(row) for row in ids))
+    await plain.close()
+
+    sched = _spec_scheduler(params, draft, n_slots=2, spec_k=3)
+    started = asyncio.Event()
+    t_a = asyncio.ensure_future(
+        sched.submit(ids[0], on_token=lambda t, i: i >= 2 and started.set())
+    )
+    t_b = asyncio.ensure_future(sched.submit(ids[1]))
+    await started.wait()  # a (and likely b) mid-generation
+    outs = [await t_a, await t_b] + list(
+        await asyncio.gather(*(sched.submit(row) for row in ids[2:]))
+    )
+    for row, plain_row, out in zip(oracle, plain_outs, outs):
+        np.testing.assert_array_equal(plain_row, row)
+        np.testing.assert_array_equal(out, row)
+    assert sched.stat_spec_dispatches > 0
+    if pair == "high_accept":
+        # the seed-shared truncation genuinely speculates: most proposals
+        # survive and dispatches amortize over multiple tokens
+        assert sched.stat_spec_accepted / sched.stat_spec_proposed > 0.5
+        assert sched.stat_spec_emitted / sched.stat_spec_dispatches > 1.5
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_speculative_sampled_top_k1_matches_oracle():
+    """temperature > 0 with top_k=1 drives the SAMPLED acceptance branch
+    (p/q ratios, residual resampling) through distributions that are
+    exactly one-hot — so the emitted tokens must still equal the greedy
+    oracle token-for-token: a deterministic proof the residual-resampling
+    plumbing preserves the target distribution."""
+    params, draft = _draft_pair()
+    ids = _prompts(3, seed=5)
+    oracle = _oracle(params, ids)
+    sched = _spec_scheduler(params, draft, n_slots=2, spec_k=3)
+    outs = await asyncio.gather(
+        *(sched.submit(row, temperature=5.0, top_k=1) for row in ids)
+    )
+    for row, out in zip(oracle, outs):
+        np.testing.assert_array_equal(out, row)
+    assert sched.stat_spec_dispatches > 0
+    await sched.close()
+
+
+async def test_spec_k0_fallback_and_tighten_only():
+    """Per-request spec_k=0 opts out: an all-opted-out workload runs the
+    plain step program (no draft dispatches), and spec_k clamps tighten-
+    only. Mixed rounds (one slot speculating, one opted out) still match
+    the oracle."""
+    params, draft = _draft_pair()
+    ids = _prompts(4, seed=13)
+    oracle = _oracle(params, ids)
+    sched = _spec_scheduler(params, draft, n_slots=2, spec_k=3)
+    outs = await asyncio.gather(*(sched.submit(row, spec_k=0) for row in ids[:2]))
+    for row, out in zip(oracle[:2], outs):
+        np.testing.assert_array_equal(out, row)
+    assert sched.stat_spec_dispatches == 0  # plain program served everything
+    # widen attempts clamp to the deployment k; mixed opt-outs share rounds
+    outs = await asyncio.gather(
+        sched.submit(ids[2], spec_k=100), sched.submit(ids[3], spec_k=0)
+    )
+    np.testing.assert_array_equal(outs[0], oracle[2])
+    np.testing.assert_array_equal(outs[1], oracle[3])
+    assert sched.stat_spec_dispatches > 0
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_spec_zero_recompiles_mixed_workload():
+    """The acceptance criterion: a mixed speculative/plain workload —
+    varying budgets, sampling params, and per-request spec_k including 0 —
+    compiles nothing after warmup, and compile_counts() reports the draft
+    and verify programs."""
+    params, draft = _draft_pair()
+    ids = _prompts(6, seed=2)
+    sched = _spec_scheduler(params, draft, n_slots=3, spec_k=3)
+    counts = sched.compile_counts()
+    for prog in ("spec_admit", "draft", "verify", "step"):
+        assert counts.get(prog, 0) >= 1, counts
+    assert sched.recompiles_since_warmup() == 0
+    outs = await asyncio.gather(
+        *(
+            sched.submit(
+                row,
+                max_new_tokens=3 + i,
+                temperature=0.5 * (i % 2),
+                top_k=i,
+                spec_k=i % 3,
+            )
+            for i, row in enumerate(ids)
+        )
+    )
+    assert all(len(o) > SEQ for o in outs)
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_spec_eos_retirement_and_metrics_emission():
+    """EOS retirement mid-round (an accepted token may BE the EOS — the
+    slot frees there, later accepted tokens are dropped) plus the accept
+    metrics contract: decode_spec() fires per verify dispatch and its
+    counters reconcile with the emitted tokens."""
+    from seldon_core_tpu.metrics import NullMetrics
+
+    class _Rec(NullMetrics):
+        def __init__(self):
+            self.calls = []
+
+        def decode_spec(self, deployment, proposed, accepted, emitted):
+            self.calls.append((proposed, accepted, emitted))
+
+    params, draft = _draft_pair()
+    ids = _prompts(1, seed=4)
+    oracle = _oracle(params, ids)[0]
+    eos = int(oracle[SEQ + 2])  # retire on the 3rd generated token
+    rec = _Rec()
+    sched = _spec_scheduler(params, draft, n_slots=2, spec_k=3, eos_id=eos, metrics=rec)
+    out = await sched.submit(ids[0])
+    cut = SEQ + list(oracle[SEQ:]).index(eos) + 1
+    np.testing.assert_array_equal(out, oracle[:cut])
+    assert sched.active == 0
+    assert rec.calls, "decode_spec never fired"
+    assert sum(c[2] for c in rec.calls) == sched.stat_spec_emitted
+    assert sum(c[0] for c in rec.calls) == sched.stat_spec_proposed >= sum(
+        c[1] for c in rec.calls
+    )
+    # emitted = generated minus the admission token (prefill emits token 0)
+    assert sched.stat_spec_emitted == len(out) - SEQ - 1
+    await sched.close()
+
+
+async def test_spec_requires_draft_and_serving_wiring():
+    """Ctor fail-fast without a draft; the full serving path (TpuSpec
+    decode_draft_model/decode_spec_k -> scheduler_for_executor) builds a
+    speculating scheduler whose buffered response matches the fused zoo
+    apply, with the spec_k meta.tags override parsed tighten-only."""
+    with pytest.raises(ValueError, match="draft"):
+        DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, spec_k=2)
+
+    from seldon_core_tpu.core.message import Meta, SeldonMessage
+    from seldon_core_tpu.models.zoo import get_model
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    server = PredictorServer(
+        _predictor(
+            2,
+            decode_spec_k=3,
+            decode_draft_model="zoo://draft?hidden=64&ffn=128&layers=1",
+        ),
+        deployment_name="d",
+    )
+    sched = server.decode_scheduler
+    assert sched is not None and sched.spec_enabled and sched.spec_k == 3
+    # vocab/max_len injected from the target
+    assert sched.draft_params["tok_emb"].shape[0] == VOCAB
+    server.warmup()
+    try:
+        ids = _prompts(2, seed=7)
+        out = await server.service.predict(
+            SeldonMessage.from_array(ids, meta=Meta(tags={"spec_k": 100}))
+        )
+        ms = get_model("tiny_gpt", seq=SEQ, max_new_tokens=6, vocab=VOCAB)
+        oracle = np.asarray(ms.apply_fn(ms.params, jnp.asarray(ids)))
+        np.testing.assert_array_equal(np.asarray(out.array).astype(np.int32), oracle)
+        assert sched.recompiles_since_warmup() == 0
+        # tighten-only: the 100 clamped to the deployment's 3
+        assert sched.request_params_from_meta(Meta(tags={"spec_k": 100})) == {
+            "spec_k": 100
+        }  # parsed raw here; submit() clamps
+    finally:
+        await sched.close()
+
+
 @pytest.mark.slow
 async def test_staggered_arrival_soak():
     """Soak-adjacent: dozens of staggered arrivals with mixed budgets and

@@ -118,3 +118,109 @@ def test_tiny_gpt_overflowing_config_rejected_at_build():
 
     with pytest.raises(ValueError, match="max_len"):
         get_model("tiny_gpt", seq=120, max_new_tokens=32, max_len=128)
+
+
+# ----------------------------------------------------- speculative blocks
+
+
+def test_verify_step_matches_sequential_decode_steps():
+    """The widened verify program is the k+1-query generalization of
+    decode_step: given the same consumed tokens, its per-position logits
+    (and argmax chain) equal k+1 sequential single-token steps over the
+    same slot cache."""
+    from seldon_core_tpu.models.decoder import (
+        decode_step, init_slot_cache, prefill, verify_step, write_prefill,
+    )
+
+    params = init_decoder(seed=3, vocab=256, hidden=64, layers=2, ffn=128, max_len=64)
+    ids = _prompt(b=1, s=8)
+    slot, n_slots, k = 1, 3, 3
+    ck, cv = init_slot_cache(params, n_slots, 32)
+    logits, kk, vv = prefill(params, jnp.asarray(ids))
+    ck, cv = write_prefill(ck, cv, kk, vv, slot)
+    first = int(np.argmax(np.asarray(logits)[0]))
+    # sequential chain: consume first + its greedy successors one at a time
+    toks = np.zeros(n_slots, np.int32)
+    pos = np.zeros(n_slots, np.int32)
+    chain = [first]
+    seq_logits = []
+    sck, scv = ck, cv
+    for j in range(k + 1):
+        toks[slot] = chain[-1]
+        pos[slot] = 8 + j
+        lg, sck, scv = decode_step(params, sck, scv, jnp.asarray(toks), jnp.asarray(pos))
+        seq_logits.append(np.asarray(lg)[slot])
+        chain.append(int(np.argmax(np.asarray(lg)[slot])))
+    # widened: same k+1 consumed tokens in ONE call
+    queries = np.zeros((n_slots, k + 1), np.int32)
+    queries[slot] = chain[: k + 1]
+    positions = np.zeros(n_slots, np.int32)
+    positions[slot] = 8
+    wlg, wck, wcv = verify_step(params, ck, cv, jnp.asarray(queries), jnp.asarray(positions))
+    wlg = np.asarray(wlg)[slot]
+    np.testing.assert_allclose(wlg, np.stack(seq_logits), rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.argmax(wlg, axis=-1), chain[1:])
+    # the caches agree wherever the sequential path wrote (positions 0..8+k)
+    np.testing.assert_allclose(
+        np.asarray(wck)[:, slot, :, : 8 + k + 1],
+        np.asarray(sck)[:, slot, :, : 8 + k + 1],
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_speculative_accept_greedy_unit():
+    """Acceptance on hand-built one-hot logits: longest matching prefix,
+    bonus at the first mismatch, tighten-only limit clamp, and the
+    all-accepted bonus from the k+1th position."""
+    from seldon_core_tpu.models.decoder import speculative_accept
+
+    n, k, vocab = 4, 3, 16
+    # target greedy chain per row: tokens 1, 2, 3, 4
+    tl = np.full((n, k + 1, vocab), -10.0, np.float32)
+    for j in range(k + 1):
+        tl[:, j, j + 1] = 10.0
+    drafts = np.array(
+        [
+            [1, 2, 3],  # all match -> accept 3, bonus = chain[3] = 4
+            [1, 9, 3],  # mismatch at 1 -> accept 1, bonus = chain[1] = 2
+            [7, 2, 3],  # mismatch at 0 -> accept 0, bonus = chain[0] = 1
+            [1, 2, 3],  # limit 1 clamps a full match -> accept 1, bonus 2
+        ],
+        np.int32,
+    )
+    dl = np.zeros((n, k, vocab), np.float32)
+    limits = np.array([3, 3, 3, 1], np.int32)
+    out, acc = speculative_accept(
+        jnp.asarray(tl), jnp.asarray(drafts), jnp.asarray(dl),
+        jnp.asarray(limits), jnp.zeros(n), jnp.zeros(n, jnp.int32),
+        jax.random.key(0),
+    )
+    out, acc = np.asarray(out), np.asarray(acc)
+    np.testing.assert_array_equal(acc, [3, 1, 0, 1])
+    emitted = [list(out[i, : acc[i] + 1]) for i in range(n)]
+    assert emitted == [[1, 2, 3, 4], [1, 2], [1], [1, 2]]
+
+
+def test_resid_scale_shares_seed_prefix():
+    """resid_scale scales only the residual output projections, after the
+    rng draws — so a fewer-layers build is still the deeper build's
+    prefix (embeddings + leading layers bitwise equal), which is what
+    makes zoo://draft an early-exit truncation of its target."""
+    tgt = init_decoder(seed=5, vocab=128, hidden=64, layers=3, ffn=128,
+                       max_len=32, resid_scale=0.1)
+    drf = init_decoder(seed=5, vocab=128, hidden=64, layers=1, ffn=128,
+                       max_len=32, resid_scale=0.1)
+    np.testing.assert_array_equal(tgt["tok_emb"], drf["tok_emb"])
+    np.testing.assert_array_equal(tgt["pos_emb"], drf["pos_emb"])
+    for key in ("qkv", "attn_out", "mlp_in", "mlp_out"):
+        np.testing.assert_array_equal(
+            tgt["layers"][0][key]["w"], drf["layers"][0][key]["w"]
+        )
+    # and the scale actually applied vs the unscaled build
+    plain = init_decoder(seed=5, vocab=128, hidden=64, layers=3, ffn=128, max_len=32)
+    np.testing.assert_allclose(
+        tgt["layers"][0]["attn_out"]["w"],
+        plain["layers"][0]["attn_out"]["w"] * np.float32(0.1),
+        rtol=1e-7,
+    )
+    np.testing.assert_array_equal(tgt["layers"][0]["qkv"]["w"], plain["layers"][0]["qkv"]["w"])

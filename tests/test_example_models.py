@@ -410,3 +410,38 @@ def test_nested_sibling_class_survives_pickle(tmp_path):
     user = load_user_object("Model", str(d))
     state = pickle.loads(pickle.dumps(user.__dict__))
     assert state["x"].v == 7
+
+
+def test_draft_zoo_entry_roundtrips_with_overrides():
+    """zoo://draft (the speculative-decoding draft decoder) round-trips
+    through _parse_zoo_uri with ?layers=&hidden= overrides and builds
+    deterministically like the other zoo entries; with a target's seed/
+    dims it is the target's layer-truncated prefix."""
+    import numpy as np
+
+    from seldon_core_tpu.models.zoo import _parse_zoo_uri, get_model
+
+    name, kwargs = _parse_zoo_uri("zoo://draft?layers=2&hidden=64&ffn=128&resid_scale=0.1")
+    assert name == "draft"
+    assert kwargs == {"layers": 2, "hidden": 64, "ffn": 128, "resid_scale": 0.1}
+    ms = get_model(name, **kwargs)
+    assert len(ms.params["layers"]) == 2
+    assert ms.params["tok_emb"].shape == (512, 64)  # default vocab kept
+    assert ms.int_inputs == "ids" and ms.generative is not None
+    # deterministic: same URI -> bitwise-equal params
+    again = get_model(name, **kwargs)
+    np.testing.assert_array_equal(ms.params["tok_emb"], again.params["tok_emb"])
+    # seed-prefix sharing with the target family (what the decode
+    # scheduler's speculation relies on)
+    tgt = get_model("tiny_gpt", hidden=64, ffn=128, layers=3, resid_scale=0.1)
+    np.testing.assert_array_equal(ms.params["tok_emb"], tgt.params["tok_emb"])
+    np.testing.assert_array_equal(
+        ms.params["layers"][0]["qkv"]["w"], tgt.params["layers"][0]["qkv"]["w"]
+    )
+    # serves standalone like any other zoo entry (fused whole-batch apply)
+    import jax.numpy as jnp
+
+    out = np.asarray(
+        ms.apply_fn(ms.params, jnp.asarray(np.zeros((1, 32), np.int32)))
+    )
+    assert out.shape == (1, 32 + 16) and out.dtype == np.int32
