@@ -1011,9 +1011,125 @@ def serving_gen_cpu(
             await server.batcher.close()
         return out
 
+    def _prefix_pred(chunk: int):
+        """The prefix sub-leg's deployment: longer prompt bucket (seq 64,
+        56 of it a shared system prompt) so prefill genuinely dominates
+        TTFT — the shape prefix reuse exists for."""
+        return _graph_predictor(
+            {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": "64", "type": "INT"},
+                    {"name": "max_new_tokens", "value": "16", "type": "INT"},
+                    {"name": "vocab", "value": str(vocab), "type": "INT"},
+                    {"name": "hidden", "value": "256", "type": "INT"},
+                    {"name": "layers", "value": "4", "type": "INT"},
+                    {"name": "ffn", "value": "1024", "type": "INT"},
+                    {"name": "max_len", "value": "80", "type": "INT"},
+                ],
+            },
+            {
+                "max_batch": n_slots,
+                "batch_buckets": [n_slots],
+                "batch_timeout_ms": 4.0,
+                "queue_timeout_ms": 120000.0,
+                "decode_slots": n_slots,
+                "decode_prefix_slots": 8,
+                "decode_prefill_chunk": chunk,
+            },
+        )
+
+    p_seq, p_prefix, p_requests = 64, 56, 24
+    p_rng = np.random.default_rng(7)
+    shared = p_rng.integers(0, vocab, p_seq).astype(np.int32)
+    p_prompts = np.stack(
+        [
+            np.concatenate(
+                [shared[:p_prefix], p_rng.integers(0, vocab, p_seq - p_prefix)]
+            ).astype(np.int32)
+            for _ in range(p_requests)
+        ]
+    )
+
+    async def run_prefix(chunk: int) -> dict:
+        """Shared-system-prompt workload through the prefix-cache path:
+        request 0 is cold and captures its hinted prefix at prefill
+        completion; staggered followers reuse it via the pool gather.
+        Reports the cold-vs-warm TTFT split, hit rate, prefill tokens
+        saved, and tokens/s — with the prefill chunked (interleaved with
+        decode) or monolithic per ``chunk``."""
+        server = PredictorServer(
+            _prefix_pred(chunk), deployment_name=f"gen-prefix-c{chunk}"
+        )
+        server.warmup()
+        rec = _LatencyRecorder()
+        ttft_cold: list[float] = []
+        ttft_warm: list[float] = []
+        rec.decode_ttft_split = lambda d, s, path: (
+            ttft_warm if path == "warm" else ttft_cold
+        ).append(s)
+        sched = server.decode_scheduler
+        sched._metrics = rec
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            # serialized enough that TTFT is dominated by prefill, not
+            # slot contention — the contract under measurement
+            await asyncio.sleep(i * 0.02)
+            msg = SeldonMessage.from_array(
+                p_prompts[i : i + 1],
+                meta=Meta(tags={"max_new_tokens": 8, "cache_prefix": p_prefix}),
+            )
+            out = await server.service.predict(msg)
+            return np.asarray(out.array)[0]
+
+        outs = await asyncio.gather(*(one(i) for i in range(p_requests)))
+        elapsed = time.perf_counter() - t0
+        tokens = 8 * p_requests
+        out = {
+            "tokens_per_sec": round(tokens / elapsed, 2),
+            "ttft_cold_p50_ms": _pct(ttft_cold, 50),
+            "ttft_warm_p50_ms": _pct(ttft_warm, 50),
+            "ttft_warm_p99_ms": _pct(ttft_warm, 99),
+            "inter_token_p99_ms": _pct(rec.itls, 99),
+            "hit_rate": round(
+                sched.stat_prefix_hits
+                / max(sched.stat_prefix_hits + sched.stat_prefix_misses, 1),
+                3,
+            ),
+            "prefill_tokens_saved": sched.stat_prefix_tokens_saved,
+            "chunk_dispatches": sched.stat_chunk_dispatches,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
+        await sched.close()
+        if server.batcher is not None:
+            await server.batcher.close()
+        return out, np.stack(outs)
+
     sched = asyncio.run(run_scheduler())
     spec = asyncio.run(run_scheduler(spec=True))
     scan = asyncio.run(run_scan())
+    prefix_mono, prefix_mono_out = asyncio.run(run_prefix(0))
+    prefix_chunked, prefix_chunked_out = asyncio.run(run_prefix(8))
+    # greedy outputs must be identical across chunked/monolithic prefill
+    # and warm/cold admissions (the bit-equivalence the tests pin)
+    assert np.array_equal(prefix_mono_out, prefix_chunked_out), "prefix path diverged"
+    prefix = {
+        "scenario": {
+            "requests": p_requests, "seq": p_seq, "shared_prefix": p_prefix,
+            "prefix_slots": 8, "chunk": 8, "max_new": 8,
+        },
+        "monolithic": prefix_mono,
+        "chunked": prefix_chunked,
+        "warm_ttft_speedup": (
+            round(prefix_mono["ttft_cold_p50_ms"] / prefix_mono["ttft_warm_p50_ms"], 2)
+            if prefix_mono["ttft_warm_p50_ms"]
+            else 0.0
+        ),
+    }
     speedup = (
         round(sched["tokens_per_sec"] / scan["tokens_per_sec"], 2)
         if scan["tokens_per_sec"]
@@ -1039,6 +1155,7 @@ def serving_gen_cpu(
         "scheduler": sched,
         "spec": spec,
         "scan": scan,
+        "prefix": prefix,
         "tokens_per_sec_speedup": speedup,
         "spec_tokens_per_sec_speedup": spec_speedup,
     }
@@ -1497,6 +1614,22 @@ def compact_record(full: dict) -> dict:
             c["gen"]["tok_disp"] = gp.get("tokens_per_dispatch")
             c["gen"]["spec_speedup"] = gen.get("spec_tokens_per_sec_speedup")
             c["gen"]["spec_k"] = (gen.get("scenario") or {}).get("spec_k")
+        gx = gen.get("prefix") or {}
+        if gx:
+            # prefix-cache sub-leg: cold-vs-warm TTFT, hit rate, prefill
+            # tokens the pool displaced, tokens/s with and without the
+            # chunked (decode-interleaved) prefill
+            gm = gx.get("monolithic") or {}
+            gc = gx.get("chunked") or {}
+            c["gen"]["prefix_cold_ttft"] = gm.get("ttft_cold_p50_ms")
+            c["gen"]["prefix_warm_ttft"] = gm.get("ttft_warm_p50_ms")
+            c["gen"]["prefix_ttft_speedup"] = gx.get("warm_ttft_speedup")
+            c["gen"]["prefix_hit_rate"] = gm.get("hit_rate")
+            c["gen"]["prefix_saved_tok"] = gm.get("prefill_tokens_saved")
+            c["gen"]["prefix_tok_s"] = gm.get("tokens_per_sec")
+            c["gen"]["prefix_tok_s_chunked"] = gc.get("tokens_per_sec")
+            c["gen"]["prefix_itl_p99"] = gm.get("inter_token_p99_ms")
+            c["gen"]["prefix_itl_p99_chunked"] = gc.get("inter_token_p99_ms")
     pallas = srv.get("pallas_long_seq") or {}
     if pallas:
         # named scalars only (a verbatim passthrough could silently eat the

@@ -284,6 +284,25 @@ class TpuSpec(_Spec):
     # meta.tags["spec_k"] override; spec_k=0 there opts a request out.
     decode_draft_model: str = ""
     decode_spec_k: int = 0
+    # Prefix-cache KV reuse for the decode scheduler: > 0 allocates a
+    # device-resident prefix pool of that many rows beside the slot cache,
+    # indexed host-side by prompt token prefixes (radix trie, longest-
+    # common-prefix match). On admit the matched prefix K/V is copied into
+    # the slot with one fused gather and only the uncovered suffix is
+    # prefilled — shared system prompts stop being recomputed per request.
+    # Populated from retiring slots (full prompt) and meta.tags
+    # ["cache_prefix"] hints; ref-counted, LRU-evicted. Greedy output stays
+    # bit-identical to a cold prefill.
+    decode_prefix_slots: int = 0
+    # tokens of prompt prefix each pool row can hold (0 -> the deployment's
+    # seq bucket; clamped to it — only prompt positions are ever cached)
+    decode_prefix_ctx: int = 0
+    # Sarathi-style chunked prefill: cap the prompt tokens a slot prefills
+    # per scheduler round (0 = whole suffix in one dispatch). Chunks run on
+    # a power-of-two bucket ladder interleaved with decode steps, so long
+    # prompt waves no longer stall running slots' inter-token latency.
+    # Requests may tighten (never widen) it via meta.tags["prefill_chunk"].
+    decode_prefill_chunk: int = 0
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

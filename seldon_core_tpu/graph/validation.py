@@ -83,6 +83,23 @@ def validate_deployment(dep: SeldonDeployment) -> None:
             problems.append(f"predictor '{pred.name}' batch_buckets must be ascending")
         if pred.tpu.dtype not in ("float32", "bfloat16", "float16"):
             problems.append(f"predictor '{pred.name}' dtype '{pred.tpu.dtype}' unsupported")
+        for knob in ("decode_prefix_slots", "decode_prefix_ctx", "decode_prefill_chunk"):
+            if getattr(pred.tpu, knob) < 0:
+                problems.append(f"predictor '{pred.name}' {knob} must be >= 0")
+        if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_prefix_ctx needs "
+                "decode_prefix_slots > 0"
+            )
+        if (
+            pred.tpu.decode_prefix_slots > 0 or pred.tpu.decode_prefill_chunk > 0
+        ) and pred.tpu.decode_slots <= 0:
+            # without the scheduler these knobs would be silently ignored
+            # (scheduler_for_executor returns None before reading them)
+            problems.append(
+                f"predictor '{pred.name}' decode_prefix_slots/decode_prefill_chunk "
+                "need decode_slots > 0 (the continuous-batching scheduler)"
+            )
 
     # wire semantics are DEPLOYMENT-level: the gateway classifies a body
     # before it knows which predictor will serve it, so predictors must

@@ -83,6 +83,21 @@ class NullMetrics:
         accepted_total / proposed_total."""
         pass
 
+    def decode_prefix(self, deployment: str, hit: bool, tokens_saved: int) -> None:
+        """One prefix-cache lookup at admission: ``hit`` whether a pool
+        entry covered a reusable prefix, ``tokens_saved`` the prefill
+        positions the gather replaced (0 on miss)."""
+        pass
+
+    def decode_prefix_evicted(self, deployment: str) -> None:
+        pass
+
+    def decode_ttft_split(self, deployment: str, duration_s: float, path: str) -> None:
+        """TTFT again, split by ``path`` ("warm" = admitted over a prefix
+        hit, "cold" = full prefill) — the latency contract the prefix
+        cache exists to move. Only emitted when the cache is enabled."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -245,6 +260,35 @@ class Metrics(NullMetrics):
             registry=registry,
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
         )
+        # prefix-cache KV reuse (decode scheduler): lookup outcomes, the
+        # prefill compute the pool actually displaced, eviction churn
+        # (sustained evictions = the pool is too small for the workload's
+        # distinct-prefix set), and TTFT split by cold/warm path
+        self._prefix_lookups = Counter(
+            "seldon_tpu_decode_prefix_lookups_total",
+            "Prefix-cache lookups at admission by outcome",
+            ["deployment_name", "outcome"],
+            registry=registry,
+        )
+        self._prefix_saved = Counter(
+            "seldon_tpu_decode_prefill_tokens_saved_total",
+            "Prompt positions served from the prefix pool instead of prefill",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._prefix_evictions = Counter(
+            "seldon_tpu_decode_prefix_evictions_total",
+            "Prefix pool rows recycled by LRU eviction",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._decode_ttft_split = Histogram(
+            "seldon_tpu_decode_ttft_split_seconds",
+            "TTFT split by admission path (warm = prefix hit, cold = full prefill)",
+            ["deployment_name", "path"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
         # SHADOW router candidate validation: per-shadow-child prediction
         # agreement with the primary (argmax match on classifier outputs)
         self._shadow = Counter(
@@ -342,6 +386,17 @@ class Metrics(NullMetrics):
         self._spec_proposed.labels(deployment).inc(proposed)
         self._spec_accepted.labels(deployment).inc(accepted)
         self._spec_emitted.labels(deployment).observe(emitted)
+
+    def decode_prefix(self, deployment, hit, tokens_saved):
+        self._prefix_lookups.labels(deployment, "hit" if hit else "miss").inc()
+        if tokens_saved > 0:
+            self._prefix_saved.labels(deployment).inc(tokens_saved)
+
+    def decode_prefix_evicted(self, deployment):
+        self._prefix_evictions.labels(deployment).inc()
+
+    def decode_ttft_split(self, deployment, duration_s, path):
+        self._decode_ttft_split.labels(deployment, path).observe(duration_s)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

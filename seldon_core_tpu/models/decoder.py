@@ -331,13 +331,20 @@ def write_prefill(
     return cache_k, cache_v
 
 
-def _layer_step_slots(p, x, cache_k, cache_v, positions, h):
+def _layer_step_slots(p, x, cache_k, cache_v, positions, h, counts=None):
     """_layer_step generalized to PER-SLOT positions and m queries per
     slot. x: [n, m, d]; cache [n, h, max_ctx, hd]; positions: [n] — slot
     i's query j sits at positions[i] + j, writes its K/V there, and
     attends to cache entries <= positions[i] + j (the in-block causal
     mask: speculative query j sees the keys queries 0..j-1 of the same
-    dispatch just wrote). The serving decode step is the m=1 case."""
+    dispatch just wrote). The serving decode step is the m=1 case.
+
+    ``counts`` (optional, [n]): per-slot WRITE masks for chunked prefill —
+    slot i persists only its first counts[i] K/V entries and leaves the
+    rest of its cache byte-identical (a select against the current block,
+    so a counts-0 slot riding the static-shape dispatch mutates nothing).
+    None keeps the unconditional m-wide write (decode/verify paths, where
+    junk beyond a slot's limit lands ahead of its cursor by design)."""
     normed = _ln(p["ln1"], x)
     qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -347,9 +354,21 @@ def _layer_step_slots(p, x, cache_k, cache_v, positions, h):
     # per-slot scatter: vmap over the slot axis turns the per-sequence
     # dynamic_update_slice into one batched scatter — no host loop, no
     # per-slot programs; the m-wide K/V block lands at positions[i]..+m-1
-    write = jax.vmap(lambda c, kk, pos: lax.dynamic_update_slice(c, kk, (0, pos, 0)))
-    cache_k = write(cache_k, k, positions)
-    cache_v = write(cache_v, v, positions)
+    if counts is None:
+        write = jax.vmap(lambda c, kk, pos: lax.dynamic_update_slice(c, kk, (0, pos, 0)))
+        cache_k = write(cache_k, k, positions)
+        cache_v = write(cache_v, v, positions)
+    else:
+        m_w = k.shape[2]
+
+        def _masked(c, kk, pos, cnt):
+            cur = lax.dynamic_slice(c, (0, pos, 0), kk.shape)
+            blk = jnp.where((jnp.arange(m_w) < cnt)[None, :, None], kk, cur)
+            return lax.dynamic_update_slice(c, blk, (0, pos, 0))
+
+        write = jax.vmap(_masked)
+        cache_k = write(cache_k, k, positions, counts)
+        cache_v = write(cache_v, v, positions, counts)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum(
         "nhqd,nhkd->nhqk", q.astype(jnp.float32), cache_k.astype(jnp.float32)
@@ -470,6 +489,50 @@ def verify_step(
     new_k, new_v = [], []
     for li, lp in enumerate(params["layers"]):
         x, ck, cv = _layer_step_slots(lp, x, cache_k[li], cache_v[li], positions, heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    logits = _logits(params, x)  # [n, m, vocab]
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def chunk_prefill(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    counts: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One prefill CHUNK for every slot: consume tokens[n, c] with slot
+    i's token j at positions[i] + j, persisting only the first counts[i]
+    K/V entries per slot (counts-0 slots — generating, free — ride the
+    static-shape dispatch without touching their cache). Returns
+    (logits[n, c, vocab], cache_k, cache_v); logits[i, counts[i] - 1] is
+    the next-token distribution after slot i's last consumed token — the
+    first generated token's logits when the chunk completes a prompt.
+
+    This is the incremental prefill building block behind both prefix
+    reuse (only the suffix a cached prefix doesn't cover is computed) and
+    Sarathi-style chunked prefill (a long prompt spreads over several
+    scheduler rounds interleaved with decode steps). Same per-position
+    K/V math as verify_step/_layer_step_slots: each query attends to
+    cache entries <= its own position through the in-block causal mask,
+    so a prompt prefilled in ANY chunk partition yields the same K/V as
+    one computed in a single pass over the same cache layout."""
+    heads = _heads(params)
+    m = tokens.shape[1]
+    max_len = params["pos_emb"].shape[0]
+    x = jnp.asarray(params["tok_emb"])[tokens]  # [n, m, d]
+    # junk queries (beyond a slot's count) may index past the position
+    # table; clip like verify_step — their logits are never used and
+    # their K/V writes are masked off
+    pidx = jnp.clip(positions[:, None] + jnp.arange(m)[None, :], 0, max_len - 1)
+    x = x + jnp.asarray(params["pos_emb"])[pidx]
+    new_k, new_v = [], []
+    for li, lp in enumerate(params["layers"]):
+        x, ck, cv = _layer_step_slots(
+            lp, x, cache_k[li], cache_v[li], positions, heads, counts=counts
+        )
         new_k.append(ck)
         new_v.append(cv)
     logits = _logits(params, x)  # [n, m, vocab]

@@ -16,6 +16,19 @@ scheduling and a vLLM-style slot KV cache into the stack:
   zero recompiles — active-slot masking, never shape changes.
 - Tokens stream to the caller as they are chosen (``on_token``), which is
   what the fast ingress's SSE endpoint forwards to clients.
+- Prefix-cache KV reuse (``tpu.decode_prefix_slots``): a host-side radix
+  index over prompt token prefixes backed by a device-resident, ref-
+  counted, LRU-evicted prefix pool ``[L, n_prefix, h, prefix_ctx, hd]``.
+  On admit the longest indexed prefix is copied into the slot with ONE
+  fused device-side gather (no host readback) and only the uncovered
+  suffix is prefilled — the RadixAttention observation that shared system
+  prompts dominate real chat/agent traffic, applied to the slot cache.
+  The pool is populated from retiring slots (full prompt) and explicit
+  ``meta.tags.cache_prefix`` hints (at prefill completion).
+- Chunked prefill (``tpu.decode_prefill_chunk``): prompt suffixes are
+  computed in fixed-size chunk buckets interleaved with decode steps
+  (Sarathi-style), so a long admission wave no longer stalls every
+  running slot's inter-token latency for a whole monolithic prefill.
 - Draft-model speculation (``tpu.decode_draft_model`` + ``decode_spec_k``)
   amortizes each target dispatch over k proposed tokens: a small draft
   decoder proposes k tokens per slot in ONE fused dispatch, the target
@@ -56,6 +69,7 @@ from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu import telemetry
 from seldon_core_tpu.models.decoder import (
+    chunk_prefill,
     decode_step,
     decoder_dims,
     draft_propose,
@@ -84,31 +98,33 @@ def _fused_step(params, cache_k, cache_v, tokens, positions, temps, topks, seed,
     return sample_tokens(logits, temps, topks, key), cache_k, cache_v
 
 
-def _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid):
-    """Per-row K/V writes of a prefill wave into each row's own slot.
-    Padding rows have valid=False and rewrite their target slot's CURRENT
-    content (a select against a same-shape dynamic_slice — a generalized
-    scatter with dropped rows measured ~25 ms/call on the CPU backend
-    where this pair of small slices is sub-ms). The loop unrolls at trace
-    time (bucket size is static)."""
-    from jax import lax
-
-    for r in range(k_new.shape[1]):
-        start = (0, slots[r], 0, 0, 0)
-        kk = k_new[:, r : r + 1]
-        vv = v_new[:, r : r + 1]
-        cur_k = lax.dynamic_slice(cache_k, start, kk.shape)
-        cur_v = lax.dynamic_slice(cache_v, start, vv.shape)
-        cache_k = lax.dynamic_update_slice(
-            cache_k, jnp.where(valid[r], kk, cur_k), start
-        )
-        cache_v = lax.dynamic_update_slice(
-            cache_v, jnp.where(valid[r], vv, cur_v), start
-        )
+def _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot):
+    """Write a prefill wave's K/V into each row's own slot as ONE masked
+    gather + slice update, vectorized over SLOTS: slot j takes wave row
+    ``row_for_slot[j]`` iff ``valid_slot[j]`` and keeps its current bytes
+    otherwise. Pivoting the mapping to the slot axis makes the write
+    conflict-free by construction (each slot SELECTS its row — no scatter
+    with duplicate destination indices exists), which is what lets the
+    whole wave land as one fused op instead of the per-row unrolled
+    dynamic_update_slice loop this replaces (4 slice ops traced per wave
+    row; the large-bucket admit programs dominated warmup — delta in
+    PARITY.md)."""
+    s = k_new.shape[3]
+    sel_k = jnp.take(k_new, row_for_slot, axis=1)  # [L, n_slots, h, s, hd]
+    sel_v = jnp.take(v_new, row_for_slot, axis=1)
+    mask = valid_slot[None, :, None, None, None]
+    cache_k = cache_k.at[:, :, :, :s, :].set(
+        jnp.where(mask, sel_k, cache_k[:, :, :, :s, :])
+    )
+    cache_v = cache_v.at[:, :, :, :s, :].set(
+        jnp.where(mask, sel_v, cache_v[:, :, :, :s, :])
+    )
     return cache_k, cache_v
 
 
-def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed, tick):
+def _fused_admit(
+    params, cache_k, cache_v, ids, row_for_slot, valid_slot, temps, topks, seed, tick
+):
     """One device program per admission WAVE: batched prompt prefill +
     per-row K/V writes into each row's own slot + first-token sampling,
     all in one dispatch. ``ids`` is a [k, s] bucket (k from a fixed
@@ -117,7 +133,9 @@ def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed
     admission-bound, and one wave of 8 prompts costs one prefill program
     like the fused scan's, not 8 serial ones."""
     logits, k_new, v_new = prefill(params, ids)  # [L, k, h, s, hd]
-    cache_k, cache_v = _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid)
+    cache_k, cache_v = _scatter_prefill_rows(
+        cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot
+    )
     key = jax.random.fold_in(jax.random.key(seed), tick)
     toks = sample_tokens(logits, temps, topks, key)
     return toks, cache_k, cache_v
@@ -125,21 +143,93 @@ def _fused_admit(params, cache_k, cache_v, ids, slots, valid, temps, topks, seed
 
 def _fused_spec_admit(
     params, draft_params, cache_k, cache_v, dcache_k, dcache_v,
-    ids, slots, valid, temps, topks, seed, tick,
+    ids, row_for_slot, valid_slot, temps, topks, seed, tick,
 ):
     """_fused_admit + the DRAFT model's prefill of the same prompts into
     its own slot cache, still one dispatch per wave. The first token comes
     from the TARGET's prefill logits exactly as on the plain path, so
     admission stays bit-identical with speculation on."""
     logits, k_new, v_new = prefill(params, ids)
-    cache_k, cache_v = _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, slots, valid)
+    cache_k, cache_v = _scatter_prefill_rows(
+        cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot
+    )
     _, dk_new, dv_new = prefill(draft_params, ids)
     dcache_k, dcache_v = _scatter_prefill_rows(
-        dcache_k, dcache_v, dk_new, dv_new, slots, valid
+        dcache_k, dcache_v, dk_new, dv_new, row_for_slot, valid_slot
     )
     key = jax.random.fold_in(jax.random.key(seed), tick)
     toks = sample_tokens(logits, temps, topks, key)
     return toks, cache_k, cache_v, dcache_k, dcache_v
+
+
+def _fused_prefix_gather(cache_k, cache_v, pool_k, pool_v, src_for_slot, len_for_slot):
+    """Copy each admitted slot's longest-matched prefix K/V out of the
+    device prefix pool in ONE dispatch: a gather along the pool axis +
+    a length-masked slice update, vectorized over slots (len 0 slots —
+    no match, not in this wave — keep their bytes). No host readback:
+    the cached K/V never leaves the device; only the two [n_slots] int32
+    index/length vectors go up with the dispatch."""
+    pc = pool_k.shape[3]
+    sel_k = jnp.take(pool_k, src_for_slot, axis=1)  # [L, n_slots, h, pc, hd]
+    sel_v = jnp.take(pool_v, src_for_slot, axis=1)
+    mask = (jnp.arange(pc)[None, :] < len_for_slot[:, None])[None, :, None, :, None]
+    cache_k = cache_k.at[:, :, :, :pc, :].set(
+        jnp.where(mask, sel_k, cache_k[:, :, :, :pc, :])
+    )
+    cache_v = cache_v.at[:, :, :, :pc, :].set(
+        jnp.where(mask, sel_v, cache_v[:, :, :, :pc, :])
+    )
+    return cache_k, cache_v
+
+
+def _fused_prefix_capture(pool_k, pool_v, cache_k, cache_v, dst, slot, length):
+    """The populate half of the prefix cache: copy ``slot``'s leading
+    ``length`` K/V entries into pool row ``dst`` (length-masked against
+    the row's current bytes), one dispatch, no readback. dst/slot/length
+    are traced scalars, so one compiled program serves every capture."""
+    pc = pool_k.shape[3]
+    seg_k = jnp.take(cache_k, slot, axis=1)[:, :, :pc, :]  # [L, h, pc, hd]
+    seg_v = jnp.take(cache_v, slot, axis=1)[:, :, :pc, :]
+    cur_k = jnp.take(pool_k, dst, axis=1)
+    cur_v = jnp.take(pool_v, dst, axis=1)
+    mask = (jnp.arange(pc) < length)[None, None, :, None]
+    new_k = jnp.where(mask, seg_k, cur_k)[:, None]
+    new_v = jnp.where(mask, seg_v, cur_v)[:, None]
+    pool_k = jax.lax.dynamic_update_slice(pool_k, new_k, (0, dst, 0, 0, 0))
+    pool_v = jax.lax.dynamic_update_slice(pool_v, new_v, (0, dst, 0, 0, 0))
+    return pool_k, pool_v
+
+
+def _fused_chunk(params, cache_k, cache_v, ids, positions, counts, temps, topks, seed, tick):
+    """One device program per prefill chunk round: ``chunk_prefill`` over
+    every slot (counts-0 slots — generating, free — ride the static shape
+    without touching their cache) + next-token sampling from each slot's
+    last consumed position, one dispatch. ``ids`` is a [n_slots, c]
+    bucket from the chunk ladder; only the sampled token for slots whose
+    prompt COMPLETED this round is consumed by the host (it is the first
+    generated token, sampled from the same last-position logits the
+    monolithic admit program samples)."""
+    logits, cache_k, cache_v = chunk_prefill(
+        params, cache_k, cache_v, ids, positions, counts
+    )
+    c = ids.shape[1]
+    idx = jnp.clip(counts - 1, 0, c - 1)
+    last = logits[jnp.arange(ids.shape[0]), idx]  # [n, vocab]
+    key = jax.random.fold_in(jax.random.key(seed), tick)
+    return sample_tokens(last, temps, topks, key), cache_k, cache_v
+
+
+def _fused_draft_admit(params, dcache_k, dcache_v, ids, row_for_slot, valid_slot):
+    """Draft-side prompt prefill for slots whose TARGET prefill completed
+    via the incremental (prefix/chunk) path: the draft shares no K/V with
+    the target's prefix pool, so its cache takes the FULL prompt in one
+    bucketed dispatch at transition time — target-side prefix reuse never
+    skews the draft's proposal distribution (and greedy acceptance is
+    bit-exact for ANY draft state regardless)."""
+    _, k_new, v_new = prefill(params, ids)
+    return _scatter_prefill_rows(
+        dcache_k, dcache_v, k_new, v_new, row_for_slot, valid_slot
+    )
 
 
 def _fused_draft(params, cache_k, cache_v, tokens, positions, temps, topks, seed, tick, k):
@@ -169,6 +259,106 @@ def _fused_verify(
     return out, acc, cache_k, cache_v
 
 
+class _PrefixEntry:
+    """One cached prefix: a device pool row + the token string it holds."""
+
+    __slots__ = ("tokens", "length", "row", "refs", "last_use", "hits")
+
+    def __init__(self, tokens: np.ndarray, row: int):
+        self.tokens = np.asarray(tokens, np.int32)
+        self.length = int(self.tokens.shape[0])
+        self.row = row
+        self.refs = 0  # pinned by in-flight readers; never evicted while > 0
+        self.last_use = 0
+        self.hits = 0
+
+
+class PrefixIndex:
+    """Host-side radix index over the device prefix pool's token strings.
+
+    Matching walks the token trie as deep as the prompt agrees with ANY
+    entry — longest-COMMON-prefix semantics, not whole-entry match: causal
+    K/V at position i depends only on tokens 0..i, so a partial overlap
+    with a longer cached entry is exactly as reusable as a full one (what
+    makes shared system prompts hit without any client hint: the first
+    full-prompt capture seeds every later request's common prefix).
+
+    Entries are ref-counted while a reader slot's prefill is in flight and
+    LRU-evicted — never while pinned — when the pool is full. Node count
+    is pool-bounded (n_rows x prefix_ctx tokens), so eviction re-indexes
+    from scratch instead of doing per-node reference surgery."""
+
+    def __init__(self, n_rows: int):
+        self.n_rows = n_rows
+        self.entries: dict[int, _PrefixEntry] = {}  # pool row -> entry
+        self._free = list(range(n_rows - 1, -1, -1))
+        self._clock = 0
+        self._root: dict[int, list] = {}  # token -> [children, pool row]
+        self.evictions = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, prompt, touch: bool = True) -> tuple["_PrefixEntry | None", int]:
+        """Longest common prefix between ``prompt`` and any entry:
+        (entry, depth). ``touch=False`` peeks without bumping LRU age
+        (the capture-dedup probe must not keep its own victim warm)."""
+        node, row, depth = self._root, -1, 0
+        for t in prompt:
+            nxt = node.get(int(t))
+            if nxt is None:
+                break
+            node, row = nxt[0], nxt[1]
+            depth += 1
+        if row < 0:
+            return None, 0
+        e = self.entries[row]
+        if touch:
+            e.last_use = self._tick()
+            e.hits += 1
+        return e, depth
+
+    def insert(self, tokens) -> "_PrefixEntry | None":
+        """Claim a pool row for ``tokens`` (LRU-evicting an unpinned entry
+        if the pool is full); returns None when every row is pinned — the
+        caller skips the capture rather than stalling. The device copy is
+        the caller's dispatch; this only does the bookkeeping."""
+        if self._free:
+            row = self._free.pop()
+        else:
+            victims = [e for e in self.entries.values() if e.refs == 0]
+            if not victims:
+                return None
+            self.remove(min(victims, key=lambda e: e.last_use))
+            self.evictions += 1
+            row = self._free.pop()
+        e = _PrefixEntry(tokens, row)
+        e.last_use = self._tick()
+        self.entries[row] = e
+        self._index(e)
+        return e
+
+    def _index(self, e: "_PrefixEntry") -> None:
+        node = self._root
+        for t in e.tokens:
+            nxt = node.setdefault(int(t), [{}, e.row])
+            nxt[1] = e.row  # newest entry through this node wins ties
+            node = nxt[0]
+
+    def remove(self, e: "_PrefixEntry") -> None:
+        del self.entries[e.row]
+        self._free.append(e.row)
+        self._root = {}
+        for other in self.entries.values():
+            self._index(other)
+
+    def clear(self) -> None:
+        self.entries.clear()
+        self._root = {}
+        self._free = list(range(self.n_rows - 1, -1, -1))
+
+
 class _Seq:
     """One in-flight generation request."""
 
@@ -176,6 +366,8 @@ class _Seq:
         "prompt", "max_new", "temperature", "top_k", "spec_k", "on_token", "future",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
         "deadline", "trace_ctxs", "gen_spans",
+        "prefilling", "prefill_pos", "prefix_len", "prefix_entry", "chunk_cap",
+        "cache_prefix", "chunk_idx",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, spec_k, on_token, future):
@@ -193,6 +385,15 @@ class _Seq:
         self.t_first_token = 0.0
         self.t_last_token = 0.0
         self.deadline = 0.0  # admission deadline (0 = none)
+        # incremental (prefix/chunk) prefill state: prefill_pos is the next
+        # prompt position to compute; prefix_len the pool-reused span
+        self.prefilling = False
+        self.prefill_pos = 0
+        self.prefix_len = 0
+        self.prefix_entry: _PrefixEntry | None = None
+        self.chunk_cap = 0  # per-round prefill token cap (0 = whole suffix)
+        self.cache_prefix = 0  # meta.tags.cache_prefix capture hint
+        self.chunk_idx = 0
         # the submitter's trace context(s), captured at submit: the decode
         # loop runs in its OWN task (no ambient request context), so spans
         # are attached to each sequence's originating trace explicitly
@@ -223,6 +424,9 @@ class DecodeScheduler:
         queue_timeout_s: float = 0.0,
         draft_params=None,
         spec_k: int = 0,
+        prefix_slots: int = 0,
+        prefix_ctx: int = 0,
+        prefill_chunk: int = 0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         dtype=jnp.float32,
@@ -269,7 +473,37 @@ class DecodeScheduler:
         self.spec_enabled = draft_params is not None and spec_k >= 1
         self.spec_k = int(spec_k) if self.spec_enabled else 0
         self.draft_params = draft_params if self.spec_enabled else None
-        self._cache_ctx = self.max_ctx + self.spec_k
+
+        # prefix cache + chunked prefill: either knob switches admission to
+        # the INCREMENTAL path (prefix gather + bucketed chunk_prefill
+        # rounds interleaved with decode steps) instead of the monolithic
+        # one-dispatch-per-wave admit program
+        self.prefix_enabled = prefix_slots > 0
+        self.prefix_slots = int(prefix_slots) if self.prefix_enabled else 0
+        self.prefix_ctx = (
+            min(int(prefix_ctx) or seq_len, seq_len) if self.prefix_enabled else 0
+        )
+        self.prefill_chunk = min(max(0, int(prefill_chunk)), seq_len)
+        self.incremental = self.prefix_enabled or self.prefill_chunk > 0
+        if self.incremental:
+            top = self.prefill_chunk or seq_len
+            cb, b = [], 1
+            while b < top:
+                cb.append(b)
+                b *= 2
+            self.chunk_buckets = tuple(cb) + (top,)
+        else:
+            self.chunk_buckets = ()
+        # cache headroom: the widened verify writes a fixed [k+1] block and
+        # a chunk round a fixed [c] block at each slot's own position; a
+        # slot near the end of its context must not have that block's
+        # dynamic_update_slice clamp backwards over accepted entries. The
+        # chunk block's worst case starts at seq_len - 1 (one remaining
+        # prompt token riding the top bucket).
+        chunk_headroom = max(
+            0, (self.chunk_buckets[-1] - 1 - max_new_tokens) if self.chunk_buckets else 0
+        )
+        self._cache_ctx = self.max_ctx + max(self.spec_k, chunk_headroom)
         if self.spec_enabled:
             ddims = decoder_dims(draft_params)
             if ddims["vocab"] != dims["vocab"]:
@@ -299,6 +533,18 @@ class DecodeScheduler:
                 _fused_draft, donate_argnums=(1, 2), static_argnums=(9,)
             )
             self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1, 2))
+        # incremental-path programs: the chunk ladder (one program per chunk
+        # bucket), the draft's transition-time prompt prefill (spec mode),
+        # and the prefix pool's gather/capture pair — all compiled at
+        # warmup() and reported by compile_counts()
+        if self.incremental:
+            self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1, 2))
+            if self.spec_enabled:
+                self._draft_admit_fn = jax.jit(_fused_draft_admit, donate_argnums=(1, 2))
+        if self.prefix_enabled:
+            self._gather_fn = jax.jit(_fused_prefix_gather, donate_argnums=(0, 1))
+            self._capture_fn = jax.jit(_fused_prefix_capture, donate_argnums=(0, 1))
+            self._prefix_index = PrefixIndex(self.prefix_slots)
         buckets = []
         b = 1
         while b < n_slots:
@@ -306,10 +552,17 @@ class DecodeScheduler:
             b *= 2
         self.admit_buckets = tuple(buckets) + (n_slots,)
 
-        self._ck, self._cv = init_slot_cache(params, n_slots, self._cache_ctx, dtype)
+        self._ck, self._cv = self._place_like(
+            params, init_slot_cache(params, n_slots, self._cache_ctx, dtype)
+        )
         if self.spec_enabled:
-            self._dck, self._dcv = init_slot_cache(
-                draft_params, n_slots, self._cache_ctx, dtype
+            self._dck, self._dcv = self._place_like(
+                draft_params, init_slot_cache(draft_params, n_slots, self._cache_ctx, dtype)
+            )
+        if self.prefix_enabled:
+            # device-resident prefix pool [L, n_prefix, h, prefix_ctx, hd]
+            self._pk, self._pv = self._place_like(
+                params, init_slot_cache(params, self.prefix_slots, self.prefix_ctx, dtype)
             )
         # on an accelerator, device dispatch + token readback block the
         # calling thread for the device-step latency — run them on the
@@ -339,6 +592,33 @@ class DecodeScheduler:
         self.stat_spec_proposed = 0
         self.stat_spec_accepted = 0
         self.stat_spec_emitted = 0
+        # prefix cache / chunked prefill attribution
+        self.stat_prefix_hits = 0
+        self.stat_prefix_misses = 0
+        self.stat_prefix_tokens_saved = 0
+        self.stat_prefix_captures = 0
+        self.stat_prefix_capture_skips = 0
+        self.stat_chunk_dispatches = 0
+
+    @staticmethod
+    def _place_like(params, arrs):
+        """Commit cache/pool buffers to the params' sharding up front.
+        When the runtime device_put the params with a mesh sharding
+        (the defaulted serving path), a jit call's output caches adopt it
+        — so fresh UNCOMMITTED zeros would make the first warmup call per
+        program compile a signature live traffic never presents again,
+        and the first live dispatch would recompile. Committing to the
+        steady-state sharding before any compile keeps warmup's
+        signatures exactly the serving ones (host-numpy params — tests,
+        direct use — are left alone)."""
+        leaves = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(params)
+            if isinstance(leaf, jax.Array)
+        ]
+        if not leaves:
+            return tuple(arrs)
+        return tuple(jax.device_put(a, leaves[0].sharding) for a in arrs)
 
     # ---------------------------------------------------------------- warmup
     def warmup(self) -> None:
@@ -347,28 +627,56 @@ class DecodeScheduler:
         compile on a live request — compile_counts() after this is the
         zero-recompile baseline."""
         t0 = time.perf_counter()
-        for b in self.admit_buckets:
-            # all-padding wave (valid all-False): warming writes nothing
-            # into live slots
-            if self.spec_enabled:
-                toks, self._ck, self._cv, self._dck, self._dcv = self._spec_admit_fn(
-                    self.params, self.draft_params,
-                    self._ck, self._cv, self._dck, self._dcv,
-                    np.zeros((b, self.seq_len), np.int32),
-                    np.zeros(b, np.int32),
-                    np.zeros(b, bool),
-                    np.zeros(b, np.float32), np.zeros(b, np.int32),
-                    self._seed, np.int32(0),
-                )
-            else:
-                toks, self._ck, self._cv = self._admit_fn(
+        zslot = np.zeros(self.n_slots, np.int32)
+        vslot = np.zeros(self.n_slots, bool)
+        if self.incremental:
+            # chunk ladder: counts all-0, so compiling touches no live
+            # bytes (the masked write is a no-op at count 0)
+            for c in self.chunk_buckets:
+                toks, self._ck, self._cv = self._chunk_fn(
                     self.params, self._ck, self._cv,
-                    np.zeros((b, self.seq_len), np.int32),
-                    np.zeros(b, np.int32),
-                    np.zeros(b, bool),
-                    np.zeros(b, np.float32), np.zeros(b, np.int32),
+                    np.zeros((self.n_slots, c), np.int32),
+                    zslot, zslot,
+                    np.zeros(self.n_slots, np.float32), zslot,
                     self._seed, np.int32(0),
                 )
+            if self.spec_enabled:
+                for b in self.admit_buckets:
+                    self._dck, self._dcv = self._draft_admit_fn(
+                        self.draft_params, self._dck, self._dcv,
+                        np.zeros((b, self.seq_len), np.int32), zslot, vslot,
+                    )
+        else:
+            for b in self.admit_buckets:
+                # all-padding wave (valid all-False): warming writes
+                # nothing into live slots
+                if self.spec_enabled:
+                    toks, self._ck, self._cv, self._dck, self._dcv = self._spec_admit_fn(
+                        self.params, self.draft_params,
+                        self._ck, self._cv, self._dck, self._dcv,
+                        np.zeros((b, self.seq_len), np.int32),
+                        zslot, vslot,
+                        np.zeros(b, np.float32), np.zeros(b, np.int32),
+                        self._seed, np.int32(0),
+                    )
+                else:
+                    toks, self._ck, self._cv = self._admit_fn(
+                        self.params, self._ck, self._cv,
+                        np.zeros((b, self.seq_len), np.int32),
+                        zslot, vslot,
+                        np.zeros(b, np.float32), np.zeros(b, np.int32),
+                        self._seed, np.int32(0),
+                    )
+        if self.prefix_enabled:
+            # gather with all lengths 0 (slots keep their bytes) and a
+            # length-0 capture into row 0 (the row keeps its bytes)
+            self._ck, self._cv = self._gather_fn(
+                self._ck, self._cv, self._pk, self._pv, zslot, zslot
+            )
+            self._pk, self._pv = self._capture_fn(
+                self._pk, self._pv, self._ck, self._cv,
+                np.int32(0), np.int32(0), np.int32(0),
+            )
         many, self._ck, self._cv = self._step_fn(
             self.params, self._ck, self._cv,
             np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
@@ -408,7 +716,18 @@ class DecodeScheduler:
             counts["spec_admit"] = self._spec_admit_fn._cache_size()
             counts["draft"] = self._draft_fn._cache_size()
             counts["verify"] = self._verify_fn._cache_size()
+        if self.incremental:
+            counts["chunk"] = self._chunk_fn._cache_size()
+            if self.spec_enabled:
+                counts["draft_admit"] = self._draft_admit_fn._cache_size()
+        if self.prefix_enabled:
+            counts["gather"] = self._gather_fn._cache_size()
+            counts["capture"] = self._capture_fn._cache_size()
         return counts
+
+    @property
+    def stat_prefix_evictions(self) -> int:
+        return self._prefix_index.evictions if self.prefix_enabled else 0
 
     def recompiles_since_warmup(self) -> int:
         """Number of XLA compiles since warmup() — the serving invariant is
@@ -433,6 +752,8 @@ class DecodeScheduler:
         temperature: float | None = None,
         top_k: int | None = None,
         spec_k: int | None = None,
+        cache_prefix: int | None = None,
+        prefill_chunk: int | None = None,
         on_token: OnToken | None = None,
     ) -> np.ndarray:
         """Generate for one prompt [seq_len]; resolves with the full int32
@@ -440,7 +761,12 @@ class DecodeScheduler:
         called inline from the decode loop per generated token — keep it
         cheap (the streaming endpoint pushes into an asyncio.Queue).
         ``spec_k`` tightens (never widens) the deployment's speculative
-        proposal length; 0 opts this request out of speculation."""
+        proposal length; 0 opts this request out of speculation.
+        ``cache_prefix`` hints how many leading prompt tokens are worth
+        capturing into the prefix pool (a shared system prompt's length);
+        ``prefill_chunk`` tightens (never widens) the deployment's
+        per-round prefill chunk — both are ignored when the corresponding
+        tier is disabled."""
         if self._closed:
             raise APIException(
                 ErrorCode.ENGINE_MICROSERVICE_ERROR, "decode scheduler closed"
@@ -459,6 +785,22 @@ class DecodeScheduler:
         sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
         seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
+        if self.incremental:
+            seq.chunk_cap = self.prefill_chunk
+            if prefill_chunk is not None:
+                pc = int(prefill_chunk)
+                # tighten-only against the deployment cap (a smaller chunk
+                # is tighter); with no deployment cap a request may still
+                # ask for one. Values < 1 are IGNORED, not clamped to 1:
+                # "0 = whole suffix" is the deployment knob's widest
+                # setting, and a request must not widen past the
+                # deployment's cap (nor accidentally get 1-token rounds)
+                if pc >= 1:
+                    seq.chunk_cap = (
+                        min(pc, self.prefill_chunk) if self.prefill_chunk else pc
+                    )
+        if self.prefix_enabled and cache_prefix is not None:
+            seq.cache_prefix = max(0, min(int(cache_prefix), self.prefix_ctx))
         if self.queue_timeout_s > 0:
             seq.deadline = seq.t_enqueued + self.queue_timeout_s
         self._waiting.append(seq)
@@ -478,6 +820,14 @@ class DecodeScheduler:
         if len(seq.tokens) == 1:
             seq.t_first_token = now
             self._metrics.decode_ttft(self._deployment, now - seq.t_enqueued)
+            if self.prefix_enabled:
+                # cold-vs-warm TTFT split: the latency contract prefix
+                # reuse exists to move
+                self._metrics.decode_ttft_split(
+                    self._deployment,
+                    now - seq.t_enqueued,
+                    "warm" if seq.prefix_len > 0 else "cold",
+                )
             # TTFT as a trace event on the sequence's generate span — the
             # latency contract a streaming client actually feels
             for sp in seq.gen_spans:
@@ -504,12 +854,53 @@ class DecodeScheduler:
                 np.concatenate([seq.prompt, np.asarray(seq.tokens, np.int32)])
             )
 
+    def _unpin(self, seq: _Seq) -> None:
+        if seq.prefix_entry is not None:
+            seq.prefix_entry.refs -= 1
+            seq.prefix_entry = None
+
+    def _maybe_capture(self, seq: _Seq, slot: int, length: int) -> None:
+        """Copy ``slot``'s leading K/V into the prefix pool when the index
+        doesn't already cover prompt[:length]: one capture dispatch, no
+        readback. Called at prefill completion for hinted captures
+        (meta.tags.cache_prefix — the prefix K/V exists from that moment)
+        and at retirement for the automatic full-prompt policy."""
+        length = min(length, self.prefix_ctx, self.seq_len)
+        if length < 1:
+            return
+        _, depth = self._prefix_index.match(seq.prompt, touch=False)
+        if depth >= length:
+            return  # already covered verbatim (or by a longer entry)
+        ev0 = self._prefix_index.evictions
+        e = self._prefix_index.insert(seq.prompt[:length])
+        if e is None:
+            # every pool row is pinned by an in-flight reader — skip
+            # rather than stall the loop
+            self.stat_prefix_capture_skips += 1
+            return
+        if self._prefix_index.evictions > ev0:
+            self._metrics.decode_prefix_evicted(self._deployment)
+        self._pk, self._pv = self._capture_fn(
+            self._pk, self._pv, self._ck, self._cv,
+            np.int32(e.row), np.int32(slot), np.int32(length),
+        )
+        self.stat_prefix_captures += 1
+
     def _retire(self, slot: int) -> None:
         seq = self._slots[slot]
         self._slots[slot] = None
         self._free.append(slot)
         self.stat_retired += 1
         if seq is not None:
+            if self.prefix_enabled:
+                # automatic capture policy: a request that declared its
+                # reusable span (cache_prefix) captured at prefill
+                # completion; everyone else contributes their full prompt
+                # here. A sequence cancelled mid-prefill has incomplete
+                # prompt K/V and must not be captured.
+                if not seq.prefilling and seq.cache_prefix == 0:
+                    self._maybe_capture(seq, slot, self.seq_len)
+                self._unpin(seq)
             if seq.gen_spans:
                 t = telemetry.now_ns()
                 for sp in seq.gen_spans:
@@ -532,88 +923,37 @@ class DecodeScheduler:
 
         return await asyncio.get_running_loop().run_in_executor(compute_pool(), fn)
 
+    def _pop_wave(self) -> tuple[list[_Seq], list[int]]:
+        wave: list[_Seq] = []
+        while self._waiting and len(wave) < len(self._free):
+            seq = self._waiting.popleft()
+            if not seq.future.cancelled():
+                wave.append(seq)
+        return wave, [self._free.pop() for _ in wave]
+
     async def _admit(self) -> None:
-        """Move waiting sequences into free slots in WAVES: one batched
-        prefill dispatch admits up to every free slot at once (bucketed to
-        the warmed power-of-two ladder; padding rows are valid=False and
-        write nothing), and each admitted row's first token is emitted
-        (sampled from the prefill logits — exactly the fused oracle's
-        first_tok)."""
+        """Move waiting sequences into free slots in WAVES.
+
+        Monolithic path (default): one batched prefill dispatch admits up
+        to every free slot at once (bucketed to the warmed power-of-two
+        ladder; padding slots are valid=False and keep their bytes), and
+        each admitted row's first token is emitted (sampled from the
+        prefill logits — exactly the fused oracle's first_tok).
+
+        Incremental path (prefix cache and/or chunked prefill enabled):
+        slot assignment + the longest-prefix pool gather happen here (one
+        fused dispatch per wave, no host readback); the uncovered suffix
+        is computed by chunk rounds interleaved with decode steps in the
+        run loop, and the first token is emitted when the last chunk
+        lands."""
         while self._waiting and self._free:
-            wave: list[_Seq] = []
-            while self._waiting and len(wave) < len(self._free):
-                seq = self._waiting.popleft()
-                if not seq.future.cancelled():
-                    wave.append(seq)
+            wave, taken = self._pop_wave()
             if not wave:
                 continue
-            bucket = next(b for b in self.admit_buckets if b >= len(wave))
-            ids = np.zeros((bucket, self.seq_len), np.int32)
-            slots = np.zeros(bucket, np.int32)
-            valid = np.zeros(bucket, bool)
-            temps = np.zeros(bucket, np.float32)
-            topks = np.zeros(bucket, np.int32)
-            taken = [self._free.pop() for _ in wave]
-            for r, (seq, slot) in enumerate(zip(wave, taken)):
-                ids[r] = seq.prompt
-                slots[r] = slot
-                valid[r] = True
-                temps[r] = seq.temperature
-                topks[r] = seq.top_k
-            tick = self._next_tick()
-            t_wave0 = telemetry.now_ns()
-
-            if self.spec_enabled:
-                def _do_admit():
-                    toks, ck, cv, dck, dcv = self._spec_admit_fn(
-                        self.params, self.draft_params,
-                        self._ck, self._cv, self._dck, self._dcv,
-                        ids, slots, valid, temps, topks, self._seed, tick,
-                    )
-                    return np.asarray(toks), ck, cv, dck, dcv
-
-                toks, self._ck, self._cv, self._dck, self._dcv = (
-                    await self._device_call(_do_admit)
-                )
+            if self.incremental:
+                self._admit_incremental(wave, taken)
             else:
-                def _do_admit():
-                    toks, ck, cv = self._admit_fn(
-                        self.params, self._ck, self._cv, ids, slots, valid, temps,
-                        topks, self._seed, tick,
-                    )
-                    return np.asarray(toks), ck, cv
-
-                toks, self._ck, self._cv = await self._device_call(_do_admit)
-            t_wave1 = telemetry.now_ns()
-            for r, (seq, slot) in enumerate(zip(wave, taken)):
-                seq.slot = slot
-                seq.pos = self.seq_len  # the first generated token's position
-                self._slots[slot] = seq
-                self.stat_admitted += 1
-                # per-sequence spans on the ORIGINATING request's trace: the
-                # shared prefill wave dispatch, then an open generate span
-                # that accumulates tokens until retirement (TTFT rides it
-                # as an event; steps are one fused dispatch for ALL slots,
-                # so per-step attribution lives in attrs, not span-per-step)
-                for c in seq.trace_ctxs:
-                    ps = c.buf.begin(
-                        "decode.prefill",
-                        c.span.span_id,
-                        {"wave": len(wave), "bucket": bucket, "slot": slot},
-                        start_ns=t_wave0,
-                    )
-                    ps.end(t_wave1)
-                    seq.gen_spans.append(
-                        c.buf.begin(
-                            "decode.generate",
-                            c.span.span_id,
-                            {"slot": slot},
-                            start_ns=t_wave1,
-                        )
-                    )
-                self._emit(seq, int(toks[r]))
-                if self._finished(seq, int(toks[r])):
-                    self._retire(slot)
+                await self._admit_monolithic(wave, taken)
         if self._waiting:
             # whoever is STILL waiting after admission filled every free
             # slot: expire those past the queue deadline (the
@@ -630,6 +970,227 @@ class DecodeScheduler:
                         )
                     )
         self.stat_peak_active = max(self.stat_peak_active, self.active)
+
+    async def _admit_monolithic(self, wave: list[_Seq], taken: list[int]) -> None:
+        bucket = next(b for b in self.admit_buckets if b >= len(wave))
+        ids = np.zeros((bucket, self.seq_len), np.int32)
+        row_for_slot = np.zeros(self.n_slots, np.int32)
+        valid_slot = np.zeros(self.n_slots, bool)
+        temps = np.zeros(bucket, np.float32)
+        topks = np.zeros(bucket, np.int32)
+        for r, (seq, slot) in enumerate(zip(wave, taken)):
+            ids[r] = seq.prompt
+            row_for_slot[slot] = r
+            valid_slot[slot] = True
+            temps[r] = seq.temperature
+            topks[r] = seq.top_k
+        tick = self._next_tick()
+        t_wave0 = telemetry.now_ns()
+
+        if self.spec_enabled:
+            def _do_admit():
+                toks, ck, cv, dck, dcv = self._spec_admit_fn(
+                    self.params, self.draft_params,
+                    self._ck, self._cv, self._dck, self._dcv,
+                    ids, row_for_slot, valid_slot, temps, topks, self._seed, tick,
+                )
+                return np.asarray(toks), ck, cv, dck, dcv
+
+            toks, self._ck, self._cv, self._dck, self._dcv = (
+                await self._device_call(_do_admit)
+            )
+        else:
+            def _do_admit():
+                toks, ck, cv = self._admit_fn(
+                    self.params, self._ck, self._cv, ids, row_for_slot,
+                    valid_slot, temps, topks, self._seed, tick,
+                )
+                return np.asarray(toks), ck, cv
+
+            toks, self._ck, self._cv = await self._device_call(_do_admit)
+        t_wave1 = telemetry.now_ns()
+        for r, (seq, slot) in enumerate(zip(wave, taken)):
+            seq.slot = slot
+            seq.pos = self.seq_len  # the first generated token's position
+            self._slots[slot] = seq
+            self.stat_admitted += 1
+            # per-sequence spans on the ORIGINATING request's trace: the
+            # shared prefill wave dispatch, then an open generate span
+            # that accumulates tokens until retirement (TTFT rides it
+            # as an event; steps are one fused dispatch for ALL slots,
+            # so per-step attribution lives in attrs, not span-per-step)
+            for c in seq.trace_ctxs:
+                ps = c.buf.begin(
+                    "decode.prefill",
+                    c.span.span_id,
+                    {"wave": len(wave), "bucket": bucket, "slot": slot},
+                    start_ns=t_wave0,
+                )
+                ps.end(t_wave1)
+                seq.gen_spans.append(
+                    c.buf.begin(
+                        "decode.generate",
+                        c.span.span_id,
+                        {"slot": slot},
+                        start_ns=t_wave1,
+                    )
+                )
+            self._emit(seq, int(toks[r]))
+            if self._finished(seq, int(toks[r])):
+                self._retire(slot)
+
+    def _admit_incremental(self, wave: list[_Seq], taken: list[int]) -> None:
+        """Slot assignment + prefix match + ONE pool-gather dispatch; no
+        prompt compute here — the run loop's chunk rounds do that, so a
+        long wave never stalls running slots' token emission."""
+        t0 = telemetry.now_ns()
+        src = np.zeros(self.n_slots, np.int32)
+        lens = np.zeros(self.n_slots, np.int32)
+        any_hit = False
+        for seq, slot in zip(wave, taken):
+            seq.slot = slot
+            seq.prefilling = True
+            self._slots[slot] = seq
+            self.stat_admitted += 1
+            reuse = 0
+            if self.prefix_enabled:
+                entry, depth = self._prefix_index.match(seq.prompt)
+                # always leave >= 1 suffix token: the last prompt position's
+                # logits are the first generated token's distribution
+                reuse = min(depth, self.seq_len - 1)
+                if reuse > 0 and entry is not None:
+                    src[slot] = entry.row
+                    lens[slot] = reuse
+                    any_hit = True
+                    entry.refs += 1  # pinned until this slot's prefill lands
+                    seq.prefix_entry = entry
+                    self.stat_prefix_hits += 1
+                    self.stat_prefix_tokens_saved += reuse
+                    self._metrics.decode_prefix(self._deployment, True, reuse)
+                else:
+                    reuse = 0
+                    self.stat_prefix_misses += 1
+                    self._metrics.decode_prefix(self._deployment, False, 0)
+            seq.prefill_pos = reuse
+            seq.prefix_len = reuse
+            for c in seq.trace_ctxs:
+                ms = c.buf.begin(
+                    "decode.prefix_match",
+                    c.span.span_id,
+                    {"slot": slot, "hit": reuse > 0},
+                    start_ns=t0,
+                )
+                ms.add_event("reuse", {"tokens": reuse})
+                ms.end()
+        if any_hit:
+            # fused device-side gather: pool rows -> slot rows, no readback
+            self._ck, self._cv = self._gather_fn(
+                self._ck, self._cv, self._pk, self._pv, src, lens
+            )
+
+    def _draft_admit(self, slot_ids: list[int]) -> None:
+        """Draft-cache prompt prefill for slots finishing incremental
+        prefill this round, one bucketed dispatch (no readback)."""
+        bucket = next(b for b in self.admit_buckets if b >= len(slot_ids))
+        ids = np.zeros((bucket, self.seq_len), np.int32)
+        row_for_slot = np.zeros(self.n_slots, np.int32)
+        valid_slot = np.zeros(self.n_slots, bool)
+        for r, i in enumerate(slot_ids):
+            ids[r] = self._slots[i].prompt
+            row_for_slot[i] = r
+            valid_slot[i] = True
+        self._dck, self._dcv = self._draft_admit_fn(
+            self.draft_params, self._dck, self._dcv, ids, row_for_slot, valid_slot
+        )
+
+    async def _chunk_round(self) -> None:
+        """One prefill chunk round: every PREFILLING slot consumes up to
+        its per-round chunk cap of prompt tokens in one fused dispatch
+        (bucketed to the warmed chunk ladder; counts-0 slots ride without
+        cache writes). Slots whose prompt completes emit their first token
+        and transition to generating — decode steps for running slots
+        interleave between rounds instead of stalling behind a monolithic
+        wave prefill."""
+        counts = np.zeros(self.n_slots, np.int32)
+        need = 0
+        for i, seq in enumerate(self._slots):
+            if seq is None or not seq.prefilling:
+                continue
+            if seq.future.cancelled():
+                self._retire(i)
+                continue
+            rem = self.seq_len - seq.prefill_pos
+            counts[i] = min(rem, seq.chunk_cap or rem)
+            need = max(need, int(counts[i]))
+        if need == 0:
+            return
+        bucket = next(b for b in self.chunk_buckets if b >= need)
+        ids = np.zeros((self.n_slots, bucket), np.int32)
+        pos = np.zeros(self.n_slots, np.int32)
+        temps = np.zeros(self.n_slots, np.float32)
+        topks = np.zeros(self.n_slots, np.int32)
+        counts = np.minimum(counts, bucket)
+        for i, seq in enumerate(self._slots):
+            if counts[i] == 0 or seq is None:
+                continue
+            ids[i, : counts[i]] = seq.prompt[seq.prefill_pos : seq.prefill_pos + counts[i]]
+            pos[i] = seq.prefill_pos
+            temps[i] = seq.temperature
+            topks[i] = seq.top_k
+        tick = self._next_tick()
+
+        def _do_chunk():
+            toks, ck, cv = self._chunk_fn(
+                self.params, self._ck, self._cv, ids, pos, counts, temps,
+                topks, self._seed, tick,
+            )
+            return np.asarray(toks), ck, cv
+
+        t0 = telemetry.now_ns()
+        toks, self._ck, self._cv = await self._device_call(_do_chunk)
+        t1 = telemetry.now_ns()
+        self.stat_chunk_dispatches += 1
+        finishing: list[tuple[_Seq, int]] = []
+        for i, seq in enumerate(list(self._slots)):
+            if seq is None or counts[i] == 0:
+                continue
+            seq.prefill_pos += int(counts[i])
+            for c in seq.trace_ctxs:
+                cs = c.buf.begin(
+                    "decode.prefill_chunk",
+                    c.span.span_id,
+                    {
+                        "slot": i, "chunk": seq.chunk_idx,
+                        "tokens": int(counts[i]), "bucket": bucket,
+                        "reused": seq.prefix_len,
+                    },
+                    start_ns=t0,
+                )
+                cs.end(t1)
+            seq.chunk_idx += 1
+            if seq.prefill_pos >= self.seq_len:
+                finishing.append((seq, i))
+        if finishing and self.spec_enabled:
+            self._draft_admit([i for _, i in finishing])
+        t2 = telemetry.now_ns()
+        for seq, i in finishing:
+            seq.prefilling = False
+            seq.pos = self.seq_len
+            if self.prefix_enabled and seq.cache_prefix > 0:
+                # hinted capture at prefill completion — the hinted span's
+                # K/V exists from this moment, so the very next admission
+                # can already hit it
+                self._maybe_capture(seq, i, seq.cache_prefix)
+            self._unpin(seq)
+            for c in seq.trace_ctxs:
+                seq.gen_spans.append(
+                    c.buf.begin(
+                        "decode.generate", c.span.span_id, {"slot": i}, start_ns=t2
+                    )
+                )
+            self._emit(seq, int(toks[i]))
+            if self._finished(seq, int(toks[i])):
+                self._retire(i)
 
     async def _spec_round(self, toks, pos, temps, topks, limits, tick) -> None:
         """One speculative round: ONE draft dispatch proposes spec_k
@@ -666,7 +1227,9 @@ class DecodeScheduler:
         accepted = int(acc.sum())  # limit-0 and free slots contribute 0
         emitted = 0
         for i, seq in enumerate(list(self._slots)):
-            if seq is None:
+            if seq is None or seq.prefilling:
+                # prefilling slots ride the round at limit 0 with their
+                # junk landing at their own prefill cursor — no emission
                 continue
             # one decode.verify span per round on the sequence's own
             # trace(s), the accept count as an event — per-round, not
@@ -704,11 +1267,17 @@ class DecodeScheduler:
                         self._wake.clear()
                         await self._wake.wait()
                     continue
+                if self.incremental:
+                    # one prefill chunk per round, interleaved with the
+                    # decode step below — running slots keep emitting while
+                    # long prompts prefill chunk by chunk
+                    await self._chunk_round()
 
                 toks = np.zeros(self.n_slots, np.int32)
                 pos = np.zeros(self.n_slots, np.int32)
                 temps = np.zeros(self.n_slots, np.float32)
                 topks = np.zeros(self.n_slots, np.int32)
+                n_gen = 0
                 for i, seq in enumerate(self._slots):
                     if seq is None:
                         continue
@@ -717,17 +1286,30 @@ class DecodeScheduler:
                         # free the slot instead of decoding its full budget
                         self._retire(i)
                         continue
+                    if seq.prefilling:
+                        # still mid-prefill: ride the step like a free slot
+                        # but park the junk write at the slot's own prefill
+                        # cursor, where the next chunk overwrites it before
+                        # any attention mask can reach it
+                        pos[i] = seq.prefill_pos
+                        continue
                     toks[i] = seq.tokens[-1]
                     pos[i] = seq.pos
                     temps[i] = seq.temperature
                     topks[i] = seq.top_k
+                    n_gen += 1
                 if self.active == 0:
+                    continue
+                if n_gen == 0:
+                    # pure-prefill round (every occupied slot still mid-
+                    # prompt): loop straight to the next chunk round
+                    await asyncio.sleep(0)
                     continue
                 limits = None
                 if self.spec_enabled:
                     limits = np.zeros(self.n_slots, np.int32)
                     for i, seq in enumerate(self._slots):
-                        if seq is None:
+                        if seq is None or seq.prefilling:
                             continue
                         # propose at most what the remaining budget can
                         # still emit beyond the bonus token (a round emits
@@ -756,7 +1338,7 @@ class DecodeScheduler:
                 self.stat_occupancy_sum += active / self.n_slots
                 self._metrics.decode_step(self._deployment, active, self.n_slots)
                 for i, seq in enumerate(self._slots):
-                    if seq is None:
+                    if seq is None or seq.prefilling:
                         continue
                     tok = int(nxt[i])
                     seq.pos += 1
@@ -788,13 +1370,28 @@ class DecodeScheduler:
             # buffers may be invalidated, which would poison every later
             # admission with 'array has been deleted'. Reallocate so the
             # scheduler recovers (slot state above is already reset).
-            self._ck, self._cv = init_slot_cache(
-                self.params, self.n_slots, self._cache_ctx, self._dtype
+            self._ck, self._cv = self._place_like(
+                self.params,
+                init_slot_cache(self.params, self.n_slots, self._cache_ctx, self._dtype),
             )
             if self.spec_enabled:
-                self._dck, self._dcv = init_slot_cache(
-                    self.draft_params, self.n_slots, self._cache_ctx, self._dtype
+                self._dck, self._dcv = self._place_like(
+                    self.draft_params,
+                    init_slot_cache(
+                        self.draft_params, self.n_slots, self._cache_ctx, self._dtype
+                    ),
                 )
+            if self.prefix_enabled:
+                # the pool was donated into gather/capture calls too; its
+                # rows are zeroed on realloc, so the index entries pointing
+                # at them must drop with it
+                self._pk, self._pv = self._place_like(
+                    self.params,
+                    init_slot_cache(
+                        self.params, self.prefix_slots, self.prefix_ctx, self._dtype
+                    ),
+                )
+                self._prefix_index.clear()
 
     async def close(self) -> None:
         """Drain: stop accepting NEW work, finish everything in flight AND
@@ -811,11 +1408,12 @@ class DecodeScheduler:
 
     # ------------------------------------------------------ message adapter
     def request_params_from_meta(self, meta: Meta) -> dict:
-        """Per-request sampling overrides ride meta.tags (the JSON envelope's
+        """Per-request overrides ride meta.tags (the JSON envelope's
         ``meta.tags`` — no schema change for existing clients): temperature,
-        top_k, max_new_tokens, spec_k. Values clamp to the deployment's caps
-        (spec_k is tighten-only: it can reduce or disable speculation for a
-        request, never widen past decode_spec_k)."""
+        top_k, max_new_tokens, spec_k, cache_prefix, prefill_chunk. Values
+        clamp to the deployment's caps (spec_k and prefill_chunk are
+        tighten-only: a request can reduce or disable them, never widen
+        past the deployment's; cache_prefix clamps to decode_prefix_ctx)."""
         tags = meta.tags or {}
         out: dict = {}
         for key, cast in (
@@ -823,6 +1421,8 @@ class DecodeScheduler:
             ("temperature", float),
             ("top_k", int),
             ("spec_k", int),
+            ("cache_prefix", int),
+            ("prefill_chunk", int),
         ):
             if key in tags:
                 try:
@@ -951,6 +1551,9 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         queue_timeout_s=float(getattr(tpu_spec, "queue_timeout_ms", 0.0)) / 1000.0,
         draft_params=draft_params,
         spec_k=spec_k if draft_params is not None else 0,
+        prefix_slots=int(getattr(tpu_spec, "decode_prefix_slots", 0)),
+        prefix_ctx=int(getattr(tpu_spec, "decode_prefix_ctx", 0)),
+        prefill_chunk=int(getattr(tpu_spec, "decode_prefill_chunk", 0)),
         metrics=metrics,
         deployment_name=deployment_name,
         dtype=runtime.dtype,

@@ -230,6 +230,7 @@ async def _user(
     wait_range: tuple[float, float] | None,
     static_payload: bool = False,
     payload_format: str = "json",
+    payload_fn=None,
 ) -> None:
     # static_payload: generate + encode ONCE per user and re-post the same
     # bytes — large-tensor benches (images) must not measure the CLIENT's
@@ -251,6 +252,11 @@ async def _user(
             )
             nprng = np.random.default_rng(rng.randrange(2**31))
             return npy_from_array(nprng.integers(0, 256, shape, dtype=np.uint8))
+        if payload_fn is not None:
+            # caller-shaped request bodies (e.g. the soak's shared-system-
+            # prompt generative mix); varies per request, so incompatible
+            # with the static_payload fast path
+            return json.dumps(payload_fn(rng)).encode()
         return json.dumps(_make_payload(rng, batch, features)).encode()
 
     ctype = "application/x-npy" if npy else "application/json"
@@ -258,7 +264,7 @@ async def _user(
     conn = _RawHttpConn(host, port, use_tls=tls)
     pre_built: bytes | None = (
         conn.build_request("/api/v0.1/predictions", encode(), ctype, headers)
-        if static_payload
+        if static_payload and payload_fn is None
         else None
     )
     parse_body = bool(route_rewards)
@@ -333,6 +339,7 @@ async def run_load(
     seed: int = 0,
     static_payload: bool = False,
     payload_format: str = "json",
+    payload_fn=None,
 ) -> LoadStats:
     stats = LoadStats()
     # reference locust pacing: min_wait 900 / max_wait 1100 ms (~1 req/s/user);
@@ -363,6 +370,7 @@ async def run_load(
                 wait_range=wait_range,
                 static_payload=static_payload,
                 payload_format=payload_format,
+                payload_fn=payload_fn,
             )
             for i in range(users)
         )

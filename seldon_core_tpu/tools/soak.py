@@ -58,6 +58,7 @@ async def soak(
     fault_spec=None,
     trace_summary: int = 0,
     spec_k: int = 0,
+    prefix_share: float = 0.0,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -73,33 +74,41 @@ async def soak(
         "parameters": [{"name": "model", "value": model, "type": "STRING"}],
     }
     predictor_extra: dict = {}
-    if spec_k > 0:
+    generative = spec_k > 0 or prefix_share > 0
+    if generative:
         if model != "iris_mlp":
             import sys as _sys
 
             print(
-                f"soak: --spec-k overrides --model (speculative soaks run "
-                f"tiny_gpt, ignoring {model!r})",
+                f"soak: --spec-k/--prefix-share override --model (generative "
+                f"soaks run tiny_gpt, ignoring {model!r})",
                 file=_sys.stderr,
             )
-        # speculative-decoding soak: a generative deployment (prompt bucket
-        # = --features) served by the decode scheduler with a seed-shared
-        # 1-layer draft, so sustained load drives the draft + widened
-        # verify programs instead of the iris classifier. The load
-        # generator's float payloads cast to token id 0 through the ids
-        # wire policy — a fixed prompt is fine, the soak's signals are RSS
-        # slope / loop lag / error budget, not model quality.
+        # generative soak: a deployment (prompt bucket = --features) served
+        # by the decode scheduler, so sustained load drives the decode-loop
+        # programs instead of the iris classifier. --spec-k adds a
+        # seed-shared 1-layer draft (draft + widened verify programs);
+        # --prefix-share shapes the prompt mix so that fraction of requests
+        # share a system prefix, driving the prefix pool's match/gather/
+        # capture/evict cycle under load. The soak's signals are RSS slope
+        # / loop lag / error budget, not model quality.
         graph["parameters"] = [
             {"name": "model", "value": "tiny_gpt", "type": "STRING"},
             {"name": "seq", "value": str(features), "type": "INT"},
             {"name": "max_new_tokens", "value": "16", "type": "INT"},
             {"name": "resid_scale", "value": "0.1", "type": "FLOAT"},
         ]
-        predictor_extra["tpu"] = {
-            "decode_slots": 4,
-            "decode_spec_k": spec_k,
-            "decode_draft_model": "zoo://draft?layers=1&resid_scale=0.1",
-        }
+        predictor_extra["tpu"] = {"decode_slots": 4}
+        if spec_k > 0:
+            predictor_extra["tpu"].update(
+                decode_spec_k=spec_k,
+                decode_draft_model="zoo://draft?layers=1&resid_scale=0.1",
+            )
+        if prefix_share > 0:
+            predictor_extra["tpu"].update(
+                decode_prefix_slots=8,
+                decode_prefill_chunk=max(1, features // 4),
+            )
     if fault_spec is not None:
         # the faulted leg exercises the resilience layer end-to-end: the
         # model node gets a retry policy (absorbing injected transport
@@ -160,6 +169,27 @@ async def soak(
             rss_samples.append((time.perf_counter(), _rss_mb()))
             lag_samples.append(window_max_lag * 1e3)
 
+    payload_fn = None
+    if prefix_share > 0:
+        # prompt mix: `prefix_share` of requests open with a fixed system
+        # prefix (half the prompt bucket) + a random tail, the rest are
+        # fully random — retiring slots auto-capture full prompts, and the
+        # radix index's longest-common-prefix match turns ANY captured
+        # sharer into a hit for the next one; the random tails churn the
+        # LRU pool so eviction runs under load too
+        shared_len = max(1, features // 2)
+        system_prefix = [7] * shared_len
+
+        def payload_fn(rng):
+            def tail(n):
+                return [rng.randrange(64) for _ in range(n)]
+
+            if rng.random() < prefix_share:
+                prompt = system_prefix + tail(features - shared_len)
+            else:
+                prompt = tail(features)
+            return {"data": {"ndarray": [prompt] * batch}}
+
     sampler_task = asyncio.ensure_future(sampler())
     try:
         stats = await run_load(
@@ -171,6 +201,7 @@ async def soak(
             oauth_key="soak-key",
             oauth_secret="soak-secret",
             static_payload=True,
+            payload_fn=payload_fn,
         )
     finally:
         stop.set()
@@ -227,10 +258,22 @@ async def soak(
             ),
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    prefix_stats = None
+    if prefix_share > 0 and sched is not None:
+        lookups = sched.stat_prefix_hits + sched.stat_prefix_misses
+        prefix_stats = {
+            "prefix_share": prefix_share,
+            "hit_rate": round(sched.stat_prefix_hits / max(lookups, 1), 3),
+            "prefill_tokens_saved": sched.stat_prefix_tokens_saved,
+            "captures": sched.stat_prefix_captures,
+            "evictions": sched.stat_prefix_evictions,
+            "chunk_dispatches": sched.stat_chunk_dispatches,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
     return {
         "duration_s": duration_s,
         "users": users,
-        "model": "tiny_gpt" if spec_k > 0 else model,
+        "model": "tiny_gpt" if generative else model,
         "preds_per_sec": round(s["requests_per_sec"] * batch, 2),
         "p99_ms": s["p99_ms"],
         "errors": s["errors"],
@@ -256,6 +299,7 @@ async def soak(
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
         **({"spec": spec_stats} if spec_stats is not None else {}),
+        **({"prefix": prefix_stats} if prefix_stats is not None else {}),
     }
 
 
@@ -291,6 +335,15 @@ def main(argv=None) -> None:
         "speculative decoding (k proposals per dispatch); the report gains "
         "accept_rate / tokens_per_dispatch under 'spec'",
     )
+    ap.add_argument(
+        "--prefix-share",
+        type=float,
+        default=0.0,
+        help="run the soak against a generative deployment with the prefix "
+        "cache enabled and shape the prompt mix so this fraction of requests "
+        "share a system prefix; the report gains hit_rate / tokens_saved / "
+        "evictions under 'prefix'",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -313,6 +366,7 @@ def main(argv=None) -> None:
                 fault_spec=fault_spec,
                 trace_summary=args.trace_summary,
                 spec_k=args.spec_k,
+                prefix_share=args.prefix_share,
             )
         )
 

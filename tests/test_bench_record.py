@@ -143,6 +143,27 @@ def worst_case_full_record() -> dict:
             "ttft_p50_ms": 3279.11,
             "ttft_p99_ms": 4411.92,
         },
+        "prefix": {
+            "scenario": {
+                "requests": 24, "seq": 64, "shared_prefix": 56,
+                "prefix_slots": 8, "chunk": 8, "max_new": 8,
+            },
+            "monolithic": {
+                "tokens_per_sec": 1411.02, "ttft_cold_p50_ms": 171.33,
+                "ttft_warm_p50_ms": 41.27, "ttft_warm_p99_ms": 88.19,
+                "inter_token_p99_ms": 44.91, "hit_rate": 0.958,
+                "prefill_tokens_saved": 1288, "chunk_dispatches": 25,
+                "recompiles_after_warmup": 0,
+            },
+            "chunked": {
+                "tokens_per_sec": 1389.77, "ttft_cold_p50_ms": 183.41,
+                "ttft_warm_p50_ms": 44.02, "ttft_warm_p99_ms": 91.33,
+                "inter_token_p99_ms": 21.08, "hit_rate": 0.958,
+                "prefill_tokens_saved": 1288, "chunk_dispatches": 41,
+                "recompiles_after_warmup": 0,
+            },
+            "warm_ttft_speedup": 4.15,
+        },
         "tokens_per_sec_speedup": 2.64,
         "spec_tokens_per_sec_speedup": 1.71,
     }
@@ -244,6 +265,17 @@ def test_compact_record_carries_every_headline():
         "tok_disp": 4.31,
         "spec_speedup": 1.71,
         "spec_k": 4,
+        # prefix-cache sub-leg: cold/warm TTFT split, hit rate, prefill
+        # tokens displaced, tokens/s + ITL with chunking off/on
+        "prefix_cold_ttft": 171.33,
+        "prefix_warm_ttft": 41.27,
+        "prefix_ttft_speedup": 4.15,
+        "prefix_hit_rate": 0.958,
+        "prefix_saved_tok": 1288,
+        "prefix_tok_s": 1411.02,
+        "prefix_tok_s_chunked": 1389.77,
+        "prefix_itl_p99": 44.91,
+        "prefix_itl_p99_chunked": 21.08,
     }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77
