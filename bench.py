@@ -1109,14 +1109,123 @@ def serving_gen_cpu(
             await server.batcher.close()
         return out, np.stack(outs)
 
+    def _paged_pred(page_budget: int, kv_dtype: str = ""):
+        """The paged sub-leg's deployment: the prefix-leg geometry (seq 64,
+        56-token shared system prompt, max_new 16 -> 5 pages of 16) under
+        an EXPLICIT page budget sized so the flat layout could hold only
+        page_budget*16/80 slots in the same KV bytes — the capacity claim
+        under measurement."""
+        tpu = {
+            "max_batch": n_slots,
+            "batch_buckets": [n_slots],
+            "batch_timeout_ms": 4.0,
+            "queue_timeout_ms": 120000.0,
+            "decode_slots": n_slots,
+            "decode_prefix_slots": 8,
+            "decode_prefill_chunk": 16,  # page-aligned chunk rounds
+            "decode_kv_page_size": 16,
+            "decode_kv_pages": page_budget,
+        }
+        if kv_dtype:
+            tpu["decode_kv_dtype"] = kv_dtype
+        return _graph_predictor(
+            {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": "64", "type": "INT"},
+                    {"name": "max_new_tokens", "value": "16", "type": "INT"},
+                    {"name": "vocab", "value": str(vocab), "type": "INT"},
+                    {"name": "hidden", "value": "256", "type": "INT"},
+                    {"name": "layers", "value": "4", "type": "INT"},
+                    {"name": "ffn", "value": "1024", "type": "INT"},
+                    {"name": "max_len", "value": "80", "type": "INT"},
+                ],
+            },
+            tpu,
+        )
+
+    async def run_paged(kv_dtype: str = "") -> dict:
+        """gen.paged_*: max concurrent slots at a FIXED page budget, paged
+        vs flat-equivalent, plus the sharing/CoW/reclaim attribution. The
+        seed request pins the 56-token system prompt's pages; every
+        follower maps 3 of its 5 pages copy-free, so the budget that would
+        flat-hold 4 slots sustains all 8 — shared pages are counted once.
+        fp mode asserts outputs against the prefix leg's (same geometry,
+        same greedy contract); int8 records throughput + occupancy only
+        (tolerance contract, tests/test_kv_pool.py)."""
+        page_budget = 1 + 4 + n_slots * 2  # junk + pinned prefix + tails
+        server = PredictorServer(
+            _paged_pred(page_budget, kv_dtype),
+            deployment_name=f"gen-paged{kv_dtype and '-' + kv_dtype}",
+        )
+        server.warmup()
+        rec = _LatencyRecorder()
+        ttft_cold: list[float] = []
+        ttft_warm: list[float] = []
+        rec.decode_ttft_split = lambda d, s, path: (
+            ttft_warm if path == "warm" else ttft_cold
+        ).append(s)
+        sched = server.decode_scheduler
+        sched._metrics = rec
+        t0 = time.perf_counter()
+        seed_msg = SeldonMessage.from_array(
+            p_prompts[:1], meta=Meta(tags={"max_new_tokens": 8, "cache_prefix": 56})
+        )
+        outs = [np.asarray((await server.service.predict(seed_msg)).array)[0]]
+
+        async def one(i: int):
+            msg = SeldonMessage.from_array(
+                p_prompts[i : i + 1], meta=Meta(tags={"max_new_tokens": 8})
+            )
+            out = await server.service.predict(msg)
+            return np.asarray(out.array)[0]
+
+        outs += list(await asyncio.gather(*(one(i) for i in range(1, p_requests))))
+        elapsed = time.perf_counter() - t0
+        a = sched.pool.alloc
+        flat_equiv = (page_budget * 16) // 80
+        out = {
+            "page_size": 16,
+            "page_budget": page_budget,
+            "kv_dtype": kv_dtype or "float32",
+            "tokens_per_sec": round(8 * p_requests / elapsed, 2),
+            "peak_slots": sched.stat_peak_active,
+            "flat_equiv_slots": flat_equiv,
+            "slots_vs_flat": round(sched.stat_peak_active / max(flat_equiv, 1), 2),
+            "pages_shared": a.stat_pages_shared,
+            "cow_copies": a.stat_cow_copies,
+            "pins_reclaimed": a.stat_pin_reclaims,
+            "prefix_hit_rate": round(
+                sched.stat_prefix_hits
+                / max(sched.stat_prefix_hits + sched.stat_prefix_misses, 1),
+                3,
+            ),
+            "ttft_cold_p50_ms": _pct(ttft_cold, 50),
+            "ttft_warm_p50_ms": _pct(ttft_warm, 50),
+            "admit_blocked_rounds": sched.stat_admit_blocked_rounds,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
+        await sched.close()
+        if server.batcher is not None:
+            await server.batcher.close()
+        return out, np.stack(outs)
+
     sched = asyncio.run(run_scheduler())
     spec = asyncio.run(run_scheduler(spec=True))
     scan = asyncio.run(run_scan())
     prefix_mono, prefix_mono_out = asyncio.run(run_prefix(0))
     prefix_chunked, prefix_chunked_out = asyncio.run(run_prefix(8))
+    paged, paged_out = asyncio.run(run_paged())
+    paged_int8, _ = asyncio.run(run_paged("int8"))
     # greedy outputs must be identical across chunked/monolithic prefill
     # and warm/cold admissions (the bit-equivalence the tests pin)
     assert np.array_equal(prefix_mono_out, prefix_chunked_out), "prefix path diverged"
+    # the fp paged run rides the same geometry/greedy contract: outputs
+    # must be token-identical to the prefix leg's (int8 is tolerance-only)
+    assert np.array_equal(paged_out, prefix_mono_out), "paged path diverged"
     prefix = {
         "scenario": {
             "requests": p_requests, "seq": p_seq, "shared_prefix": p_prefix,
@@ -1156,6 +1265,14 @@ def serving_gen_cpu(
         "spec": spec,
         "scan": scan,
         "prefix": prefix,
+        "paged": {
+            "scenario": {
+                "requests": p_requests, "seq": p_seq, "shared_prefix": p_prefix,
+                "max_new": 8, "n_slots": n_slots,
+            },
+            "fp": paged,
+            "int8": paged_int8,
+        },
         "tokens_per_sec_speedup": speedup,
         "spec_tokens_per_sec_speedup": spec_speedup,
     }
@@ -1630,6 +1747,18 @@ def compact_record(full: dict) -> dict:
             c["gen"]["prefix_tok_s_chunked"] = gc.get("tokens_per_sec")
             c["gen"]["prefix_itl_p99"] = gm.get("inter_token_p99_ms")
             c["gen"]["prefix_itl_p99_chunked"] = gc.get("inter_token_p99_ms")
+        gpp = gen.get("paged") or {}
+        if gpp:
+            gf = gpp.get("fp") or {}
+            g8 = gpp.get("int8") or {}
+            c["gen"]["paged_budget"] = gf.get("page_budget")
+            c["gen"]["paged_peak_slots"] = gf.get("peak_slots")
+            c["gen"]["paged_flat_equiv"] = gf.get("flat_equiv_slots")
+            c["gen"]["paged_slots_vs_flat"] = gf.get("slots_vs_flat")
+            c["gen"]["paged_pages_shared"] = gf.get("pages_shared")
+            c["gen"]["paged_cow"] = gf.get("cow_copies")
+            c["gen"]["paged_tok_s"] = gf.get("tokens_per_sec")
+            c["gen"]["paged_int8_tok_s"] = g8.get("tokens_per_sec")
     pallas = srv.get("pallas_long_seq") or {}
     if pallas:
         # named scalars only (a verbatim passthrough could silently eat the

@@ -303,6 +303,26 @@ class TpuSpec(_Spec):
     # prompt waves no longer stall running slots' inter-token latency.
     # Requests may tighten (never widen) it via meta.tags["prefill_chunk"].
     decode_prefill_chunk: int = 0
+    # Paged KV memory (serving/kv_pool.py): the decode scheduler's K/V
+    # lives in a device page pool shared by live slots and the prefix
+    # cache, gathered through per-slot block tables.
+    # decode_kv_page_size: tokens per page (0 = auto, 16). With an
+    # explicit page size, decode_prefill_chunk must be page-aligned (a
+    # multiple of it) so chunk rounds land on page boundaries.
+    decode_kv_page_size: int = 0
+    # decode_kv_pages: total page budget (0 = auto: flat-equivalent —
+    # every slot can hold its full context with zero sharing). An explicit
+    # budget is where paging pays: shared system-prompt pages are counted
+    # once pool-wide, so more slots fit the same HBM; admission throttles
+    # on a reservation invariant instead of deadlocking, and a budget too
+    # small for even one slot's residency is rejected up front.
+    decode_kv_pages: int = 0
+    # decode_kv_dtype: "int8" stores the pool quantized (per-page-row
+    # scale/zero-point, dequant fused into the attention gather) for
+    # roughly half the KV bytes per token; greedy output is then
+    # tolerance-close, not bit-identical, to the fp pool. "" keeps the
+    # computation dtype.
+    decode_kv_dtype: str = ""
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

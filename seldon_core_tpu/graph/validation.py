@@ -83,9 +83,60 @@ def validate_deployment(dep: SeldonDeployment) -> None:
             problems.append(f"predictor '{pred.name}' batch_buckets must be ascending")
         if pred.tpu.dtype not in ("float32", "bfloat16", "float16"):
             problems.append(f"predictor '{pred.name}' dtype '{pred.tpu.dtype}' unsupported")
-        for knob in ("decode_prefix_slots", "decode_prefix_ctx", "decode_prefill_chunk"):
+        for knob in (
+            "decode_prefix_slots",
+            "decode_prefix_ctx",
+            "decode_prefill_chunk",
+            "decode_kv_page_size",
+            "decode_kv_pages",
+        ):
             if getattr(pred.tpu, knob) < 0:
                 problems.append(f"predictor '{pred.name}' {knob} must be >= 0")
+        if pred.tpu.decode_kv_dtype not in ("", "int8"):
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_dtype "
+                f"'{pred.tpu.decode_kv_dtype}' unsupported (want '' or 'int8')"
+            )
+        if (
+            pred.tpu.decode_kv_page_size > 0
+            or pred.tpu.decode_kv_pages > 0
+            or pred.tpu.decode_kv_dtype
+        ) and pred.tpu.decode_slots <= 0:
+            # the paged-KV knobs configure the continuous-batching
+            # scheduler's pool; without it they would be silently ignored
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_page_size/decode_kv_pages/"
+                "decode_kv_dtype need decode_slots > 0 (the continuous-"
+                "batching scheduler)"
+            )
+        if (
+            pred.tpu.decode_kv_page_size > 0
+            and pred.tpu.decode_prefill_chunk > 0
+            and pred.tpu.decode_prefill_chunk % pred.tpu.decode_kv_page_size != 0
+        ):
+            # page-aligned chunk rounds: every chunk boundary lands on a
+            # page boundary, so chunked prefill never copy-on-writes its
+            # own half-written page mid-prompt
+            problems.append(
+                f"predictor '{pred.name}' decode_prefill_chunk "
+                f"({pred.tpu.decode_prefill_chunk}) must be a multiple of "
+                f"decode_kv_page_size ({pred.tpu.decode_kv_page_size})"
+            )
+        if (
+            pred.tpu.decode_kv_pages > 0
+            and pred.tpu.decode_kv_pages < pred.tpu.decode_slots + 1
+        ):
+            # static half of the minimal-residency check (the scheduler
+            # re-checks against the actual context geometry at build):
+            # fewer pages than slots (+ the junk sink) can never reach the
+            # configured concurrency — admission would starve, not deadlock,
+            # but the config is unservable as asked
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_pages "
+                f"({pred.tpu.decode_kv_pages}) is below decode_slots + 1 "
+                f"({pred.tpu.decode_slots + 1}) — the page budget cannot "
+                "host the configured concurrency"
+            )
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

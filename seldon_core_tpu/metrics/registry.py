@@ -98,6 +98,28 @@ class NullMetrics:
         cache exists to move. Only emitted when the cache is enabled."""
         pass
 
+    # paged KV pool (serving/kv_pool.py): page occupancy by class, and the
+    # three event streams that explain it — copy-free shares at admission,
+    # copy-on-write page copies, and LRU reclaim of prefix pins
+    def decode_kv_pool(self, deployment: str, free: int, live: int, prefix: int) -> None:
+        """Pool occupancy gauges: ``free`` unallocated pages, ``live``
+        pages referenced by at least one slot, ``prefix`` pages held only
+        by prefix-cache pins (the reclaimable set)."""
+        pass
+
+    def decode_kv_shared(self, deployment: str, pages: int) -> None:
+        """One prefix-hit admission mapped ``pages`` pool pages copy-free."""
+        pass
+
+    def decode_kv_cow(self, deployment: str, copies: int) -> None:
+        """One scheduler round dispatched ``copies`` copy-on-write page
+        copies (first divergent writes into shared pages)."""
+        pass
+
+    def decode_kv_reclaimed(self, deployment: str, pins: int) -> None:
+        """Pool pressure reclaimed ``pins`` LRU prefix pins."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -282,6 +304,43 @@ class Metrics(NullMetrics):
             ["deployment_name"],
             registry=registry,
         )
+        # paged KV pool: page occupancy by class + share/CoW/reclaim events
+        self._kv_pages_free = Gauge(
+            "seldon_tpu_decode_kv_pages_free",
+            "Unallocated pages in the decode KV page pool",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._kv_pages_live = Gauge(
+            "seldon_tpu_decode_kv_pages_live",
+            "KV pool pages referenced by at least one live decode slot",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._kv_pages_prefix = Gauge(
+            "seldon_tpu_decode_kv_pages_prefix",
+            "KV pool pages held only by prefix-cache pins (reclaimable)",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._kv_shared = Counter(
+            "seldon_tpu_decode_kv_pages_shared_total",
+            "Pool pages mapped copy-free into admitted slots off prefix hits",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._kv_cow = Counter(
+            "seldon_tpu_decode_kv_cow_copies_total",
+            "Copy-on-write page copies (first divergent write into a shared page)",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._kv_reclaimed = Counter(
+            "seldon_tpu_decode_kv_pins_reclaimed_total",
+            "Prefix pins reclaimed LRU-first under pool allocation pressure",
+            ["deployment_name"],
+            registry=registry,
+        )
         self._decode_ttft_split = Histogram(
             "seldon_tpu_decode_ttft_split_seconds",
             "TTFT split by admission path (warm = prefix hit, cold = full prefill)",
@@ -397,6 +456,23 @@ class Metrics(NullMetrics):
 
     def decode_ttft_split(self, deployment, duration_s, path):
         self._decode_ttft_split.labels(deployment, path).observe(duration_s)
+
+    def decode_kv_pool(self, deployment, free, live, prefix):
+        self._kv_pages_free.labels(deployment).set(free)
+        self._kv_pages_live.labels(deployment).set(live)
+        self._kv_pages_prefix.labels(deployment).set(prefix)
+
+    def decode_kv_shared(self, deployment, pages):
+        if pages > 0:
+            self._kv_shared.labels(deployment).inc(pages)
+
+    def decode_kv_cow(self, deployment, copies):
+        if copies > 0:
+            self._kv_cow.labels(deployment).inc(copies)
+
+    def decode_kv_reclaimed(self, deployment, pins):
+        if pins > 0:
+            self._kv_reclaimed.labels(deployment).inc(pins)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

@@ -539,6 +539,215 @@ def chunk_prefill(
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
+# ------------------------------------------------------------- paged KV
+# Block-table KV memory (serving/kv_pool.py owns the allocator): instead of
+# one contiguous [L, n_slots, h, max_ctx, hd] row per slot, K/V lives in a
+# shared page pool [L, n_pages, h, page_size, hd] and each slot carries a
+# static-shape block table [max_pages] of physical page ids. The attention
+# building blocks below mirror decode_step / verify_step / chunk_prefill
+# exactly — same masks, same einsums, same f32 accumulation — but read the
+# cache through a pool gather and write through a per-token page/offset
+# scatter, so two slots sharing a system prompt REFERENCE the same pages
+# (vLLM's PagedAttention memory model) instead of each holding a copy.
+#
+# Conventions the scheduler relies on:
+# - physical page 0 is a reserved junk sink: free slots' block tables are
+#   all-zero and masked-off writes (beyond a slot's chunk count, past the
+#   virtual length) are redirected there, so a static-shape dispatch can
+#   never corrupt a live page;
+# - the gathered virtual cache is [max_pages * page_size] long; positions
+#   beyond a query's own position contribute exactly zero attention mass
+#   (the same -1e30 masking the flat path uses), so greedy output stays
+#   bit-identical to the contiguous layout and the scan oracle;
+# - pool state is a flat tuple pytree: (k, v) in fp mode, or
+#   (k_q, k_scale, k_zp, v_q, v_scale, v_zp) with int8 payloads and ONE
+#   (scale, zero-point) pair per page row (= per cached token, shared
+#   across heads) stored page-resident beside the payload — copy-on-write
+#   and sharing move the scales with their page, and dequantization fuses
+#   into the attention gather.
+
+
+def paged_kv_init(
+    params: dict, n_pages: int, page_size: int, dtype=jnp.float32, kv_dtype: str = ""
+) -> tuple:
+    """Zeroed page pool state tuple (see module comment for the layout)."""
+    d = decoder_dims(params)
+    shape = (d["layers"], n_pages, d["heads"], page_size, d["head_dim"])
+    if kv_dtype == "int8":
+        sshape = (d["layers"], n_pages, page_size)
+        # scale 1 / zp 0: dequantized junk pages read back as exact zeros,
+        # matching the fp pool's init
+        return (
+            jnp.zeros(shape, jnp.int8),
+            jnp.ones(sshape, jnp.float32),
+            jnp.zeros(sshape, jnp.float32),
+            jnp.zeros(shape, jnp.int8),
+            jnp.ones(sshape, jnp.float32),
+            jnp.zeros(sshape, jnp.float32),
+        )
+    if kv_dtype:
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} (want '' or 'int8')")
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def paged_copy(pool: tuple, src: jax.Array, dst: jax.Array) -> tuple:
+    """Copy pool pages src[i] -> dst[i] across every state component (the
+    copy-on-write primitive). Padding entries use src=dst=0: page 0 is the
+    junk sink, so rewriting it with its own bytes is a no-op by design."""
+    return tuple(a.at[:, dst].set(jnp.take(a, src, axis=1)) for a in pool)
+
+
+def _quant_rows(x: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-row asymmetric int8: x[N, h, hd] -> (q[N, h, hd] int8, scale[N],
+    zp[N]) with q = round((x - zp) / scale) in [-127, 127]."""
+    lo = jnp.min(x, axis=(1, 2))
+    hi = jnp.max(x, axis=(1, 2))
+    zp = (hi + lo) * 0.5
+    scale = jnp.maximum((hi - lo) / 254.0, 1e-8)
+    q = jnp.clip(
+        jnp.round((x - zp[:, None, None]) / scale[:, None, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale, zp
+
+
+def _paged_write(kv: tuple, k, v, bt, positions, counts):
+    """Scatter the dispatch's new K/V (k, v: [n, h, m, hd], slot i's entry j
+    at positions[i] + j) into the per-layer pool slices through the block
+    tables. Invalid entries — beyond counts[i], or past the virtual length
+    — are redirected to junk page 0 instead of masked in place, which is
+    what lets free/prefilling slots ride static-shape dispatches without
+    owning writable pages."""
+    n, h, m, hd = k.shape
+    ps = kv[0].shape[2]
+    n_log = bt.shape[1]
+    gp = positions[:, None] + jnp.arange(m)[None, :]  # [n, m] global positions
+    lp = jnp.clip(gp // ps, 0, n_log - 1)
+    phys = jnp.take_along_axis(bt, lp, axis=1)  # [n, m] physical pages
+    ok = (gp >= 0) & (gp < n_log * ps)
+    if counts is not None:
+        ok = ok & (jnp.arange(m)[None, :] < counts[:, None])
+    phys = jnp.where(ok, phys, 0)
+    pf = phys.reshape(-1)
+    of = (gp % ps).reshape(-1)
+    kt = k.transpose(0, 2, 1, 3).reshape(n * m, h, hd)  # per-token rows
+    vt = v.transpose(0, 2, 1, 3).reshape(n * m, h, hd)
+    if len(kv) == 2:
+        pk, pv = kv
+        return (
+            pk.at[pf, :, of, :].set(kt.astype(pk.dtype)),
+            pv.at[pf, :, of, :].set(vt.astype(pv.dtype)),
+        )
+    kq, sk, zk, vq, sv, zv = kv
+    qk, sck, zpk = _quant_rows(kt.astype(jnp.float32))
+    qv, scv, zpv = _quant_rows(vt.astype(jnp.float32))
+    return (
+        kq.at[pf, :, of, :].set(qk),
+        sk.at[pf, of].set(sck),
+        zk.at[pf, of].set(zpk),
+        vq.at[pf, :, of, :].set(qv),
+        sv.at[pf, of].set(scv),
+        zv.at[pf, of].set(zpv),
+    )
+
+
+def _paged_gather(kv: tuple, bt) -> tuple[jax.Array, jax.Array]:
+    """Gather each slot's pages into a virtual contiguous cache
+    [n, h, max_pages * page_size, hd] in f32 (the flat path's attention
+    accumulation dtype). int8 mode fuses the per-page-row dequant here."""
+    if len(kv) == 2:
+        k = jnp.take(kv[0], bt, axis=0).astype(jnp.float32)  # [n, P, h, ps, hd]
+        v = jnp.take(kv[1], bt, axis=0).astype(jnp.float32)
+    else:
+        kq, sk, zk, vq, sv, zv = kv
+        k = jnp.take(kq, bt, axis=0).astype(jnp.float32)
+        v = jnp.take(vq, bt, axis=0).astype(jnp.float32)
+        k = k * jnp.take(sk, bt, axis=0)[:, :, None, :, None] + jnp.take(
+            zk, bt, axis=0
+        )[:, :, None, :, None]
+        v = v * jnp.take(sv, bt, axis=0)[:, :, None, :, None] + jnp.take(
+            zv, bt, axis=0
+        )[:, :, None, :, None]
+    n, p, h, ps, hd = k.shape
+    k = k.transpose(0, 2, 1, 3, 4).reshape(n, h, p * ps, hd)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(n, h, p * ps, hd)
+    return k, v
+
+
+def _layer_step_paged(p, x, kv, bt, positions, h, counts=None):
+    """_layer_step_slots reworked onto the page pool: same math, but the
+    new K/V scatters through the block tables first and attention reads
+    the pool back through a page gather (so in-dispatch queries see the
+    keys earlier queries of the same dispatch just wrote, exactly like the
+    flat path's write-then-read). Returns (x_out, new per-layer kv)."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, h)  # [n, h, m, hd]
+    k = _split_heads(k, h)
+    v = _split_heads(v, h)
+    kv = _paged_write(kv, k, v, bt, positions, counts)
+    cache_k, cache_v = _paged_gather(kv, bt)  # f32 virtual caches
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("nhqd,nhkd->nhqk", q.astype(jnp.float32), cache_k) * scale
+    m = x.shape[1]
+    q_pos = positions[:, None] + jnp.arange(m)[None, :]  # [n, m]
+    valid = jnp.arange(cache_k.shape[2])[None, None, :] <= q_pos[:, :, None]
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
+    p_attn = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("nhqk,nhkd->nhqd", p_attn, cache_v)
+    ctx = _merge_heads(ctx.astype(x.dtype))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, kv
+
+
+def _paged_forward(params, pool, bt, tokens, positions, counts=None):
+    """Shared body of the paged decode/verify/chunk programs: tokens[n, m]
+    with slot i's query j at positions[i] + j; returns (logits[n, m, vocab],
+    new pool state). Junk queries clip the position table like the flat
+    verify/chunk paths — their logits are never read and their writes are
+    junk-redirected."""
+    heads = _heads(params)
+    m = tokens.shape[1]
+    max_len = params["pos_emb"].shape[0]
+    x = jnp.asarray(params["tok_emb"])[tokens]  # [n, m, d]
+    pidx = jnp.clip(positions[:, None] + jnp.arange(m)[None, :], 0, max_len - 1)
+    x = x + jnp.asarray(params["pos_emb"])[pidx]
+    per_comp: list[list] = [[] for _ in pool]
+    for li, lp in enumerate(params["layers"]):
+        layer_kv = tuple(a[li] for a in pool)
+        x, layer_kv = _layer_step_paged(lp, x, layer_kv, bt, positions, heads, counts)
+        for acc, a in zip(per_comp, layer_kv):
+            acc.append(a)
+    logits = _logits(params, x)  # [n, m, vocab]
+    return logits, tuple(jnp.stack(acc) for acc in per_comp)
+
+
+def paged_decode_step(params, pool, bt, tokens, positions):
+    """decode_step over the page pool: consume tokens[n] at positions[n],
+    return (logits[n, vocab], pool) — K/V written through block tables."""
+    logits, pool = _paged_forward(params, pool, bt, tokens[:, None], positions)
+    return logits[:, 0, :], pool
+
+
+def paged_verify_step(params, pool, bt, tokens, positions):
+    """verify_step over the page pool: m queries per slot, logits[i, j]
+    scored AFTER consuming query j — the widened speculative verify."""
+    return _paged_forward(params, pool, bt, tokens, positions)
+
+
+def paged_chunk_prefill(params, pool, bt, tokens, positions, counts):
+    """chunk_prefill over the page pool: persist only the first counts[i]
+    K/V entries per slot (counts-0 slots ride the static-shape dispatch
+    with their writes junk-redirected, touching no live page)."""
+    return _paged_forward(params, pool, bt, tokens, positions, counts)
+
+
 def draft_propose(
     params: dict,
     cache_k: jax.Array,

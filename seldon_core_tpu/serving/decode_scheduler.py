@@ -5,26 +5,38 @@ to completion: a request arriving mid-generation waits for the previous
 generation to finish (head-of-line blocking), every sequence pays
 ``max_new_tokens`` steps even after it stops, and clients see nothing until
 the last token lands. This module brings Orca-style iteration-level
-scheduling and a vLLM-style slot KV cache into the stack:
+scheduling over a vLLM-style PAGED KV pool into the stack:
 
-- ONE compiled per-step program (``decode_step``) runs over a static-shape
-  slot cache ``[layers, n_slots, heads, max_ctx, head_dim]``; slots are
-  assigned per sequence and freed on completion.
+- ONE compiled per-step program (``paged_decode_step``) runs over the
+  shared page pool through static-shape ``[n_slots, max_pages]`` block
+  tables; slots are assigned per sequence and freed on completion.
 - Between steps the scheduler admits newly-arrived prefilled sequences into
   free slots and retires finished ones (EOS or per-request
   ``max_new_tokens``), so batch composition changes at STEP boundaries with
   zero recompiles — active-slot masking, never shape changes.
 - Tokens stream to the caller as they are chosen (``on_token``), which is
   what the fast ingress's SSE endpoint forwards to clients.
+- Paged KV memory (serving/kv_pool.py): K/V lives in ONE device-resident
+  page pool ``[L, n_pages, h, page_size, hd]`` shared by live slots and
+  the prefix cache; each slot carries a static-shape block table and the
+  attention programs gather through it (vLLM's PagedAttention memory
+  model). Slot memory stops being ``n_slots * max_ctx`` worst-case: a
+  host-side allocator tracks per-page refcounts, copies-on-write at the
+  first divergent write into a shared page, reclaims unreferenced prefix
+  pages LRU-first, and admits sequences against a reservation invariant
+  instead of deadlocking when an explicit ``tpu.decode_kv_pages`` budget
+  runs tight. ``tpu.decode_kv_dtype: int8`` stores the pool quantized
+  (per-page-row scale/zero-point, dequant fused into the gather) for
+  roughly double the effective capacity again.
 - Prefix-cache KV reuse (``tpu.decode_prefix_slots``): a host-side radix
-  index over prompt token prefixes backed by a device-resident, ref-
-  counted, LRU-evicted prefix pool ``[L, n_prefix, h, prefix_ctx, hd]``.
-  On admit the longest indexed prefix is copied into the slot with ONE
-  fused device-side gather (no host readback) and only the uncovered
-  suffix is prefilled — the RadixAttention observation that shared system
-  prompts dominate real chat/agent traffic, applied to the slot cache.
-  The pool is populated from retiring slots (full prompt) and explicit
-  ``meta.tags.cache_prefix`` hints (at prefill completion).
+  index over prompt token prefixes whose entries REFERENCE pool pages.
+  On admit the longest match maps the shared pages into the reader's
+  block table (refcount bump — copy-free; the old gather-copy is gone)
+  and only the uncovered suffix is prefilled — the RadixAttention
+  observation that shared system prompts dominate real chat/agent
+  traffic. Entries are captured from retiring slots (full prompt) and
+  explicit ``meta.tags.cache_prefix`` hints (at prefill completion) by
+  pinning the pages in place.
 - Chunked prefill (``tpu.decode_prefill_chunk``): prompt suffixes are
   computed in fixed-size chunk buckets interleaved with decode steps
   (Sarathi-style), so a long admission wave no longer stalls every
@@ -69,46 +81,45 @@ from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu import telemetry
 from seldon_core_tpu.models.decoder import (
-    chunk_prefill,
-    decode_step,
     decoder_dims,
     draft_propose,
     init_slot_cache,
+    paged_chunk_prefill,
+    paged_decode_step,
+    paged_verify_step,
     prefill,
     sample_tokens,
     speculative_accept,
-    verify_step,
 )
+from seldon_core_tpu.serving.kv_pool import PagedKVPool
 
 log = logging.getLogger(__name__)
 
 OnToken = Callable[[int, int], None]  # (token_id, index-within-generation)
 
 
-def _fused_step(params, cache_k, cache_v, tokens, positions, temps, topks, seed, tick):
-    """One device program per scheduler step: decode_step + sampling + key
-    derivation fused into a single dispatch. Per-step host->device traffic
-    is four tiny vectors and the readback one [n_slots] int32 — the
-    per-step floor is ONE dispatch, not three (matters doubly when each
-    dispatch is a network RTT on the tunnel harness). ``tick`` is a traced
-    scalar, so the per-step RNG key needs no host-side split and the
-    program never recompiles."""
-    logits, cache_k, cache_v = decode_step(params, cache_k, cache_v, tokens, positions)
+def _fused_step(params, pool, bt, tokens, positions, temps, topks, seed, tick):
+    """One device program per scheduler step: paged decode_step + sampling
+    + key derivation fused into a single dispatch. Per-step host->device
+    traffic is the block tables plus four tiny vectors, and the readback
+    one [n_slots] int32 — the per-step floor is ONE dispatch, not three
+    (matters doubly when each dispatch is a network RTT on the tunnel
+    harness). ``tick`` is a traced scalar, so the per-step RNG key needs
+    no host-side split and the program never recompiles."""
+    logits, pool = paged_decode_step(params, pool, bt, tokens, positions)
     key = jax.random.fold_in(jax.random.key(seed), tick)
-    return sample_tokens(logits, temps, topks, key), cache_k, cache_v
+    return sample_tokens(logits, temps, topks, key), pool
 
 
 def _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot):
     """Write a prefill wave's K/V into each row's own slot as ONE masked
-    gather + slice update, vectorized over SLOTS: slot j takes wave row
-    ``row_for_slot[j]`` iff ``valid_slot[j]`` and keeps its current bytes
-    otherwise. Pivoting the mapping to the slot axis makes the write
+    gather + slice update, vectorized over SLOTS (DRAFT cache only since
+    the paged pool took over the target side — the draft keeps the flat
+    slot layout because its whole point is to be small): slot j takes wave
+    row ``row_for_slot[j]`` iff ``valid_slot[j]`` and keeps its current
+    bytes otherwise. Pivoting the mapping to the slot axis makes the write
     conflict-free by construction (each slot SELECTS its row — no scatter
-    with duplicate destination indices exists), which is what lets the
-    whole wave land as one fused op instead of the per-row unrolled
-    dynamic_update_slice loop this replaces (4 slice ops traced per wave
-    row; the large-bucket admit programs dominated warmup — delta in
-    PARITY.md)."""
+    with duplicate destination indices exists)."""
     s = k_new.shape[3]
     sel_k = jnp.take(k_new, row_for_slot, axis=1)  # [L, n_slots, h, s, hd]
     sel_v = jnp.take(v_new, row_for_slot, axis=1)
@@ -122,110 +133,30 @@ def _scatter_prefill_rows(cache_k, cache_v, k_new, v_new, row_for_slot, valid_sl
     return cache_k, cache_v
 
 
-def _fused_admit(
-    params, cache_k, cache_v, ids, row_for_slot, valid_slot, temps, topks, seed, tick
-):
-    """One device program per admission WAVE: batched prompt prefill +
-    per-row K/V writes into each row's own slot + first-token sampling,
-    all in one dispatch. ``ids`` is a [k, s] bucket (k from a fixed
-    power-of-two ladder so admissions of any size reuse a warmed
-    program). Batching matters: short-generation workloads are
-    admission-bound, and one wave of 8 prompts costs one prefill program
-    like the fused scan's, not 8 serial ones."""
-    logits, k_new, v_new = prefill(params, ids)  # [L, k, h, s, hd]
-    cache_k, cache_v = _scatter_prefill_rows(
-        cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot
-    )
-    key = jax.random.fold_in(jax.random.key(seed), tick)
-    toks = sample_tokens(logits, temps, topks, key)
-    return toks, cache_k, cache_v
-
-
-def _fused_spec_admit(
-    params, draft_params, cache_k, cache_v, dcache_k, dcache_v,
-    ids, row_for_slot, valid_slot, temps, topks, seed, tick,
-):
-    """_fused_admit + the DRAFT model's prefill of the same prompts into
-    its own slot cache, still one dispatch per wave. The first token comes
-    from the TARGET's prefill logits exactly as on the plain path, so
-    admission stays bit-identical with speculation on."""
-    logits, k_new, v_new = prefill(params, ids)
-    cache_k, cache_v = _scatter_prefill_rows(
-        cache_k, cache_v, k_new, v_new, row_for_slot, valid_slot
-    )
-    _, dk_new, dv_new = prefill(draft_params, ids)
-    dcache_k, dcache_v = _scatter_prefill_rows(
-        dcache_k, dcache_v, dk_new, dv_new, row_for_slot, valid_slot
-    )
-    key = jax.random.fold_in(jax.random.key(seed), tick)
-    toks = sample_tokens(logits, temps, topks, key)
-    return toks, cache_k, cache_v, dcache_k, dcache_v
-
-
-def _fused_prefix_gather(cache_k, cache_v, pool_k, pool_v, src_for_slot, len_for_slot):
-    """Copy each admitted slot's longest-matched prefix K/V out of the
-    device prefix pool in ONE dispatch: a gather along the pool axis +
-    a length-masked slice update, vectorized over slots (len 0 slots —
-    no match, not in this wave — keep their bytes). No host readback:
-    the cached K/V never leaves the device; only the two [n_slots] int32
-    index/length vectors go up with the dispatch."""
-    pc = pool_k.shape[3]
-    sel_k = jnp.take(pool_k, src_for_slot, axis=1)  # [L, n_slots, h, pc, hd]
-    sel_v = jnp.take(pool_v, src_for_slot, axis=1)
-    mask = (jnp.arange(pc)[None, :] < len_for_slot[:, None])[None, :, None, :, None]
-    cache_k = cache_k.at[:, :, :, :pc, :].set(
-        jnp.where(mask, sel_k, cache_k[:, :, :, :pc, :])
-    )
-    cache_v = cache_v.at[:, :, :, :pc, :].set(
-        jnp.where(mask, sel_v, cache_v[:, :, :, :pc, :])
-    )
-    return cache_k, cache_v
-
-
-def _fused_prefix_capture(pool_k, pool_v, cache_k, cache_v, dst, slot, length):
-    """The populate half of the prefix cache: copy ``slot``'s leading
-    ``length`` K/V entries into pool row ``dst`` (length-masked against
-    the row's current bytes), one dispatch, no readback. dst/slot/length
-    are traced scalars, so one compiled program serves every capture."""
-    pc = pool_k.shape[3]
-    seg_k = jnp.take(cache_k, slot, axis=1)[:, :, :pc, :]  # [L, h, pc, hd]
-    seg_v = jnp.take(cache_v, slot, axis=1)[:, :, :pc, :]
-    cur_k = jnp.take(pool_k, dst, axis=1)
-    cur_v = jnp.take(pool_v, dst, axis=1)
-    mask = (jnp.arange(pc) < length)[None, None, :, None]
-    new_k = jnp.where(mask, seg_k, cur_k)[:, None]
-    new_v = jnp.where(mask, seg_v, cur_v)[:, None]
-    pool_k = jax.lax.dynamic_update_slice(pool_k, new_k, (0, dst, 0, 0, 0))
-    pool_v = jax.lax.dynamic_update_slice(pool_v, new_v, (0, dst, 0, 0, 0))
-    return pool_k, pool_v
-
-
-def _fused_chunk(params, cache_k, cache_v, ids, positions, counts, temps, topks, seed, tick):
-    """One device program per prefill chunk round: ``chunk_prefill`` over
-    every slot (counts-0 slots — generating, free — ride the static shape
-    without touching their cache) + next-token sampling from each slot's
-    last consumed position, one dispatch. ``ids`` is a [n_slots, c]
-    bucket from the chunk ladder; only the sampled token for slots whose
-    prompt COMPLETED this round is consumed by the host (it is the first
-    generated token, sampled from the same last-position logits the
-    monolithic admit program samples)."""
-    logits, cache_k, cache_v = chunk_prefill(
-        params, cache_k, cache_v, ids, positions, counts
-    )
+def _fused_chunk(params, pool, bt, ids, positions, counts, temps, topks, seed, tick):
+    """One device program per prefill chunk round: ``paged_chunk_prefill``
+    over every slot (counts-0 slots — generating, free — ride the static
+    shape with their writes junk-redirected) + next-token sampling from
+    each slot's last consumed position, one dispatch. ``ids`` is a
+    [n_slots, c] bucket from the chunk ladder; only the sampled token for
+    slots whose prompt COMPLETED this round is consumed by the host (it is
+    the first generated token). With the monolithic admit path gone, this
+    IS admission's prompt compute — a whole wave prefills in one dispatch
+    at the top bucket, or spread over rounds when chunking is on."""
+    logits, pool = paged_chunk_prefill(params, pool, bt, ids, positions, counts)
     c = ids.shape[1]
     idx = jnp.clip(counts - 1, 0, c - 1)
     last = logits[jnp.arange(ids.shape[0]), idx]  # [n, vocab]
     key = jax.random.fold_in(jax.random.key(seed), tick)
-    return sample_tokens(last, temps, topks, key), cache_k, cache_v
+    return sample_tokens(last, temps, topks, key), pool
 
 
 def _fused_draft_admit(params, dcache_k, dcache_v, ids, row_for_slot, valid_slot):
-    """Draft-side prompt prefill for slots whose TARGET prefill completed
-    via the incremental (prefix/chunk) path: the draft shares no K/V with
-    the target's prefix pool, so its cache takes the FULL prompt in one
-    bucketed dispatch at transition time — target-side prefix reuse never
-    skews the draft's proposal distribution (and greedy acceptance is
-    bit-exact for ANY draft state regardless)."""
+    """Draft-side prompt prefill for slots whose TARGET prefill completed:
+    the draft shares no K/V with the target's page pool, so its flat cache
+    takes the FULL prompt in one bucketed dispatch at transition time —
+    target-side prefix reuse never skews the draft's proposal distribution
+    (and greedy acceptance is bit-exact for ANY draft state regardless)."""
     _, k_new, v_new = prefill(params, ids)
     return _scatter_prefill_rows(
         dcache_k, dcache_v, k_new, v_new, row_for_slot, valid_slot
@@ -243,38 +174,42 @@ def _fused_draft(params, cache_k, cache_v, tokens, positions, temps, topks, seed
 
 
 def _fused_verify(
-    params, cache_k, cache_v, tokens, drafts, draft_logits,
+    params, pool, bt, tokens, drafts, draft_logits,
     positions, limits, temps, topks, seed, tick,
 ):
     """One device program per speculation round, target side: the widened
-    [n, k+1] verify step + the acceptance rule, reading back only
+    [n, k+1] paged verify step + the acceptance rule, reading back only
     (out_tokens [n, k+1], n_accepted [n]). The draft's proposals and raw
     logits stay on device between the two dispatches."""
     queries = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [n, k+1]
-    logits, cache_k, cache_v = verify_step(params, cache_k, cache_v, queries, positions)
+    logits, pool = paged_verify_step(params, pool, bt, queries, positions)
     key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
     out, acc = speculative_accept(
         logits, drafts, draft_logits, limits, temps, topks, key
     )
-    return out, acc, cache_k, cache_v
+    return out, acc, pool
 
 
 class _PrefixEntry:
-    """One cached prefix: a device pool row + the token string it holds."""
+    """One cached prefix: the token string it holds plus a REFERENCE to
+    the pool pages carrying its K/V (a kv_pool pin id) — no private pool
+    row, no copy anywhere in its lifecycle."""
 
-    __slots__ = ("tokens", "length", "row", "refs", "last_use", "hits")
+    __slots__ = ("tokens", "length", "pages", "pin_id", "last_use", "hits")
 
-    def __init__(self, tokens: np.ndarray, row: int):
+    def __init__(self, tokens: np.ndarray, pages: list[int], pin_id: int):
         self.tokens = np.asarray(tokens, np.int32)
         self.length = int(self.tokens.shape[0])
-        self.row = row
-        self.refs = 0  # pinned by in-flight readers; never evicted while > 0
+        self.pages = list(pages)
+        self.pin_id = pin_id
         self.last_use = 0
         self.hits = 0
 
 
 class PrefixIndex:
-    """Host-side radix index over the device prefix pool's token strings.
+    """Host-side radix index over token prefixes whose K/V lives in POOL
+    PAGES (serving/kv_pool.py): a hit maps the entry's pages into the
+    reader's block table (refcount bump) instead of copying anything.
 
     Matching walks the token trie as deep as the prompt agrees with ANY
     entry — longest-COMMON-prefix semantics, not whole-entry match: causal
@@ -283,17 +218,20 @@ class PrefixIndex:
     makes shared system prompts hit without any client hint: the first
     full-prompt capture seeds every later request's common prefix).
 
-    Entries are ref-counted while a reader slot's prefill is in flight and
-    LRU-evicted — never while pinned — when the pool is full. Node count
-    is pool-bounded (n_rows x prefix_ctx tokens), so eviction re-indexes
-    from scratch instead of doing per-node reference surgery."""
+    Capacity is bounded twice: ``max_entries`` caps the index itself
+    (insert evicts the LRU entry and returns it so the caller can release
+    its pin), and the PAGE POOL reclaims pin-only pages LRU-first under
+    allocation pressure (the allocator calls back and the entry drops via
+    ``remove_by_pin``). Readers never pin entries: once admission maps the
+    pages, the slot's own refcounts keep them alive — an entry is always
+    safe to evict. Node count is entry-bounded, so eviction re-indexes
+    from scratch instead of per-node reference surgery."""
 
-    def __init__(self, n_rows: int):
-        self.n_rows = n_rows
-        self.entries: dict[int, _PrefixEntry] = {}  # pool row -> entry
-        self._free = list(range(n_rows - 1, -1, -1))
+    def __init__(self, max_entries: int):
+        self.max_entries = max_entries
+        self.entries: dict[int, _PrefixEntry] = {}  # pin_id -> entry
         self._clock = 0
-        self._root: dict[int, list] = {}  # token -> [children, pool row]
+        self._root: dict[int, list] = {}  # token -> [children, entry]
         self.evictions = 0
 
     def _tick(self) -> int:
@@ -304,59 +242,71 @@ class PrefixIndex:
         """Longest common prefix between ``prompt`` and any entry:
         (entry, depth). ``touch=False`` peeks without bumping LRU age
         (the capture-dedup probe must not keep its own victim warm)."""
-        node, row, depth = self._root, -1, 0
+        node, ent, depth = self._root, None, 0
         for t in prompt:
             nxt = node.get(int(t))
             if nxt is None:
                 break
-            node, row = nxt[0], nxt[1]
+            node, ent = nxt[0], nxt[1]
             depth += 1
-        if row < 0:
+        if ent is None:
             return None, 0
-        e = self.entries[row]
         if touch:
-            e.last_use = self._tick()
-            e.hits += 1
-        return e, depth
+            ent.last_use = self._tick()
+            ent.hits += 1
+        return ent, depth
 
-    def insert(self, tokens) -> "_PrefixEntry | None":
-        """Claim a pool row for ``tokens`` (LRU-evicting an unpinned entry
-        if the pool is full); returns None when every row is pinned — the
-        caller skips the capture rather than stalling. The device copy is
-        the caller's dispatch; this only does the bookkeeping."""
-        if self._free:
-            row = self._free.pop()
-        else:
-            victims = [e for e in self.entries.values() if e.refs == 0]
-            if not victims:
-                return None
-            self.remove(min(victims, key=lambda e: e.last_use))
+    def insert(
+        self, tokens, pages: list[int], pin_id: int
+    ) -> tuple["_PrefixEntry", "_PrefixEntry | None"]:
+        """Index a captured prefix; returns (entry, evicted) where
+        ``evicted`` is the LRU entry pushed out by the max_entries cap (the
+        caller must release its pool pin) or None."""
+        evicted = None
+        if len(self.entries) >= self.max_entries:
+            evicted = min(self.entries.values(), key=lambda e: e.last_use)
+            self.remove(evicted)
             self.evictions += 1
-            row = self._free.pop()
-        e = _PrefixEntry(tokens, row)
+        e = _PrefixEntry(tokens, pages, pin_id)
         e.last_use = self._tick()
-        self.entries[row] = e
+        self.entries[pin_id] = e
         self._index(e)
-        return e
+        return e, evicted
 
     def _index(self, e: "_PrefixEntry") -> None:
         node = self._root
         for t in e.tokens:
-            nxt = node.setdefault(int(t), [{}, e.row])
-            nxt[1] = e.row  # newest entry through this node wins ties
+            nxt = node.setdefault(int(t), [{}, e])
+            nxt[1] = e  # newest entry through this node wins ties
             node = nxt[0]
 
     def remove(self, e: "_PrefixEntry") -> None:
-        del self.entries[e.row]
-        self._free.append(e.row)
+        del self.entries[e.pin_id]
         self._root = {}
         for other in self.entries.values():
             self._index(other)
 
+    def remove_by_pins(self, pin_ids) -> int:
+        """Pool-pressure reclaim callback: the allocator already dropped
+        the pins' refs; drop the index entries that held them — ONE trie
+        rebuild for the whole wave (rebuild-per-pin would put O(entries)
+        work per reclaimed pin on the hot decode path). Returns how many
+        entries actually dropped."""
+        dropped = 0
+        for pin_id in pin_ids:
+            if pin_id in self.entries:
+                del self.entries[pin_id]
+                dropped += 1
+        if dropped:
+            self._root = {}
+            for other in self.entries.values():
+                self._index(other)
+            self.evictions += dropped
+        return dropped
+
     def clear(self) -> None:
         self.entries.clear()
         self._root = {}
-        self._free = list(range(self.n_rows - 1, -1, -1))
 
 
 class _Seq:
@@ -366,7 +316,7 @@ class _Seq:
         "prompt", "max_new", "temperature", "top_k", "spec_k", "on_token", "future",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
         "deadline", "trace_ctxs", "gen_spans",
-        "prefilling", "prefill_pos", "prefix_len", "prefix_entry", "chunk_cap",
+        "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
         "cache_prefix", "chunk_idx",
     )
 
@@ -390,7 +340,6 @@ class _Seq:
         self.prefilling = False
         self.prefill_pos = 0
         self.prefix_len = 0
-        self.prefix_entry: _PrefixEntry | None = None
         self.chunk_cap = 0  # per-round prefill token cap (0 = whole suffix)
         self.cache_prefix = 0  # meta.tags.cache_prefix capture hint
         self.chunk_idx = 0
@@ -427,6 +376,9 @@ class DecodeScheduler:
         prefix_slots: int = 0,
         prefix_ctx: int = 0,
         prefill_chunk: int = 0,
+        kv_page_size: int = 0,
+        kv_pages: int = 0,
+        kv_dtype: str = "",
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         dtype=jnp.float32,
@@ -474,36 +426,39 @@ class DecodeScheduler:
         self.spec_k = int(spec_k) if self.spec_enabled else 0
         self.draft_params = draft_params if self.spec_enabled else None
 
-        # prefix cache + chunked prefill: either knob switches admission to
-        # the INCREMENTAL path (prefix gather + bucketed chunk_prefill
-        # rounds interleaved with decode steps) instead of the monolithic
-        # one-dispatch-per-wave admit program
+        # prefix cache: the radix index over pool-page references.
+        # prefix_slots caps the INDEX (entries), not device rows — pages
+        # live in the shared pool and reclaim under allocation pressure.
         self.prefix_enabled = prefix_slots > 0
         self.prefix_slots = int(prefix_slots) if self.prefix_enabled else 0
         self.prefix_ctx = (
             min(int(prefix_ctx) or seq_len, seq_len) if self.prefix_enabled else 0
         )
         self.prefill_chunk = min(max(0, int(prefill_chunk)), seq_len)
-        self.incremental = self.prefix_enabled or self.prefill_chunk > 0
-        if self.incremental:
-            top = self.prefill_chunk or seq_len
-            cb, b = [], 1
-            while b < top:
-                cb.append(b)
-                b *= 2
-            self.chunk_buckets = tuple(cb) + (top,)
-        else:
-            self.chunk_buckets = ()
-        # cache headroom: the widened verify writes a fixed [k+1] block and
-        # a chunk round a fixed [c] block at each slot's own position; a
-        # slot near the end of its context must not have that block's
-        # dynamic_update_slice clamp backwards over accepted entries. The
-        # chunk block's worst case starts at seq_len - 1 (one remaining
-        # prompt token riding the top bucket).
-        chunk_headroom = max(
-            0, (self.chunk_buckets[-1] - 1 - max_new_tokens) if self.chunk_buckets else 0
-        )
-        self._cache_ctx = self.max_ctx + max(self.spec_k, chunk_headroom)
+        # ALL admission is incremental now (the monolithic admit program is
+        # gone): prompt compute rides the chunk ladder — one dispatch for a
+        # whole wave at the top bucket, or Sarathi-interleaved rounds when
+        # decode_prefill_chunk caps it. Kept as an attribute for
+        # bench/test introspection.
+        self.incremental = True
+        top = self.prefill_chunk or seq_len
+        # power-of-FOUR ladder: each chunk bucket is a full-transformer
+        # program, and with chunking now the only admission path the
+        # ladder dominates warmup — a coarser ladder halves the compile
+        # count while round COUNTS stay set by the chunk cap, not the
+        # bucket (a 5-token suffix rides bucket 16 with junk-masked slack)
+        cb, b = [], 1
+        while b < top:
+            cb.append(b)
+            b *= 4
+        self.chunk_buckets = tuple(cb) + (top,)
+        # paged pool geometry: the write mask junk-redirects out-of-range
+        # entries, so the pool needs NO verify/chunk headroom columns —
+        # virtual context is exactly seq + max_new (rounded up to pages).
+        # The flat DRAFT cache still needs the spec_k headroom (its
+        # dynamic_update_slice would clamp backwards at the context edge).
+        self._cache_ctx = self.max_ctx
+        self._draft_ctx = self.max_ctx + self.spec_k
         if self.spec_enabled:
             ddims = decoder_dims(draft_params)
             if ddims["vocab"] != dims["vocab"]:
@@ -517,52 +472,51 @@ class DecodeScheduler:
                     f"than seq_len + max_new_tokens ({self.max_ctx})"
                 )
 
-        # compiled programs — the caches are donated so slot updates are
-        # in-place in HBM. The step program is ONE executable; the admit
-        # program is one per wave bucket (power-of-two ladder up to
-        # n_slots), all compiled at warmup(). With speculation on, the
-        # admit ladder runs the spec variant (target + draft prefill) and
-        # two more programs join: the k-step draft loop and the widened
-        # verify. The plain step program stays warm either way — it serves
-        # rounds where every active slot's effective spec_k is 0.
-        self._admit_fn = jax.jit(_fused_admit, donate_argnums=(1, 2))
-        self._step_fn = jax.jit(_fused_step, donate_argnums=(1, 2))
+        # compiled programs — the pool state tuple is donated so page
+        # updates are in-place in HBM. The step program is ONE executable;
+        # the chunk ladder compiles one per bucket; the pool's CoW copy
+        # ladder one per copy bucket — all at warmup(). With speculation
+        # on, three more join: the k-step draft loop, the widened paged
+        # verify, and the draft's transition-time flat prompt prefill. The
+        # plain step program stays warm either way — it serves rounds
+        # where every active slot's effective spec_k is 0.
+        self._step_fn = jax.jit(_fused_step, donate_argnums=(1,))
+        self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,))
         if self.spec_enabled:
-            self._spec_admit_fn = jax.jit(_fused_spec_admit, donate_argnums=(2, 3, 4, 5))
             self._draft_fn = jax.jit(
                 _fused_draft, donate_argnums=(1, 2), static_argnums=(9,)
             )
-            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1, 2))
-        # incremental-path programs: the chunk ladder (one program per chunk
-        # bucket), the draft's transition-time prompt prefill (spec mode),
-        # and the prefix pool's gather/capture pair — all compiled at
-        # warmup() and reported by compile_counts()
-        if self.incremental:
-            self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1, 2))
-            if self.spec_enabled:
-                self._draft_admit_fn = jax.jit(_fused_draft_admit, donate_argnums=(1, 2))
+            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1,))
+            self._draft_admit_fn = jax.jit(_fused_draft_admit, donate_argnums=(1, 2))
+            # wave buckets for the draft's transition-time flat prefill —
+            # the only surviving consumer of the admit ladder now that the
+            # target side admits through the chunk programs
+            buckets = []
+            b = 1
+            while b < n_slots:
+                buckets.append(b)
+                b *= 2
+            self.admit_buckets = tuple(buckets) + (n_slots,)
         if self.prefix_enabled:
-            self._gather_fn = jax.jit(_fused_prefix_gather, donate_argnums=(0, 1))
-            self._capture_fn = jax.jit(_fused_prefix_capture, donate_argnums=(0, 1))
             self._prefix_index = PrefixIndex(self.prefix_slots)
-        buckets = []
-        b = 1
-        while b < n_slots:
-            buckets.append(b)
-            b *= 2
-        self.admit_buckets = tuple(buckets) + (n_slots,)
 
-        self._ck, self._cv = self._place_like(
-            params, init_slot_cache(params, n_slots, self._cache_ctx, dtype)
+        # the paged KV pool both live slots and the prefix cache allocate
+        # from (serving/kv_pool.py) — geometry/validation live there
+        self.pool = PagedKVPool(
+            params,
+            n_slots=n_slots,
+            cache_ctx=self._cache_ctx,
+            page_size=kv_page_size,
+            n_pages=kv_pages,
+            kv_dtype=kv_dtype,
+            dtype=dtype,
+            place=lambda arrs: self._place_like(params, arrs),
         )
+        if self.prefix_enabled:
+            self.pool.alloc.on_pins_reclaimed = self._on_pins_reclaimed
         if self.spec_enabled:
             self._dck, self._dcv = self._place_like(
-                draft_params, init_slot_cache(draft_params, n_slots, self._cache_ctx, dtype)
-            )
-        if self.prefix_enabled:
-            # device-resident prefix pool [L, n_prefix, h, prefix_ctx, hd]
-            self._pk, self._pv = self._place_like(
-                params, init_slot_cache(params, self.prefix_slots, self.prefix_ctx, dtype)
+                draft_params, init_slot_cache(draft_params, n_slots, self._draft_ctx, dtype)
             )
         # on an accelerator, device dispatch + token readback block the
         # calling thread for the device-step latency — run them on the
@@ -599,6 +553,13 @@ class DecodeScheduler:
         self.stat_prefix_captures = 0
         self.stat_prefix_capture_skips = 0
         self.stat_chunk_dispatches = 0
+        # paged-pool attribution (the allocator owns the counters; these
+        # track what the scheduler itself dispatched/declined)
+        self.stat_kv_copy_rounds = 0
+        # scheduler rounds whose queue head could not reserve pages (one
+        # waiting request blocked for N rounds counts N — a round counter,
+        # not an admission counter)
+        self.stat_admit_blocked_rounds = 0
 
     @staticmethod
     def _place_like(params, arrs):
@@ -622,78 +583,47 @@ class DecodeScheduler:
 
     # ---------------------------------------------------------------- warmup
     def warmup(self) -> None:
-        """Compile every device program ahead of traffic (one admit program
-        per wave bucket + the step program). Serving must never pay an XLA
-        compile on a live request — compile_counts() after this is the
-        zero-recompile baseline."""
+        """Compile every device program ahead of traffic (the chunk ladder,
+        the step program, the pool's CoW copy ladder, and the speculation
+        trio). Serving must never pay an XLA compile on a live request —
+        compile_counts() after this is the zero-recompile baseline.
+        Warmup dispatches write only into junk page 0 (all-zero block
+        tables, counts 0), so they touch no live bytes."""
         t0 = time.perf_counter()
         zslot = np.zeros(self.n_slots, np.int32)
         vslot = np.zeros(self.n_slots, bool)
-        if self.incremental:
-            # chunk ladder: counts all-0, so compiling touches no live
-            # bytes (the masked write is a no-op at count 0)
-            for c in self.chunk_buckets:
-                toks, self._ck, self._cv = self._chunk_fn(
-                    self.params, self._ck, self._cv,
-                    np.zeros((self.n_slots, c), np.int32),
-                    zslot, zslot,
-                    np.zeros(self.n_slots, np.float32), zslot,
-                    self._seed, np.int32(0),
-                )
-            if self.spec_enabled:
-                for b in self.admit_buckets:
-                    self._dck, self._dcv = self._draft_admit_fn(
-                        self.draft_params, self._dck, self._dcv,
-                        np.zeros((b, self.seq_len), np.int32), zslot, vslot,
-                    )
-        else:
+        bt0 = self.pool.block_tables()  # all-zero: every write junk-sinks
+        for c in self.chunk_buckets:
+            toks, self.pool.state = self._chunk_fn(
+                self.params, self.pool.state, bt0,
+                np.zeros((self.n_slots, c), np.int32),
+                zslot, zslot,
+                np.zeros(self.n_slots, np.float32), zslot,
+                self._seed, np.int32(0),
+            )
+        self.pool.warmup()  # the CoW copy ladder (page0 self-copies)
+        if self.spec_enabled:
             for b in self.admit_buckets:
-                # all-padding wave (valid all-False): warming writes
-                # nothing into live slots
-                if self.spec_enabled:
-                    toks, self._ck, self._cv, self._dck, self._dcv = self._spec_admit_fn(
-                        self.params, self.draft_params,
-                        self._ck, self._cv, self._dck, self._dcv,
-                        np.zeros((b, self.seq_len), np.int32),
-                        zslot, vslot,
-                        np.zeros(b, np.float32), np.zeros(b, np.int32),
-                        self._seed, np.int32(0),
-                    )
-                else:
-                    toks, self._ck, self._cv = self._admit_fn(
-                        self.params, self._ck, self._cv,
-                        np.zeros((b, self.seq_len), np.int32),
-                        zslot, vslot,
-                        np.zeros(b, np.float32), np.zeros(b, np.int32),
-                        self._seed, np.int32(0),
-                    )
-        if self.prefix_enabled:
-            # gather with all lengths 0 (slots keep their bytes) and a
-            # length-0 capture into row 0 (the row keeps its bytes)
-            self._ck, self._cv = self._gather_fn(
-                self._ck, self._cv, self._pk, self._pv, zslot, zslot
-            )
-            self._pk, self._pv = self._capture_fn(
-                self._pk, self._pv, self._ck, self._cv,
-                np.int32(0), np.int32(0), np.int32(0),
-            )
-        many, self._ck, self._cv = self._step_fn(
-            self.params, self._ck, self._cv,
+                self._dck, self._dcv = self._draft_admit_fn(
+                    self.draft_params, self._dck, self._dcv,
+                    np.zeros((b, self.seq_len), np.int32), zslot, vslot,
+                )
+        many, self.pool.state = self._step_fn(
+            self.params, self.pool.state, bt0,
             np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
             np.zeros(self.n_slots, np.float32), np.zeros(self.n_slots, np.int32),
             self._seed, np.int32(0),
         )
         if self.spec_enabled:
-            # the speculative round pair: draft K/V junk lands in free
-            # slots at positions the next admission's prefill overwrites
+            # the speculative round pair: junk writes land in page 0
             zi = np.zeros(self.n_slots, np.int32)
             zf = np.zeros(self.n_slots, np.float32)
             drafts, dlogits, self._dck, self._dcv = self._draft_fn(
                 self.draft_params, self._dck, self._dcv,
                 zi, zi, zf, zi, self._seed, np.int32(0), self.spec_k,
             )
-            out_t, acc, self._ck, self._cv = self._verify_fn(
-                self.params, self._ck, self._cv,
+            out_t, acc, self.pool.state = self._verify_fn(
+                self.params, self.pool.state, bt0,
                 zi, drafts, dlogits, zi, zi, zf, zi, self._seed, np.int32(0),
             )
             jax.block_until_ready(out_t)
@@ -709,20 +639,14 @@ class DecodeScheduler:
         instances in one process (multi-tenant) — the zero-recompile
         assertion is therefore relative: recompiles_since_warmup()."""
         counts = {
-            "admit": self._admit_fn._cache_size(),
             "step": self._step_fn._cache_size(),
+            "chunk": self._chunk_fn._cache_size(),
+            "copy": self.pool.compile_count(),
         }
         if self.spec_enabled:
-            counts["spec_admit"] = self._spec_admit_fn._cache_size()
             counts["draft"] = self._draft_fn._cache_size()
             counts["verify"] = self._verify_fn._cache_size()
-        if self.incremental:
-            counts["chunk"] = self._chunk_fn._cache_size()
-            if self.spec_enabled:
-                counts["draft_admit"] = self._draft_admit_fn._cache_size()
-        if self.prefix_enabled:
-            counts["gather"] = self._gather_fn._cache_size()
-            counts["capture"] = self._capture_fn._cache_size()
+            counts["draft_admit"] = self._draft_admit_fn._cache_size()
         return counts
 
     @property
@@ -785,20 +709,19 @@ class DecodeScheduler:
         sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
         seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
-        if self.incremental:
-            seq.chunk_cap = self.prefill_chunk
-            if prefill_chunk is not None:
-                pc = int(prefill_chunk)
-                # tighten-only against the deployment cap (a smaller chunk
-                # is tighter); with no deployment cap a request may still
-                # ask for one. Values < 1 are IGNORED, not clamped to 1:
-                # "0 = whole suffix" is the deployment knob's widest
-                # setting, and a request must not widen past the
-                # deployment's cap (nor accidentally get 1-token rounds)
-                if pc >= 1:
-                    seq.chunk_cap = (
-                        min(pc, self.prefill_chunk) if self.prefill_chunk else pc
-                    )
+        seq.chunk_cap = self.prefill_chunk
+        if prefill_chunk is not None:
+            pc = int(prefill_chunk)
+            # tighten-only against the deployment cap (a smaller chunk
+            # is tighter); with no deployment cap a request may still
+            # ask for one. Values < 1 are IGNORED, not clamped to 1:
+            # "0 = whole suffix" is the deployment knob's widest
+            # setting, and a request must not widen past the
+            # deployment's cap (nor accidentally get 1-token rounds)
+            if pc >= 1:
+                seq.chunk_cap = (
+                    min(pc, self.prefill_chunk) if self.prefill_chunk else pc
+                )
         if self.prefix_enabled and cache_prefix is not None:
             seq.cache_prefix = max(0, min(int(cache_prefix), self.prefix_ctx))
         if self.queue_timeout_s > 0:
@@ -854,15 +777,26 @@ class DecodeScheduler:
                 np.concatenate([seq.prompt, np.asarray(seq.tokens, np.int32)])
             )
 
-    def _unpin(self, seq: _Seq) -> None:
-        if seq.prefix_entry is not None:
-            seq.prefix_entry.refs -= 1
-            seq.prefix_entry = None
+    def _on_pins_reclaimed(self, pin_ids: list[int]) -> None:
+        """Allocator callback, once per reclaim wave: pool pressure
+        reclaimed prefix pins — drop the index entries that held them
+        (their pages are gone/repurposed)."""
+        dropped = self._prefix_index.remove_by_pins(pin_ids)
+        for _ in range(dropped):
+            self._metrics.decode_prefix_evicted(self._deployment)
+        self._metrics.decode_kv_reclaimed(self._deployment, len(pin_ids))
+
+    def _kv_gauges(self) -> None:
+        a = self.pool.alloc
+        self._metrics.decode_kv_pool(
+            self._deployment, a.free_pages, a.live_pages, a.prefix_pages
+        )
 
     def _maybe_capture(self, seq: _Seq, slot: int, length: int) -> None:
-        """Copy ``slot``'s leading K/V into the prefix pool when the index
-        doesn't already cover prompt[:length]: one capture dispatch, no
-        readback. Called at prefill completion for hinted captures
+        """Pin ``slot``'s leading prompt pages as a prefix entry when the
+        index doesn't already cover prompt[:length] — a refcount bump, NO
+        device work (the capture-copy dispatch of the flat layout is
+        gone). Called at prefill completion for hinted captures
         (meta.tags.cache_prefix — the prefix K/V exists from that moment)
         and at retirement for the automatic full-prompt policy."""
         length = min(length, self.prefix_ctx, self.seq_len)
@@ -871,19 +805,18 @@ class DecodeScheduler:
         _, depth = self._prefix_index.match(seq.prompt, touch=False)
         if depth >= length:
             return  # already covered verbatim (or by a longer entry)
-        ev0 = self._prefix_index.evictions
-        e = self._prefix_index.insert(seq.prompt[:length])
-        if e is None:
-            # every pool row is pinned by an in-flight reader — skip
-            # rather than stall the loop
+        pin = self.pool.alloc.capture(slot, length)
+        if pin is None:
+            # the span's pages aren't materialized (shouldn't happen for
+            # a completed prefill) — skip rather than stall the loop
             self.stat_prefix_capture_skips += 1
             return
-        if self._prefix_index.evictions > ev0:
+        _, evicted = self._prefix_index.insert(seq.prompt[:length], pin.pages, pin.pin_id)
+        if evicted is not None:
+            # index-cap LRU eviction: release the displaced entry's pin
+            # (its pages free unless live readers still map them)
+            self.pool.alloc.release(evicted.pin_id)
             self._metrics.decode_prefix_evicted(self._deployment)
-        self._pk, self._pv = self._capture_fn(
-            self._pk, self._pv, self._ck, self._cv,
-            np.int32(e.row), np.int32(slot), np.int32(length),
-        )
         self.stat_prefix_captures += 1
 
     def _retire(self, slot: int) -> None:
@@ -897,10 +830,12 @@ class DecodeScheduler:
                 # reusable span (cache_prefix) captured at prefill
                 # completion; everyone else contributes their full prompt
                 # here. A sequence cancelled mid-prefill has incomplete
-                # prompt K/V and must not be captured.
+                # prompt K/V and must not be captured. Capture pins pages
+                # BEFORE retire returns them to the pool.
                 if not seq.prefilling and seq.cache_prefix == 0:
                     self._maybe_capture(seq, slot, self.seq_len)
-                self._unpin(seq)
+            self.pool.alloc.retire(slot)
+            self._kv_gauges()
             if seq.gen_spans:
                 t = telemetry.now_ns()
                 for sp in seq.gen_spans:
@@ -923,37 +858,96 @@ class DecodeScheduler:
 
         return await asyncio.get_running_loop().run_in_executor(compute_pool(), fn)
 
-    def _pop_wave(self) -> tuple[list[_Seq], list[int]]:
-        wave: list[_Seq] = []
-        while self._waiting and len(wave) < len(self._free):
-            seq = self._waiting.popleft()
-            if not seq.future.cancelled():
-                wave.append(seq)
-        return wave, [self._free.pop() for _ in wave]
+    async def _run_copies(self, copies: list[tuple[int, int]]) -> None:
+        """Dispatch a round's copy-on-write page copies (batched through
+        the pool's warmed ladder) BEFORE the round's write dispatch."""
+        if not copies:
+            return
+        await self._device_call(lambda: self.pool.run_copies(copies))
+        self.stat_kv_copy_rounds += 1
+        self._metrics.decode_kv_cow(self._deployment, len(copies))
 
     async def _admit(self) -> None:
-        """Move waiting sequences into free slots in WAVES.
+        """Move waiting sequences into free slots — pure host work now:
+        slot assignment, the longest-prefix match, copy-free page mapping
+        (refcount bumps into the block table), and the worst-case page
+        reservation. The uncovered suffix is computed by chunk rounds
+        interleaved with decode steps in the run loop, and the first token
+        is emitted when the last chunk lands.
 
-        Monolithic path (default): one batched prefill dispatch admits up
-        to every free slot at once (bucketed to the warmed power-of-two
-        ladder; padding slots are valid=False and keep their bytes), and
-        each admitted row's first token is emitted (sampled from the
-        prefill logits — exactly the fused oracle's first_tok).
-
-        Incremental path (prefix cache and/or chunked prefill enabled):
-        slot assignment + the longest-prefix pool gather happen here (one
-        fused dispatch per wave, no host readback); the uncovered suffix
-        is computed by chunk rounds interleaved with decode steps in the
-        run loop, and the first token is emitted when the last chunk
-        lands."""
+        Admission is page-budget aware: a sequence admits only when the
+        pool can GUARANTEE its exclusive page need on top of every running
+        slot's outstanding reservation (kv_pool's no-deadlock invariant).
+        When the budget is tight the head of the queue waits for
+        retirements — FIFO, like slot contention."""
         while self._waiting and self._free:
-            wave, taken = self._pop_wave()
-            if not wave:
+            seq = self._waiting[0]
+            if seq.future.cancelled():
+                self._waiting.popleft()
                 continue
-            if self.incremental:
-                self._admit_incremental(wave, taken)
-            else:
-                await self._admit_monolithic(wave, taken)
+            t0 = telemetry.now_ns()
+            slot = self._free[-1]
+            entry, reuse = None, 0
+            if self.prefix_enabled:
+                entry, depth = self._prefix_index.match(seq.prompt)
+                # always leave >= 1 suffix token: the last prompt
+                # position's logits are the first generated token's
+                # distribution
+                reuse = min(depth, self.seq_len - 1)
+                if reuse <= 0:
+                    entry = None
+            # a cache_prefix hint pins pages at prefill completion; if the
+            # hinted span's last page extends past seq_len, this slot's own
+            # GENERATION writes will copy-on-write it — reserve for exactly
+            # that case (page-aligned prompts need no extra, so a full
+            # hinted burst still reaches every slot on the auto budget)
+            extra = 0
+            if self.prefix_enabled and seq.cache_prefix > 0:
+                alloc = self.pool.alloc
+                hint_end = alloc.pages_for(seq.cache_prefix) * alloc.page_size
+                extra = 1 if hint_end > self.seq_len else 0
+            if not self.pool.alloc.try_admit(
+                slot, entry.pages if entry is not None else (), reuse, extra
+            ):
+                self.stat_admit_blocked_rounds += 1
+                break
+            self._waiting.popleft()
+            self._free.pop()
+            seq.slot = slot
+            seq.prefilling = True
+            self._slots[slot] = seq
+            self.stat_admitted += 1
+            shared_pages = self.pool.alloc.pages_for(reuse) if reuse else 0
+            if self.prefix_enabled:
+                if entry is not None:
+                    self.pool.alloc.touch(entry.pin_id)
+                    self.stat_prefix_hits += 1
+                    self.stat_prefix_tokens_saved += reuse
+                    self._metrics.decode_prefix(self._deployment, True, reuse)
+                    self._metrics.decode_kv_shared(self._deployment, shared_pages)
+                else:
+                    self.stat_prefix_misses += 1
+                    self._metrics.decode_prefix(self._deployment, False, 0)
+            seq.prefill_pos = reuse
+            seq.prefix_len = reuse
+            for c in seq.trace_ctxs:
+                ms = c.buf.begin(
+                    "decode.prefix_match" if self.prefix_enabled else "decode.admit",
+                    c.span.span_id,
+                    {"slot": slot, "hit": reuse > 0},
+                    start_ns=t0,
+                )
+                ms.add_event("reuse", {"tokens": reuse})
+                ms.add_event(
+                    "kv_alloc",
+                    {
+                        "shared_pages": shared_pages,
+                        "reserved_pages": int(self.pool.alloc._reserved[slot]),
+                        "free_pages": self.pool.alloc.free_pages,
+                    },
+                )
+                ms.end()
+        self._kv_gauges()
         if self._waiting:
             # whoever is STILL waiting after admission filled every free
             # slot: expire those past the queue deadline (the
@@ -970,123 +964,6 @@ class DecodeScheduler:
                         )
                     )
         self.stat_peak_active = max(self.stat_peak_active, self.active)
-
-    async def _admit_monolithic(self, wave: list[_Seq], taken: list[int]) -> None:
-        bucket = next(b for b in self.admit_buckets if b >= len(wave))
-        ids = np.zeros((bucket, self.seq_len), np.int32)
-        row_for_slot = np.zeros(self.n_slots, np.int32)
-        valid_slot = np.zeros(self.n_slots, bool)
-        temps = np.zeros(bucket, np.float32)
-        topks = np.zeros(bucket, np.int32)
-        for r, (seq, slot) in enumerate(zip(wave, taken)):
-            ids[r] = seq.prompt
-            row_for_slot[slot] = r
-            valid_slot[slot] = True
-            temps[r] = seq.temperature
-            topks[r] = seq.top_k
-        tick = self._next_tick()
-        t_wave0 = telemetry.now_ns()
-
-        if self.spec_enabled:
-            def _do_admit():
-                toks, ck, cv, dck, dcv = self._spec_admit_fn(
-                    self.params, self.draft_params,
-                    self._ck, self._cv, self._dck, self._dcv,
-                    ids, row_for_slot, valid_slot, temps, topks, self._seed, tick,
-                )
-                return np.asarray(toks), ck, cv, dck, dcv
-
-            toks, self._ck, self._cv, self._dck, self._dcv = (
-                await self._device_call(_do_admit)
-            )
-        else:
-            def _do_admit():
-                toks, ck, cv = self._admit_fn(
-                    self.params, self._ck, self._cv, ids, row_for_slot,
-                    valid_slot, temps, topks, self._seed, tick,
-                )
-                return np.asarray(toks), ck, cv
-
-            toks, self._ck, self._cv = await self._device_call(_do_admit)
-        t_wave1 = telemetry.now_ns()
-        for r, (seq, slot) in enumerate(zip(wave, taken)):
-            seq.slot = slot
-            seq.pos = self.seq_len  # the first generated token's position
-            self._slots[slot] = seq
-            self.stat_admitted += 1
-            # per-sequence spans on the ORIGINATING request's trace: the
-            # shared prefill wave dispatch, then an open generate span
-            # that accumulates tokens until retirement (TTFT rides it
-            # as an event; steps are one fused dispatch for ALL slots,
-            # so per-step attribution lives in attrs, not span-per-step)
-            for c in seq.trace_ctxs:
-                ps = c.buf.begin(
-                    "decode.prefill",
-                    c.span.span_id,
-                    {"wave": len(wave), "bucket": bucket, "slot": slot},
-                    start_ns=t_wave0,
-                )
-                ps.end(t_wave1)
-                seq.gen_spans.append(
-                    c.buf.begin(
-                        "decode.generate",
-                        c.span.span_id,
-                        {"slot": slot},
-                        start_ns=t_wave1,
-                    )
-                )
-            self._emit(seq, int(toks[r]))
-            if self._finished(seq, int(toks[r])):
-                self._retire(slot)
-
-    def _admit_incremental(self, wave: list[_Seq], taken: list[int]) -> None:
-        """Slot assignment + prefix match + ONE pool-gather dispatch; no
-        prompt compute here — the run loop's chunk rounds do that, so a
-        long wave never stalls running slots' token emission."""
-        t0 = telemetry.now_ns()
-        src = np.zeros(self.n_slots, np.int32)
-        lens = np.zeros(self.n_slots, np.int32)
-        any_hit = False
-        for seq, slot in zip(wave, taken):
-            seq.slot = slot
-            seq.prefilling = True
-            self._slots[slot] = seq
-            self.stat_admitted += 1
-            reuse = 0
-            if self.prefix_enabled:
-                entry, depth = self._prefix_index.match(seq.prompt)
-                # always leave >= 1 suffix token: the last prompt position's
-                # logits are the first generated token's distribution
-                reuse = min(depth, self.seq_len - 1)
-                if reuse > 0 and entry is not None:
-                    src[slot] = entry.row
-                    lens[slot] = reuse
-                    any_hit = True
-                    entry.refs += 1  # pinned until this slot's prefill lands
-                    seq.prefix_entry = entry
-                    self.stat_prefix_hits += 1
-                    self.stat_prefix_tokens_saved += reuse
-                    self._metrics.decode_prefix(self._deployment, True, reuse)
-                else:
-                    reuse = 0
-                    self.stat_prefix_misses += 1
-                    self._metrics.decode_prefix(self._deployment, False, 0)
-            seq.prefill_pos = reuse
-            seq.prefix_len = reuse
-            for c in seq.trace_ctxs:
-                ms = c.buf.begin(
-                    "decode.prefix_match",
-                    c.span.span_id,
-                    {"slot": slot, "hit": reuse > 0},
-                    start_ns=t0,
-                )
-                ms.add_event("reuse", {"tokens": reuse})
-                ms.end()
-        if any_hit:
-            # fused device-side gather: pool rows -> slot rows, no readback
-            self._ck, self._cv = self._gather_fn(
-                self._ck, self._cv, self._pk, self._pv, src, lens
-            )
 
     def _draft_admit(self, slot_ids: list[int]) -> None:
         """Draft-cache prompt prefill for slots finishing incremental
@@ -1130,6 +1007,7 @@ class DecodeScheduler:
         temps = np.zeros(self.n_slots, np.float32)
         topks = np.zeros(self.n_slots, np.int32)
         counts = np.minimum(counts, bucket)
+        copies: list[tuple[int, int]] = []
         for i, seq in enumerate(self._slots):
             if counts[i] == 0 or seq is None:
                 continue
@@ -1137,17 +1015,23 @@ class DecodeScheduler:
             pos[i] = seq.prefill_pos
             temps[i] = seq.temperature
             topks[i] = seq.top_k
+            # page residency for this slot's write range: allocate fresh
+            # pages, copy-on-write the shared boundary page (the reader's
+            # first divergent write into a prefix-mapped page)
+            copies += self.pool.alloc.prepare_write(i, int(pos[i]), int(counts[i]))
+        await self._run_copies(copies)
+        bt = self.pool.block_tables()
         tick = self._next_tick()
 
         def _do_chunk():
-            toks, ck, cv = self._chunk_fn(
-                self.params, self._ck, self._cv, ids, pos, counts, temps,
+            toks, state = self._chunk_fn(
+                self.params, self.pool.state, bt, ids, pos, counts, temps,
                 topks, self._seed, tick,
             )
-            return np.asarray(toks), ck, cv
+            return np.asarray(toks), state
 
         t0 = telemetry.now_ns()
-        toks, self._ck, self._cv = await self._device_call(_do_chunk)
+        toks, self.pool.state = await self._device_call(_do_chunk)
         t1 = telemetry.now_ns()
         self.stat_chunk_dispatches += 1
         finishing: list[tuple[_Seq, int]] = []
@@ -1178,10 +1062,9 @@ class DecodeScheduler:
             seq.pos = self.seq_len
             if self.prefix_enabled and seq.cache_prefix > 0:
                 # hinted capture at prefill completion — the hinted span's
-                # K/V exists from this moment, so the very next admission
-                # can already hit it
+                # pages are pinned from this moment, so the very next
+                # admission can already map them
                 self._maybe_capture(seq, i, seq.cache_prefix)
-            self._unpin(seq)
             for c in seq.trace_ctxs:
                 seq.gen_spans.append(
                     c.buf.begin(
@@ -1192,7 +1075,7 @@ class DecodeScheduler:
             if self._finished(seq, int(toks[i])):
                 self._retire(i)
 
-    async def _spec_round(self, toks, pos, temps, topks, limits, tick) -> None:
+    async def _spec_round(self, bt, toks, pos, temps, topks, limits, tick) -> None:
         """One speculative round: ONE draft dispatch proposes spec_k
         tokens per slot, ONE widened target dispatch verifies them, and
         every slot advances by its accepted length + the bonus token
@@ -1207,14 +1090,14 @@ class DecodeScheduler:
                 self.draft_params, self._dck, self._dcv, toks, pos, temps,
                 topks, self._seed, tick, self.spec_k,
             )
-            out_t, acc, ck, cv = self._verify_fn(
-                self.params, self._ck, self._cv, toks, drafts, dlogits, pos,
+            out_t, acc, state = self._verify_fn(
+                self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
                 limits, temps, topks, self._seed, tick,
             )
-            return np.asarray(out_t), np.asarray(acc), ck, cv, dck, dcv
+            return np.asarray(out_t), np.asarray(acc), state, dck, dcv
 
         t0 = telemetry.now_ns()
-        out_t, acc, self._ck, self._cv, self._dck, self._dcv = (
+        out_t, acc, self.pool.state, self._dck, self._dcv = (
             await self._device_call(_do_spec)
         )
         t1 = telemetry.now_ns()
@@ -1267,11 +1150,11 @@ class DecodeScheduler:
                         self._wake.clear()
                         await self._wake.wait()
                     continue
-                if self.incremental:
-                    # one prefill chunk per round, interleaved with the
-                    # decode step below — running slots keep emitting while
-                    # long prompts prefill chunk by chunk
-                    await self._chunk_round()
+                # one prefill chunk per round, interleaved with the decode
+                # step below — running slots keep emitting while long
+                # prompts prefill chunk by chunk (with no chunk cap a whole
+                # admission wave prefills in one top-bucket dispatch)
+                await self._chunk_round()
 
                 toks = np.zeros(self.n_slots, np.int32)
                 pos = np.zeros(self.n_slots, np.int32)
@@ -1319,20 +1202,35 @@ class DecodeScheduler:
                             0, min(seq.spec_k, seq.max_new - len(seq.tokens) - 1)
                         )
                 tick = self._next_tick()
+                spec_round = limits is not None and bool(limits.any())
 
-                if limits is not None and limits.any():
-                    await self._spec_round(toks, pos, temps, topks, limits, tick)
+                # page residency for the round's writes: 1 token per
+                # generating slot on the plain step, the full [k+1]-wide
+                # block (accepted or junk) on a speculative round.
+                # Prefilling slots need nothing — their junk parks in
+                # already-owned pages or the junk sink.
+                width = self.spec_k + 1 if spec_round else 1
+                copies: list[tuple[int, int]] = []
+                for i, seq in enumerate(self._slots):
+                    if seq is None or seq.prefilling:
+                        continue
+                    copies += self.pool.alloc.prepare_write(i, seq.pos, width)
+                await self._run_copies(copies)
+                bt = self.pool.block_tables()
+
+                if spec_round:
+                    await self._spec_round(bt, toks, pos, temps, topks, limits, tick)
                     await asyncio.sleep(0)
                     continue
 
                 def _do_step():
-                    nxt, ck, cv = self._step_fn(
-                        self.params, self._ck, self._cv, toks, pos, temps,
+                    nxt, state = self._step_fn(
+                        self.params, self.pool.state, bt, toks, pos, temps,
                         topks, self._seed, tick,
                     )
-                    return np.asarray(nxt), ck, cv
+                    return np.asarray(nxt), state
 
-                nxt, self._ck, self._cv = await self._device_call(_do_step)
+                nxt, self.pool.state = await self._device_call(_do_step)
                 self.stat_steps += 1
                 active = self.active
                 self.stat_occupancy_sum += active / self.n_slots
@@ -1366,31 +1264,21 @@ class DecodeScheduler:
             self._slots = [None] * self.n_slots
             self._free = list(range(self.n_slots - 1, -1, -1))
             self._waiting.clear()
-            # the caches were DONATED into the call that just raised — their
-            # buffers may be invalidated, which would poison every later
-            # admission with 'array has been deleted'. Reallocate so the
-            # scheduler recovers (slot state above is already reset).
-            self._ck, self._cv = self._place_like(
-                self.params,
-                init_slot_cache(self.params, self.n_slots, self._cache_ctx, self._dtype),
-            )
+            # the pool state was DONATED into the call that just raised —
+            # its buffers may be invalidated, which would poison every
+            # later admission with 'array has been deleted'. Reallocate
+            # (pool.reset also rebuilds the host allocator, so every page
+            # mapping drops with the bytes) and clear the index entries
+            # that pointed into it.
+            self.pool.reset()
             if self.spec_enabled:
                 self._dck, self._dcv = self._place_like(
                     self.draft_params,
                     init_slot_cache(
-                        self.draft_params, self.n_slots, self._cache_ctx, self._dtype
+                        self.draft_params, self.n_slots, self._draft_ctx, self._dtype
                     ),
                 )
             if self.prefix_enabled:
-                # the pool was donated into gather/capture calls too; its
-                # rows are zeroed on realloc, so the index entries pointing
-                # at them must drop with it
-                self._pk, self._pv = self._place_like(
-                    self.params,
-                    init_slot_cache(
-                        self.params, self.prefix_slots, self.prefix_ctx, self._dtype
-                    ),
-                )
                 self._prefix_index.clear()
 
     async def close(self) -> None:
@@ -1554,6 +1442,9 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         prefix_slots=int(getattr(tpu_spec, "decode_prefix_slots", 0)),
         prefix_ctx=int(getattr(tpu_spec, "decode_prefix_ctx", 0)),
         prefill_chunk=int(getattr(tpu_spec, "decode_prefill_chunk", 0)),
+        kv_page_size=int(getattr(tpu_spec, "decode_kv_page_size", 0)),
+        kv_pages=int(getattr(tpu_spec, "decode_kv_pages", 0)),
+        kv_dtype=str(getattr(tpu_spec, "decode_kv_dtype", "") or ""),
         metrics=metrics,
         deployment_name=deployment_name,
         dtype=runtime.dtype,

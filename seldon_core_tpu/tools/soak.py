@@ -59,6 +59,7 @@ async def soak(
     trace_summary: int = 0,
     spec_k: int = 0,
     prefix_share: float = 0.0,
+    paged: bool = False,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -74,7 +75,11 @@ async def soak(
         "parameters": [{"name": "model", "value": model, "type": "STRING"}],
     }
     predictor_extra: dict = {}
-    generative = spec_k > 0 or prefix_share > 0
+    if paged and prefix_share <= 0:
+        # the paged soak's point is CoW + reclaim under a SHARED/divergent
+        # traffic mix — default the mix on when the caller didn't shape it
+        prefix_share = 0.6
+    generative = spec_k > 0 or prefix_share > 0 or paged
     if generative:
         if model != "iris_mlp":
             import sys as _sys
@@ -108,6 +113,24 @@ async def soak(
             predictor_extra["tpu"].update(
                 decode_prefix_slots=8,
                 decode_prefill_chunk=max(1, features // 4),
+            )
+        if paged:
+            # a DELIBERATELY tight page budget: ~half the flat-equivalent
+            # capacity, so shared-prefix admissions share pages copy-free,
+            # divergent tails copy-on-write, and sustained load drives pin
+            # reclaim — the allocator surface the soak exists to stress.
+            # Chunk rounds page-aligned per the validation contract.
+            ps = max(2, features // 4)
+            pages_per_slot = -(-(features + 16) // ps)
+            n_slots = predictor_extra["tpu"]["decode_slots"]
+            budget = max(
+                pages_per_slot + 2, n_slots + 1, 1 + 2 * pages_per_slot + 2
+            )
+            predictor_extra["tpu"].update(
+                decode_prefix_slots=8,
+                decode_kv_page_size=ps,
+                decode_kv_pages=budget,
+                decode_prefill_chunk=ps,
             )
     if fault_spec is not None:
         # the faulted leg exercises the resilience layer end-to-end: the
@@ -258,6 +281,26 @@ async def soak(
             ),
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    paged_stats = None
+    if paged and sched is not None:
+        a = sched.pool.alloc
+        paged_stats = {
+            "page_size": sched.pool.page_size,
+            "page_budget": sched.pool.n_pages,
+            "peak_slots": sched.stat_peak_active,
+            "pages_shared": a.stat_pages_shared,
+            "cow_copies": a.stat_cow_copies,
+            "pins_reclaimed": a.stat_pin_reclaims,
+            "pages_reclaimed": a.stat_reclaimed_pages,
+            "admit_blocked_rounds": sched.stat_admit_blocked_rounds,
+            "pages_free_end": a.free_pages,
+            "pages_live_end": a.live_pages,
+            "pages_prefix_end": a.prefix_pages,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
+        # end-of-run allocator audit: a soak that leaked or double-freed a
+        # page fails loudly here rather than reporting a green run
+        a.check()
     prefix_stats = None
     if prefix_share > 0 and sched is not None:
         lookups = sched.stat_prefix_hits + sched.stat_prefix_misses
@@ -300,6 +343,7 @@ async def soak(
         **({"trace_summary": traces} if traces is not None else {}),
         **({"spec": spec_stats} if spec_stats is not None else {}),
         **({"prefix": prefix_stats} if prefix_stats is not None else {}),
+        **({"paged": paged_stats} if paged_stats is not None else {}),
     }
 
 
@@ -344,6 +388,15 @@ def main(argv=None) -> None:
         "share a system prefix; the report gains hit_rate / tokens_saved / "
         "evictions under 'prefix'",
     )
+    ap.add_argument(
+        "--paged",
+        action="store_true",
+        help="run the soak against a generative deployment with a TIGHT "
+        "paged-KV budget and a mixed shared-prefix/divergent prompt stream "
+        "so copy-on-write and LRU pin reclaim run under load; the report "
+        "gains pages_shared / cow_copies / pins_reclaimed under 'paged' "
+        "(implies --prefix-share 0.6 unless set)",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -367,6 +420,7 @@ def main(argv=None) -> None:
                 trace_summary=args.trace_summary,
                 spec_k=args.spec_k,
                 prefix_share=args.prefix_share,
+                paged=args.paged,
             )
         )
 

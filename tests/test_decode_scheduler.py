@@ -541,7 +541,7 @@ async def test_spec_zero_recompiles_mixed_workload():
     ids = _prompts(6, seed=2)
     sched = _spec_scheduler(params, draft, n_slots=3, spec_k=3)
     counts = sched.compile_counts()
-    for prog in ("spec_admit", "draft", "verify", "step"):
+    for prog in ("draft_admit", "draft", "verify", "step", "chunk", "copy"):
         assert counts.get(prog, 0) >= 1, counts
     assert sched.recompiles_since_warmup() == 0
     outs = await asyncio.gather(

@@ -62,12 +62,13 @@ def _scheduler(params, n_slots=2, **kw) -> DecodeScheduler:
 def test_prefix_index_lcp_match_insert_evict():
     """Longest-common-prefix semantics: a prompt sharing only part of a
     longer entry still matches at the shared depth; dedup-covered inserts
-    are the caller's job (match depth tells it); LRU eviction recycles the
-    oldest unpinned entry and rebuilds the trie."""
+    are the caller's job (match depth tells it); the entry cap evicts the
+    LRU entry (returning it so the caller releases its pool pin) and
+    rebuilds the trie."""
     idx = PrefixIndex(2)
     a = np.array([1, 2, 3, 4], np.int32)
-    ea = idx.insert(a)
-    assert ea is not None and ea.length == 4
+    ea, ev = idx.insert(a, [1, 2], pin_id=0)
+    assert ev is None and ea.length == 4 and ea.pages == [1, 2]
     # exact, partial, and divergent lookups
     e, d = idx.match(np.array([1, 2, 3, 4, 9], np.int32))
     assert e is ea and d == 4
@@ -75,37 +76,56 @@ def test_prefix_index_lcp_match_insert_evict():
     assert e is ea and d == 2
     _, d = idx.match(np.array([9, 9], np.int32))
     assert d == 0
-    eb = idx.insert(np.array([5, 6], np.int32))
-    assert idx._free == []
-    # pool full: inserting a third evicts the LRU (ea is older than eb —
-    # but a recent match refreshed ea, so eb is the victim)
+    eb, ev = idx.insert(np.array([5, 6], np.int32), [3], pin_id=1)
+    assert ev is None and len(idx.entries) == 2
+    # at the cap: inserting a third evicts the LRU (ea is older than eb —
+    # but a recent match refreshed ea, so eb is the victim) and returns it
+    # so the caller can release its pool pin
     idx.match(a)
-    ec = idx.insert(np.array([7, 8], np.int32))
-    assert ec is not None and idx.evictions == 1
-    assert eb.row not in {e.row for e in idx.entries.values()} or ec.row == eb.row
+    ec, ev = idx.insert(np.array([7, 8], np.int32), [4], pin_id=2)
+    assert ev is eb and idx.evictions == 1
     _, d = idx.match(np.array([5, 6], np.int32))
     assert d == 0  # eb's tokens are gone from the trie
     e, d = idx.match(a)
     assert e is ea and d == 4  # survivor intact after the rebuild
+    # pool-pressure reclaim drops by pin id (the allocator's batched
+    # callback — one trie rebuild per reclaim wave)
+    assert idx.remove_by_pins([ec.pin_id, 999]) == 1
+    assert idx.evictions == 2
+    _, d = idx.match(np.array([7, 8], np.int32))
+    assert d == 0
 
 
-def test_prefix_index_refcount_blocks_eviction():
-    """A pinned entry (an in-flight reader slot) is never recycled: with
-    every row pinned, insert() refuses instead of corrupting the pool row
-    under the reader."""
-    idx = PrefixIndex(2)
-    ea = idx.insert(np.array([1, 2], np.int32))
-    eb = idx.insert(np.array([3, 4], np.int32))
-    ea.refs += 1
-    eb.refs += 1
-    assert idx.insert(np.array([5, 6], np.int32)) is None
-    assert idx.evictions == 0
-    eb.refs -= 1
-    ec = idx.insert(np.array([5, 6], np.int32))
-    assert ec is not None and ec.row == eb.row and idx.evictions == 1
-    # the pinned entry survived both attempts
-    e, d = idx.match(np.array([1, 2], np.int32))
-    assert e is ea and d == 2
+def test_reader_safety_pages_survive_entry_eviction():
+    """The paged twin of the old refcount-blocks-eviction guarantee: an
+    entry whose pages a live reader slot has mapped CAN be evicted (the
+    index drops it) but the PAGES survive through the reader's own
+    refcounts — nothing is recycled under the reader until it retires."""
+    from seldon_core_tpu.serving.kv_pool import PageAllocator
+
+    alloc = PageAllocator(n_pages=8, page_size=4, n_slots=2, pages_per_slot=3)
+    # slot 0 admits, materializes 2 pages, captures them as a prefix pin
+    assert alloc.try_admit(0, (), 0)
+    assert alloc.prepare_write(0, 0, 8) == []
+    pin = alloc.capture(0, 8)
+    assert pin is not None and len(pin.pages) == 2
+    alloc.retire(0)
+    # a reader maps the pinned pages copy-free
+    assert alloc.try_admit(1, pin.pages, reuse=7)
+    assert alloc.slot_pages(1) == pin.pages
+    alloc.check()
+    # entry eviction (index cap or reclaim) releases the pin — the shared
+    # pages stay alive under the reader, only the unshared refs free
+    alloc.release(pin.pin_id)
+    alloc.check()
+    for p in pin.pages:
+        assert alloc.refs[p] == 1  # reader's reference survives
+    # the reader's first divergent write copy-on-writes nothing now (it
+    # owns the pages exclusively after the pin dropped)
+    assert alloc.prepare_write(1, 7, 1) == []
+    alloc.retire(1)
+    alloc.check()
+    assert alloc.free_pages == 7  # everything back, nothing leaked
 
 
 # ------------------------------------------------- bit-equivalence: warm/cold
@@ -373,7 +393,8 @@ async def test_warmup_compiles_every_bucket_and_mixed_traffic_recompiles_nothing
     # ladders are warmed bucket-by-bucket (jit caches count executables)
     assert base["chunk"] >= len(sched.chunk_buckets)
     assert base["draft_admit"] >= len(sched.admit_buckets)
-    for prog in ("step", "draft", "verify", "gather", "capture"):
+    assert base["copy"] >= len(sched.pool.copy_buckets)
+    for prog in ("step", "draft", "verify"):
         assert base.get(prog, 0) >= 1, (prog, base)
     ids = _shared_prompts(8, shared=4, seed=41)
     oracle = _oracle(params, ids)
