@@ -323,6 +323,16 @@ class TpuSpec(_Spec):
     # tolerance-close, not bit-identical, to the fp pool. "" keeps the
     # computation dtype.
     decode_kv_dtype: str = ""
+    # Tensor-parallel decode over a named device mesh (parallel/tp.py):
+    # e.g. {"tp": 4} shards decoder params, the paged KV page pool, and
+    # the draft's flat cache on the attention HEAD axis (FFN on its
+    # hidden axis) across 4 devices, with the per-layer all-reduces
+    # fused into the step/chunk/verify programs. Exactly ONE axis;
+    # n_heads and ffn (target AND draft) must be divisible by its size,
+    # which must not exceed the attached devices. Needs decode_slots > 0;
+    # greedy output stays token-identical to the single-device scheduler
+    # at any width. {} (default) keeps single-device dispatch.
+    decode_mesh_axes: dict[str, int] = Field(default_factory=dict)
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

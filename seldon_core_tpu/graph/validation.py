@@ -137,6 +137,34 @@ def validate_deployment(dep: SeldonDeployment) -> None:
                 f"({pred.tpu.decode_slots + 1}) — the page budget cannot "
                 "host the configured concurrency"
             )
+        if pred.tpu.decode_mesh_axes:
+            # tensor-parallel decode (parallel/tp.py): structural rules
+            # here; the head/FFN divisibility rules need the model's
+            # geometry and are enforced at scheduler build (hard error on
+            # direct construction, warn-and-disable through serving)
+            if len(pred.tpu.decode_mesh_axes) != 1:
+                problems.append(
+                    f"predictor '{pred.name}' decode_mesh_axes must name "
+                    f"exactly one tensor-parallel axis, got "
+                    f"{dict(pred.tpu.decode_mesh_axes)}"
+                )
+            for axis, size in pred.tpu.decode_mesh_axes.items():
+                if size < 1:
+                    problems.append(
+                        f"predictor '{pred.name}' decode_mesh_axes axis "
+                        f"'{axis}' must be >= 1"
+                    )
+            if pred.tpu.decode_slots <= 0:
+                problems.append(
+                    f"predictor '{pred.name}' decode_mesh_axes needs "
+                    "decode_slots > 0 (the continuous-batching scheduler "
+                    "owns the sharded decode programs)"
+                )
+            # NO device-budget check here: validation may run on a
+            # control-plane host (operator/reconciler) whose device count
+            # says nothing about the data plane's — same reason tpu.mesh
+            # only checks sizes > 0. The data plane enforces the budget at
+            # scheduler build (decode_mesh_problems) with warn-disable.
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

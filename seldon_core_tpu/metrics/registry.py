@@ -120,6 +120,14 @@ class NullMetrics:
         """Pool pressure reclaimed ``pins`` LRU prefix pins."""
         pass
 
+    def decode_kv_per_device(self, deployment: str, pages: int, tp: int) -> None:
+        """Allocated (live + prefix) pool pages resident on EACH mesh
+        device, labeled by the tensor-parallel width: the page axis is
+        unsharded (heads shard instead), so the count is pool-wide while
+        per-page bytes scale 1/tp — together they read as per-device KV
+        HBM. tp=1 on single-device deployments."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -341,6 +349,13 @@ class Metrics(NullMetrics):
             ["deployment_name"],
             registry=registry,
         )
+        self._kv_per_device = Gauge(
+            "seldon_tpu_decode_kv_pages_per_device",
+            "Allocated KV pool pages resident per mesh device (page bytes "
+            "scale 1/tp under tensor-parallel head sharding)",
+            ["deployment_name", "tp"],
+            registry=registry,
+        )
         self._decode_ttft_split = Histogram(
             "seldon_tpu_decode_ttft_split_seconds",
             "TTFT split by admission path (warm = prefix hit, cold = full prefill)",
@@ -473,6 +488,9 @@ class Metrics(NullMetrics):
     def decode_kv_reclaimed(self, deployment, pins):
         if pins > 0:
             self._kv_reclaimed.labels(deployment).inc(pins)
+
+    def decode_kv_per_device(self, deployment, pages, tp):
+        self._kv_per_device.labels(deployment, str(tp)).set(pages)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

@@ -8,6 +8,14 @@ from seldon_core_tpu.parallel.mesh import (
     mesh_from_spec,
     replicated,
 )
+from seldon_core_tpu.parallel.tp import (
+    decode_mesh_problems,
+    decode_tp_mesh,
+    decoder_param_pspecs,
+    decoder_param_shardings,
+    kv_sharding,
+    tp_width,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -15,7 +23,13 @@ __all__ = [
     "MODEL_AXIS",
     "SEQ_AXIS",
     "data_sharding",
+    "decode_mesh_problems",
+    "decode_tp_mesh",
+    "decoder_param_pspecs",
+    "decoder_param_shardings",
     "initialize_distributed",
+    "kv_sharding",
     "mesh_from_spec",
     "replicated",
+    "tp_width",
 ]

@@ -41,6 +41,15 @@ scheduling over a vLLM-style PAGED KV pool into the stack:
   computed in fixed-size chunk buckets interleaved with decode steps
   (Sarathi-style), so a long admission wave no longer stalls every
   running slot's inter-token latency for a whole monolithic prefill.
+- Tensor-parallel decode (``tpu.decode_mesh_axes``, e.g. ``{"tp": 4}``):
+  every fused program runs SPMD over a named device mesh
+  (parallel/tp.py): decoder params, the paged page pool, and the
+  draft's flat cache shard on the attention HEAD axis, the FFN on its
+  hidden axis, with the per-layer all-reduces fused into the step
+  programs by GSPMD. Block tables, the allocator, and the prefix index
+  stay host-side and device-agnostic — admission/CoW/reclaim logic is
+  untouched, and greedy output stays token-identical to the
+  single-device scheduler at any width.
 - Draft-model speculation (``tpu.decode_draft_model`` + ``decode_spec_k``)
   amortizes each target dispatch over k proposed tokens: a small draft
   decoder proposes k tokens per slot in ONE fused dispatch, the target
@@ -90,6 +99,12 @@ from seldon_core_tpu.models.decoder import (
     prefill,
     sample_tokens,
     speculative_accept,
+)
+from seldon_core_tpu.parallel.tp import (
+    decode_mesh_problems,
+    decode_tp_mesh,
+    decoder_param_shardings,
+    kv_sharding,
 )
 from seldon_core_tpu.serving.kv_pool import PagedKVPool
 
@@ -379,6 +394,7 @@ class DecodeScheduler:
         kv_page_size: int = 0,
         kv_pages: int = 0,
         kv_dtype: str = "",
+        mesh_axes: dict | None = None,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         dtype=jnp.float32,
@@ -472,6 +488,64 @@ class DecodeScheduler:
                     f"than seq_len + max_new_tokens ({self.max_ctx})"
                 )
 
+        # tensor-parallel decode mesh (parallel/tp.py): params (target AND
+        # draft) are committed to the head/FFN partitioning up front, so
+        # every jit below traces against the sharded layout and GSPMD
+        # fuses the per-layer all-reduces into the already-fused programs.
+        # Raises on an unservable request (too many devices, indivisible
+        # heads/ffn) — the serving builder pre-checks and warn-disables.
+        self.mesh, self._tp_axis, self.tp = decode_tp_mesh(
+            mesh_axes, params, self.draft_params
+        )
+        if self.mesh is not None:
+            self.params = params = jax.device_put(
+                params, decoder_param_shardings(params, self.mesh, self._tp_axis)
+            )
+            if self.spec_enabled:
+                self.draft_params = draft_params = jax.device_put(
+                    draft_params,
+                    decoder_param_shardings(draft_params, self.mesh, self._tp_axis),
+                )
+        # span attributes distinguishing sharded deployments in /traces
+        self._mesh_attrs = (
+            {
+                "tp": self.tp,
+                "mesh_axes": ",".join(f"{k}={v}" for k, v in (mesh_axes or {}).items()),
+            }
+            if self.mesh is not None
+            else {}
+        )
+
+        if self.prefix_enabled:
+            self._prefix_index = PrefixIndex(self.prefix_slots)
+
+        # the paged KV pool both live slots and the prefix cache allocate
+        # from (serving/kv_pool.py) — geometry/validation live there. On a
+        # decode mesh the pool payloads commit HEAD-sharded (int8 scale
+        # planes replicated) and the CoW ladder pins matching output
+        # shardings; single-device keeps the PR 5 behavior of matching
+        # the params' sharding (the defaulted serving path).
+        self.pool = PagedKVPool(
+            params,
+            n_slots=n_slots,
+            cache_ctx=self._cache_ctx,
+            page_size=kv_page_size,
+            n_pages=kv_pages,
+            kv_dtype=kv_dtype,
+            dtype=dtype,
+            place=lambda arrs: self._commit_kv(params, arrs),
+            shardings_fn=(
+                (lambda a: kv_sharding(self.mesh, self._tp_axis, a))
+                if self.mesh is not None
+                else None
+            ),
+        )
+        if self.prefix_enabled:
+            self.pool.alloc.on_pins_reclaimed = self._on_pins_reclaimed
+        if self.spec_enabled:
+            self._dck, self._dcv = self._commit_kv(
+                draft_params, init_slot_cache(draft_params, n_slots, self._draft_ctx, dtype)
+            )
         # compiled programs — the pool state tuple is donated so page
         # updates are in-place in HBM. The step program is ONE executable;
         # the chunk ladder compiles one per bucket; the pool's CoW copy
@@ -479,15 +553,40 @@ class DecodeScheduler:
         # on, three more join: the k-step draft loop, the widened paged
         # verify, and the draft's transition-time flat prompt prefill. The
         # plain step program stays warm either way — it serves rounds
-        # where every active slot's effective spec_k is 0.
-        self._step_fn = jax.jit(_fused_step, donate_argnums=(1,))
-        self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,))
+        # where every active slot's effective spec_k is 0. On a decode
+        # mesh, OUTPUT shardings are pinned to the mesh layout so the
+        # donated pool/draft state round-trips every program with one
+        # stable signature (warmup == live traffic — zero recompiles,
+        # same as single-device).
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            pool_sh = self.pool.state_shardings
+            step_kw = {"out_shardings": (rep, pool_sh)}
+            verify_kw = {"out_shardings": (rep, rep, pool_sh)}
+            dc_sh = (
+                tuple(
+                    kv_sharding(self.mesh, self._tp_axis, a)
+                    for a in (self._dck, self._dcv)
+                )
+                if self.spec_enabled
+                else None
+            )
+            draft_kw = {"out_shardings": (rep, rep) + dc_sh} if dc_sh else {}
+            draft_admit_kw = {"out_shardings": dc_sh} if dc_sh else {}
+        else:
+            step_kw = verify_kw = draft_kw = draft_admit_kw = {}
+        self._step_fn = jax.jit(_fused_step, donate_argnums=(1,), **step_kw)
+        self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,), **step_kw)
         if self.spec_enabled:
             self._draft_fn = jax.jit(
-                _fused_draft, donate_argnums=(1, 2), static_argnums=(9,)
+                _fused_draft, donate_argnums=(1, 2), static_argnums=(9,), **draft_kw
             )
-            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1,))
-            self._draft_admit_fn = jax.jit(_fused_draft_admit, donate_argnums=(1, 2))
+            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1,), **verify_kw)
+            self._draft_admit_fn = jax.jit(
+                _fused_draft_admit, donate_argnums=(1, 2), **draft_admit_kw
+            )
             # wave buckets for the draft's transition-time flat prefill —
             # the only surviving consumer of the admit ladder now that the
             # target side admits through the chunk programs
@@ -497,27 +596,6 @@ class DecodeScheduler:
                 buckets.append(b)
                 b *= 2
             self.admit_buckets = tuple(buckets) + (n_slots,)
-        if self.prefix_enabled:
-            self._prefix_index = PrefixIndex(self.prefix_slots)
-
-        # the paged KV pool both live slots and the prefix cache allocate
-        # from (serving/kv_pool.py) — geometry/validation live there
-        self.pool = PagedKVPool(
-            params,
-            n_slots=n_slots,
-            cache_ctx=self._cache_ctx,
-            page_size=kv_page_size,
-            n_pages=kv_pages,
-            kv_dtype=kv_dtype,
-            dtype=dtype,
-            place=lambda arrs: self._place_like(params, arrs),
-        )
-        if self.prefix_enabled:
-            self.pool.alloc.on_pins_reclaimed = self._on_pins_reclaimed
-        if self.spec_enabled:
-            self._dck, self._dcv = self._place_like(
-                draft_params, init_slot_cache(draft_params, n_slots, self._draft_ctx, dtype)
-            )
         # on an accelerator, device dispatch + token readback block the
         # calling thread for the device-step latency — run them on the
         # shared compute pool so the serving event loop (ingress, batcher
@@ -560,6 +638,20 @@ class DecodeScheduler:
         # waiting request blocked for N rounds counts N — a round counter,
         # not an admission counter)
         self.stat_admit_blocked_rounds = 0
+
+    def _commit_kv(self, params, arrs):
+        """Commit cache/pool buffers to their serving-steady sharding
+        before any compile. On a decode mesh that is the tensor-parallel
+        layout (5-D KV payloads head-sharded, scale planes replicated —
+        parallel/tp.py); otherwise the PR 5 behavior: match the params'
+        sharding so the defaulted (mesh-committed-params) serving path
+        warms the exact signatures live traffic presents."""
+        if self.mesh is not None:
+            return tuple(
+                jax.device_put(a, kv_sharding(self.mesh, self._tp_axis, a))
+                for a in arrs
+            )
+        return self._place_like(params, arrs)
 
     @staticmethod
     def _place_like(params, arrs):
@@ -791,6 +883,62 @@ class DecodeScheduler:
         self._metrics.decode_kv_pool(
             self._deployment, a.free_pages, a.live_pages, a.prefix_pages
         )
+        # pages resident per device: the page axis is NOT sharded (every
+        # device holds all pages x its head shard), so the count matches
+        # the pool-wide allocation while per-page BYTES scale 1/tp — the
+        # tp label is what makes the gauge readable as per-device HBM
+        self._metrics.decode_kv_per_device(
+            self._deployment, a.live_pages + a.prefix_pages, self.tp
+        )
+
+    def shard_audit(self) -> dict:
+        """Per-shard audit of the device pools on a decode mesh (the soak
+        harness runs this beside the allocator's host-side ``check()``):
+        every pool/draft-cache component must be laid out across exactly
+        the mesh devices, 5-D payloads carrying heads/tp per shard and
+        replicated components full-size. Raises AssertionError on any
+        divergence; returns a small report dict."""
+        if self.mesh is None:
+            return {
+                "tp": 1,
+                "kv_pages_per_device": self.pool.alloc.live_pages
+                + self.pool.alloc.prefix_pages,
+            }
+        mesh_devices = set(self.mesh.devices.flat)
+        audited = 0
+
+        def _check(name: str, arr) -> None:
+            nonlocal audited
+            devs = {s.device for s in arr.addressable_shards}
+            if devs != mesh_devices:
+                raise AssertionError(
+                    f"{name}: shards on {len(devs)} devices, mesh has "
+                    f"{len(mesh_devices)}"
+                )
+            want = list(arr.shape)
+            if arr.ndim == 5:
+                if want[2] % self.tp:
+                    raise AssertionError(f"{name}: head axis {want[2]} % tp != 0")
+                want[2] //= self.tp
+            for s in arr.addressable_shards:
+                if list(s.data.shape) != want:
+                    raise AssertionError(
+                        f"{name}: shard shape {list(s.data.shape)} != {want}"
+                    )
+            audited += 1
+
+        for i, a in enumerate(self.pool.state):
+            _check(f"pool[{i}]", a)
+        if self.spec_enabled:
+            _check("draft_k", self._dck)
+            _check("draft_v", self._dcv)
+        return {
+            "tp": self.tp,
+            "mesh_devices": len(mesh_devices),
+            "components_audited": audited,
+            "kv_pages_per_device": self.pool.alloc.live_pages
+            + self.pool.alloc.prefix_pages,
+        }
 
     def _maybe_capture(self, seq: _Seq, slot: int, length: int) -> None:
         """Pin ``slot``'s leading prompt pages as a prefix entry when the
@@ -934,7 +1082,7 @@ class DecodeScheduler:
                 ms = c.buf.begin(
                     "decode.prefix_match" if self.prefix_enabled else "decode.admit",
                     c.span.span_id,
-                    {"slot": slot, "hit": reuse > 0},
+                    {"slot": slot, "hit": reuse > 0, **self._mesh_attrs},
                     start_ns=t0,
                 )
                 ms.add_event("reuse", {"tokens": reuse})
@@ -1068,7 +1216,10 @@ class DecodeScheduler:
             for c in seq.trace_ctxs:
                 seq.gen_spans.append(
                     c.buf.begin(
-                        "decode.generate", c.span.span_id, {"slot": i}, start_ns=t2
+                        "decode.generate",
+                        c.span.span_id,
+                        {"slot": i, **self._mesh_attrs},
+                        start_ns=t2,
                     )
                 )
             self._emit(seq, int(toks[i]))
@@ -1121,7 +1272,7 @@ class DecodeScheduler:
                 vs = c.buf.begin(
                     "decode.verify",
                     c.span.span_id,
-                    {"slot": i, "proposed": int(limits[i])},
+                    {"slot": i, "proposed": int(limits[i]), **self._mesh_attrs},
                     start_ns=t0,
                 )
                 vs.add_event("accept", {"accepted": int(acc[i])})
@@ -1217,6 +1368,9 @@ class DecodeScheduler:
                     copies += self.pool.alloc.prepare_write(i, seq.pos, width)
                 await self._run_copies(copies)
                 bt = self.pool.block_tables()
+                # per-round pool gauges: this round's prepare_write may
+                # have allocated/CoW'd pages with no admission in between
+                self._kv_gauges()
 
                 if spec_round:
                     await self._spec_round(bt, toks, pos, temps, topks, limits, tick)
@@ -1272,7 +1426,7 @@ class DecodeScheduler:
             # that pointed into it.
             self.pool.reset()
             if self.spec_enabled:
-                self._dck, self._dcv = self._place_like(
+                self._dck, self._dcv = self._commit_kv(
                     self.draft_params,
                     init_slot_cache(
                         self.draft_params, self.n_slots, self._draft_ctx, self._dtype
@@ -1427,6 +1581,21 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
             draft_uri, spec_k,
         )
         spec_k = 0
+    mesh_axes = dict(getattr(tpu_spec, "decode_mesh_axes", {}) or {})
+    if mesh_axes:
+        # the spec-mode precedent: an unservable opt-in degrades to the
+        # working config with a log line, instead of failing the boot —
+        # here that means single-device dispatch when the mesh request
+        # exceeds the attached devices or the decoder's head/FFN geometry
+        # isn't divisible by the tensor-parallel width
+        problems = decode_mesh_problems(mesh_axes, runtime.params, draft_params)
+        if problems:
+            log.warning(
+                "decode_mesh_axes=%s unservable (%s) — tensor-parallel "
+                "decode disabled, running single-device",
+                mesh_axes, "; ".join(problems),
+            )
+            mesh_axes = {}
     return DecodeScheduler(
         runtime.params,
         seq_len=int(gen["seq"]),
@@ -1445,6 +1614,7 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         kv_page_size=int(getattr(tpu_spec, "decode_kv_page_size", 0)),
         kv_pages=int(getattr(tpu_spec, "decode_kv_pages", 0)),
         kv_dtype=str(getattr(tpu_spec, "decode_kv_dtype", "") or ""),
+        mesh_axes=mesh_axes,
         metrics=metrics,
         deployment_name=deployment_name,
         dtype=runtime.dtype,

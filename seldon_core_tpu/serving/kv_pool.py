@@ -347,6 +347,7 @@ class PagedKVPool:
         kv_dtype: str = "",
         dtype=None,
         place=None,
+        shardings_fn=None,
     ):
         import jax.numpy as jnp
 
@@ -368,7 +369,24 @@ class PagedKVPool:
         self.state = self._place(
             paged_kv_init(params, self.n_pages, self.page_size, self._dtype, kv_dtype)
         )
-        self._copy_fn = jax.jit(paged_copy, donate_argnums=(0,))
+        # tensor-parallel decode (parallel/tp.py): the scheduler hands a
+        # per-buffer sharding resolver so the pool state is committed to
+        # the decode mesh (payloads head-sharded, int8 scale planes
+        # replicated) and the CoW copy ladder pins the SAME shardings on
+        # its outputs — the donated state round-trips every program with
+        # one stable layout, which is what keeps warmup's signatures
+        # exactly the live ones (zero recompiles on the sharded geometry)
+        self.state_shardings = (
+            tuple(shardings_fn(a) for a in self.state)
+            if shardings_fn is not None
+            else None
+        )
+        copy_kw = (
+            {"out_shardings": self.state_shardings}
+            if self.state_shardings is not None
+            else {}
+        )
+        self._copy_fn = jax.jit(paged_copy, donate_argnums=(0,), **copy_kw)
         buckets, b = [], 1
         while b < self.n_slots:
             buckets.append(b)

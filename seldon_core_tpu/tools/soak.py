@@ -60,6 +60,7 @@ async def soak(
     spec_k: int = 0,
     prefix_share: float = 0.0,
     paged: bool = False,
+    tp: int = 0,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -79,7 +80,13 @@ async def soak(
         # the paged soak's point is CoW + reclaim under a SHARED/divergent
         # traffic mix — default the mix on when the caller didn't shape it
         prefix_share = 0.6
-    generative = spec_k > 0 or prefix_share > 0 or paged
+    if tp > 1 and not paged:
+        # the tp soak's point is the sharded program set under sustained
+        # load INCLUDING the paged copy/CoW ladder — default the pool on
+        paged = True
+        if prefix_share <= 0:
+            prefix_share = 0.6
+    generative = spec_k > 0 or prefix_share > 0 or paged or tp > 1
     if generative:
         if model != "iris_mlp":
             import sys as _sys
@@ -104,10 +111,23 @@ async def soak(
             {"name": "resid_scale", "value": "0.1", "type": "FLOAT"},
         ]
         predictor_extra["tpu"] = {"decode_slots": 4}
+        if tp > 1:
+            # tensor-parallel mesh: hidden 256 -> 4 heads / ffn 1024, both
+            # divisible by every width the 8-device host mesh can carry
+            graph["parameters"] += [
+                {"name": "hidden", "value": "256", "type": "INT"},
+                {"name": "ffn", "value": "1024", "type": "INT"},
+            ]
+            predictor_extra["tpu"]["decode_mesh_axes"] = {"tp": tp}
         if spec_k > 0:
+            draft_uri = "zoo://draft?layers=1&resid_scale=0.1"
+            if tp > 1:
+                # the draft shards on the same mesh — pin its geometry to
+                # the target's (only vocab/max_len are auto-injected)
+                draft_uri += "&hidden=256&ffn=1024"
             predictor_extra["tpu"].update(
                 decode_spec_k=spec_k,
-                decode_draft_model="zoo://draft?layers=1&resid_scale=0.1",
+                decode_draft_model=draft_uri,
             )
         if prefix_share > 0:
             predictor_extra["tpu"].update(
@@ -301,6 +321,29 @@ async def soak(
         # end-of-run allocator audit: a soak that leaked or double-freed a
         # page fails loudly here rather than reporting a green run
         a.check()
+    tp_stats = None
+    if tp > 1:
+        # a --tp soak that silently fell back to single-device (mesh
+        # warn-disabled, too few devices, no scheduler) would report a
+        # vacuously green run with the shard audit never executed — the
+        # exact failure mode a CI gate keyed on exit code must not miss
+        if sched is None or sched.tp != tp:
+            raise RuntimeError(
+                f"soak --tp {tp}: scheduler runs at tp="
+                f"{getattr(sched, 'tp', None)} — the mesh request was "
+                "disabled (device count or head/ffn divisibility); the "
+                "sharded geometry was NOT exercised"
+            )
+        # per-shard audit beside the allocator's host-side check(): every
+        # pool/draft-cache buffer must be laid out across exactly the mesh
+        # devices with head-sharded payloads — a soak that drifted a
+        # buffer off the mesh (or silently replicated a shard) fails
+        # loudly here rather than reporting a green run
+        tp_stats = {
+            **sched.shard_audit(),
+            "requested_tp": tp,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
     prefix_stats = None
     if prefix_share > 0 and sched is not None:
         lookups = sched.stat_prefix_hits + sched.stat_prefix_misses
@@ -344,6 +387,7 @@ async def soak(
         **({"spec": spec_stats} if spec_stats is not None else {}),
         **({"prefix": prefix_stats} if prefix_stats is not None else {}),
         **({"paged": paged_stats} if paged_stats is not None else {}),
+        **({"tp": tp_stats} if tp_stats is not None else {}),
     }
 
 
@@ -397,6 +441,16 @@ def main(argv=None) -> None:
         "gains pages_shared / cow_copies / pins_reclaimed under 'paged' "
         "(implies --prefix-share 0.6 unless set)",
     )
+    ap.add_argument(
+        "--tp",
+        type=int,
+        default=0,
+        help="run the soak against a generative deployment decoded "
+        "tensor-parallel over an N-device mesh (decode_mesh_axes={'tp': N}; "
+        "forces an N-device host platform when no accelerator provides one, "
+        "implies --paged); the report gains the per-shard layout audit "
+        "under 'tp' and the end-of-run allocator check runs as usual",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -407,6 +461,24 @@ def main(argv=None) -> None:
         help="calls per unhealthy window (0 = steady error rate)",
     )
     args = ap.parse_args(argv)
+
+    if args.tp > 1:
+        # the host platform's device count is fixed at backend init — set
+        # the flag before anything imports jax (harmless when a real
+        # multi-chip backend is attached: the flag only shapes the CPU
+        # platform)
+        import os
+        import sys as _sys
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if (
+            "jax" not in _sys.modules
+            and "xla_force_host_platform_device_count" not in flags
+        ):
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={max(8, args.tp)}"
+            ).strip()
 
     def _run(fault_spec=None) -> dict:
         return asyncio.run(
@@ -421,6 +493,7 @@ def main(argv=None) -> None:
                 spec_k=args.spec_k,
                 prefix_share=args.prefix_share,
                 paged=args.paged,
+                tp=args.tp,
             )
         )
 

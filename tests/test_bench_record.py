@@ -164,6 +164,33 @@ def worst_case_full_record() -> dict:
             },
             "warm_ttft_speedup": 4.15,
         },
+        "tp": {
+            "scenario": {
+                "widths": [1, 2, 4], "devices": 8, "requests": 24,
+                "seq": 64, "shared_prefix": 56, "max_new": 8, "n_slots": 8,
+                "geometry": "paged+prefix, page_size 16",
+            },
+            "tp1": {
+                "tp": 1, "tokens_per_sec": 1388.41, "ttft_p50_ms": 40.11,
+                "ttft_p99_ms": 171.02, "inter_token_p99_ms": 22.18,
+                "recompiles_after_warmup": 0, "kv_pages_per_device": 20,
+                "mesh_devices": 1,
+            },
+            "tp2": {
+                "tp": 2, "tokens_per_sec": 1101.33, "ttft_p50_ms": 51.72,
+                "ttft_p99_ms": 201.44, "inter_token_p99_ms": 28.05,
+                "recompiles_after_warmup": 0, "kv_pages_per_device": 20,
+                "mesh_devices": 2, "outputs_identical_to_tp1": True,
+                "speedup_vs_tp1": 0.79,
+            },
+            "tp4": {
+                "tp": 4, "tokens_per_sec": 905.87, "ttft_p50_ms": 66.41,
+                "ttft_p99_ms": 255.13, "inter_token_p99_ms": 35.92,
+                "recompiles_after_warmup": 0, "kv_pages_per_device": 20,
+                "mesh_devices": 4, "outputs_identical_to_tp1": True,
+                "speedup_vs_tp1": 0.65,
+            },
+        },
         "tokens_per_sec_speedup": 2.64,
         "spec_tokens_per_sec_speedup": 1.71,
     }
@@ -276,6 +303,15 @@ def test_compact_record_carries_every_headline():
         "prefix_tok_s_chunked": 1389.77,
         "prefix_itl_p99": 44.91,
         "prefix_itl_p99_chunked": 21.08,
+        # tensor-parallel sub-leg: tokens/s per width (width order), the
+        # widest leg's speedup + identity contract, recompiles all-zero
+        "tp_widths": [1, 2, 4],
+        "tp_tok_s": [1388.41, 1101.33, 905.87],
+        "tp_ttft_p50": [40.11, 51.72, 66.41],
+        "tp_itl_p99": [22.18, 28.05, 35.92],
+        "tp_speedup": 0.65,
+        "tp_identical": True,
+        "tp_recompiles": [0, 0, 0],
     }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77
