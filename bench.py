@@ -48,6 +48,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -856,6 +857,170 @@ def serving_grpc_web_gateway(duration_s: float = 6.0, users: int = 32) -> dict:
     )
 
 
+def _gen_tree_leg(
+    n_requests: int = 24, n_slots: int = 4, rtt_floor_ms: float = 100.0
+) -> dict:
+    """gen.tree_*: multi-candidate TREE speculation (decode_spec_tree) vs
+    the PR 4 chain (decode_spec_k=4) vs plain decode, at the SAME
+    2-dispatch round shape, on a shared-prompt geometry (seq 32 with a
+    24-token shared system prefix, prefix cache on).
+
+    Two deliberate choices make this the leg where the tree's mechanism —
+    MORE accepted tokens per dispatch at the same dispatch count — is the
+    thing measured:
+
+    - **the draft is DISTILLED in-leg** (training/distill_draft.py, 150
+      KL steps against the target) rather than seed-shared-truncated: at
+      the truncation pair's ~0.95+ accept a chain already takes nearly
+      every proposal and sibling candidates have nothing to catch; the
+      distilled draft's moderate accept (~0.35 chain) is the regime real
+      (non-weight-shared) drafts live in, and where top-b branching
+      roughly doubles per-depth acceptance.
+    - **tokens/s is reported twice**: raw CPU, and under a per-dispatch
+      RTT floor (asyncio latency injected per device call) modeling the
+      dispatch-latency-bound regime the chip harness actually serves in —
+      the tunnel's measured per-dispatch floor is 116–141 ms (see the
+      MULTICHIP records); the floor here is a conservative 100 ms. On the
+      raw CPU backend a widened dispatch is real arithmetic, so width
+      costs ~linearly and the tree trails the chain; under the floor the
+      round COUNT is the cost, which is exactly what the tree reduces.
+      The accelerator regime sits between, nearer the floor twin (a
+      widened decode dispatch is memory-bandwidth-bound on chip).
+
+    Greedy outputs are asserted bit-identical across plain/chain/tree —
+    the tokens/s columns price the SAME tokens."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from seldon_core_tpu.models.decoder import init_decoder
+    from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
+    from seldon_core_tpu.training.distill_draft import (
+        distill, load_draft_checkpoint,
+    )
+
+    seq, max_new, vocab, hidden, ffn, layers = 32, 32, 256, 64, 256, 2
+    max_len = seq + max_new
+    spec_k, spec_tree = 4, "2,2,1,1"
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "draft_distilled.npz")
+        distill_report = distill(
+            seed=0, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
+            max_len=max_len, resid_scale=1.0, draft_layers=1,
+            seq=8, horizon=24, batch=16, steps=150, log_every=0, out=ckpt,
+        )
+        target = init_decoder(
+            0, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
+            max_len=max_len, resid_scale=1.0,
+        )
+        draft = load_draft_checkpoint(
+            ckpt,
+            init_decoder(
+                0, vocab=vocab, hidden=hidden, layers=1, ffn=ffn,
+                max_len=max_len, resid_scale=1.0,
+            ),
+        )
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, vocab, 24).astype(np.int32)
+    prompts = np.stack([
+        np.concatenate([shared, rng.integers(0, vocab, seq - 24)]).astype(np.int32)
+        for _ in range(n_requests)
+    ])
+    rtt_s = rtt_floor_ms / 1000.0
+
+    async def run(rtt: bool, **kw) -> tuple[dict, list]:
+        s = DecodeScheduler(
+            target, seq_len=seq, max_new_tokens=max_new, n_slots=n_slots,
+            prefix_slots=8, **kw,
+        )
+        s.warmup()
+        if rtt:
+            orig = s._device_call
+
+            async def floored(fn):
+                res = await orig(fn)
+                await asyncio.sleep(rtt_s)
+                return res
+
+            s._device_call = floored
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            await asyncio.sleep(i * 0.002)
+            return await s.submit(prompts[i])
+
+        outs = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        elapsed = time.perf_counter() - t0
+        res = {
+            "tokens_per_sec": round(n_requests * max_new / elapsed, 1),
+            "dispatches": s.stat_steps + s.stat_chunk_dispatches,
+            "recompiles_after_warmup": s.recompiles_since_warmup(),
+        }
+        if s.spec_enabled:
+            res["accept_rate"] = round(
+                s.stat_spec_accepted / max(s.stat_spec_proposed, 1), 3
+            )
+            # per-SLOT accepted+bonus per verify dispatch: the
+            # amortization one sequence sees — the tree-vs-chain claim
+            res["tokens_per_ride"] = round(
+                s.stat_spec_ride_emitted / max(s.stat_spec_rides, 1), 2
+            )
+            res["spec_dispatches"] = s.stat_spec_dispatches
+        await s.close()
+        return res, [np.asarray(o) for o in outs]
+
+    async def drive() -> dict:
+        legs: dict = {}
+        baseline_outs = None
+        for mode, kw in (
+            ("plain", {}),
+            ("chain", {"draft_params": draft, "spec_k": spec_k}),
+            ("tree", {"draft_params": draft, "spec_tree": spec_tree}),
+        ):
+            raw, outs = await run(False, **kw)
+            rtt, outs2 = await run(True, **kw)
+            if baseline_outs is None:
+                baseline_outs = outs
+            ident = all(
+                np.array_equal(a, b) for a, b in zip(outs, baseline_outs)
+            ) and all(np.array_equal(a, b) for a, b in zip(outs2, baseline_outs))
+            assert ident, f"greedy {mode} output diverged from plain"
+            legs[mode] = {
+                **{k: v for k, v in raw.items() if k != "tokens_per_sec"},
+                "tokens_per_sec_raw": raw["tokens_per_sec"],
+                "tokens_per_sec_rtt": rtt["tokens_per_sec"],
+            }
+        return legs
+
+    legs = asyncio.run(drive())
+    return {
+        "scenario": {
+            "requests": n_requests, "n_slots": n_slots, "seq": seq,
+            "shared_prefix": 24, "max_new": max_new,
+            "model": f"hidden {hidden} x {layers}L, vocab {vocab}",
+            "draft": "1L, KL-distilled in-leg (150 steps, resid_scale=1.0)",
+            "spec_k": spec_k, "spec_tree": spec_tree,
+            "rtt_floor_ms": rtt_floor_ms,
+        },
+        "distill": {
+            k: distill_report[k]
+            for k in ("accept_proxy_before", "accept_proxy_after", "final_kl")
+        },
+        **legs,
+        "outputs_identical": True,
+        "tokens_per_ride_vs_chain": round(
+            legs["tree"]["tokens_per_ride"] / max(legs["chain"]["tokens_per_ride"], 1e-9),
+            2,
+        ),
+        "rtt_speedup_vs_chain": round(
+            legs["tree"]["tokens_per_sec_rtt"]
+            / max(legs["chain"]["tokens_per_sec_rtt"], 1e-9),
+            2,
+        ),
+    }
+
+
 def serving_gen_cpu(
     n_requests: int = 64, n_slots: int = 8, stagger_ms: float = 2.0
 ) -> dict:
@@ -954,7 +1119,7 @@ def serving_gen_cpu(
             meta=Meta(tags={"max_new_tokens": int(budgets[i])}),
         )
 
-    async def run_scheduler(spec: bool = False) -> dict:
+    async def run_scheduler(spec: bool = False) -> tuple[dict, list]:
         server = PredictorServer(
             _pred(n_slots, spec=spec), deployment_name="gen-spec" if spec else "gen"
         )
@@ -963,12 +1128,15 @@ def serving_gen_cpu(
         server.decode_scheduler._metrics = rec
         t0 = time.perf_counter()
 
-        async def one(i: int) -> int:
+        async def one(i: int) -> np.ndarray:
             await asyncio.sleep(i * stagger_s)
             out = await server.service.predict(_msg(i))
-            return int(out.meta.tags["gen_lens"][0])
+            arr = np.atleast_2d(np.asarray(out.array))[0]
+            return arr[: SEQ_TOK + int(out.meta.tags["gen_lens"][0])]
 
-        tokens = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        SEQ_TOK = seq
+        outs = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        tokens = [len(o) - seq for o in outs]
         elapsed = time.perf_counter() - t0
         sched = server.decode_scheduler
         out = {
@@ -994,7 +1162,7 @@ def serving_gen_cpu(
         if server.batcher is not None:
             await server.batcher.close()
         assert list(tokens) == [int(b) for b in budgets], "budget mismatch"
-        return out
+        return out, outs
 
     async def run_scan() -> dict:
         server = PredictorServer(_pred(0), deployment_name="gen-scan")
@@ -1224,8 +1392,15 @@ def serving_gen_cpu(
             await server.batcher.close()
         return out, np.stack(outs)
 
-    sched = asyncio.run(run_scheduler())
-    spec = asyncio.run(run_scheduler(spec=True))
+    sched, sched_outs = asyncio.run(run_scheduler())
+    spec, spec_outs = asyncio.run(run_scheduler(spec=True))
+    # greedy speculative output must be bit-identical to the plain
+    # scheduler (the equivalence contract the tests pin); tokens/s is
+    # then an apples-to-apples rate of the SAME tokens
+    assert all(
+        np.array_equal(a, b) for a, b in zip(spec_outs, sched_outs)
+    ), "chain-spec output diverged from plain"
+    tree = _gen_tree_leg()
     scan = asyncio.run(run_scan())
     prefix_mono, prefix_mono_out = asyncio.run(run_prefix(0))
     prefix_chunked, prefix_chunked_out = asyncio.run(run_prefix(8))
@@ -1274,6 +1449,7 @@ def serving_gen_cpu(
         },
         "scheduler": sched,
         "spec": spec,
+        "tree": tree,
         "scan": scan,
         "prefix": prefix,
         "paged": {
@@ -1921,6 +2097,25 @@ def compact_record(full: dict) -> dict:
             c["gen"]["tok_disp"] = gp.get("tokens_per_dispatch")
             c["gen"]["spec_speedup"] = gen.get("spec_tokens_per_sec_speedup")
             c["gen"]["spec_k"] = (gen.get("scenario") or {}).get("spec_k")
+        gt_tree = gen.get("tree") or {}
+        if gt_tree:
+            # tree-speculation sub-leg: same 2-dispatch round at proposal
+            # WIDTH, distilled draft, RTT-floor twin — the headline
+            # comparison vs the chain is accepted-tokens-per-dispatch
+            # (tok_ride, per slot) at equal dispatch cost, and tokens/s
+            # in the dispatch-latency-bound regime
+            tchain = gt_tree.get("chain") or {}
+            ttree = gt_tree.get("tree") or {}
+            # [tree, chain] pairs keep the byte budget: tokens/s under
+            # the RTT floor and per-slot accepted+bonus per dispatch
+            # (identity + distill delta live in the full record/PARITY)
+            c["gen"]["tree_tok_s"] = [
+                ttree.get("tokens_per_sec_rtt"), tchain.get("tokens_per_sec_rtt"),
+            ]
+            c["gen"]["tree_ride"] = [
+                ttree.get("tokens_per_ride"), tchain.get("tokens_per_ride"),
+            ]
+            c["gen"]["tree_speedup"] = gt_tree.get("rtt_speedup_vs_chain")
         gx = gen.get("prefix") or {}
         if gx:
             # prefix-cache sub-leg: cold-vs-warm TTFT, hit rate, prefill

@@ -284,6 +284,31 @@ class TpuSpec(_Spec):
     # meta.tags["spec_k"] override; spec_k=0 there opts a request out.
     decode_draft_model: str = ""
     decode_spec_k: int = 0
+    # Tree speculation (models/spec_tree.py): per-depth top-b branching,
+    # e.g. "4,2,1" — the draft proposes 4 candidates at depth 1, 2 per
+    # surviving branch at depth 2, 1 at depth 3, and the whole flattened
+    # tree is scored in the ONE widened verify dispatch (Medusa/EAGLE/
+    # SpecInfer-style), so accepted-tokens-per-dispatch rises at the same
+    # 2-dispatch round cost. Needs decode_draft_model; subsumes
+    # decode_spec_k (the tree's depth plays its role — a chain IS the
+    # degenerate "1,1,...,1" tree). The flattened tree is capped at
+    # spec_tree.MAX_TREE_NODES nodes (verify-width headroom). Requests
+    # may tighten per-depth widths (never widen) via
+    # meta.tags["spec_tree"]; greedy output stays bit-identical to the
+    # plain scheduler, temperature > 0 uses per-depth recursive rejection
+    # resampling so the output distribution is unchanged. Composes with
+    # paged/int8 KV, the prefix cache, and decode_mesh_axes (the tree
+    # axis is replicated; heads stay sharded).
+    decode_spec_tree: str = ""
+    # Accept-rate-adaptive speculation: > 0 enables a rolling (EWMA)
+    # accept-rate estimate that scales the EFFECTIVE speculation depth
+    # between plain decode (estimate below the floor — a cold or
+    # adversarial workload stops paying draft + widened-verify cost,
+    # with a periodic depth-1 probe so the estimate can recover) and the
+    # configured spec_k / tree-depth ceiling. Adaptation changes only
+    # per-slot limit DATA, never program shapes — zero recompiles by
+    # construction. 0 (default) pins the configured shape.
+    decode_spec_accept_floor: float = 0.0
     # Prefix-cache KV reuse for the decode scheduler: > 0 allocates a
     # device-resident prefix pool of that many rows beside the slot cache,
     # indexed host-side by prompt token prefixes (radix trie, longest-

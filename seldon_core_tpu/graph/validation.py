@@ -165,6 +165,73 @@ def validate_deployment(dep: SeldonDeployment) -> None:
             # says nothing about the data plane's — same reason tpu.mesh
             # only checks sizes > 0. The data plane enforces the budget at
             # scheduler build (decode_mesh_problems) with warn-disable.
+        if pred.tpu.decode_spec_k < 0:
+            problems.append(f"predictor '{pred.name}' decode_spec_k must be >= 0")
+        if pred.tpu.decode_spec_k > 0 or pred.tpu.decode_spec_tree:
+            # speculation knobs configure the continuous-batching
+            # scheduler and need a draft to propose with — without either
+            # they were previously only caught (or silently ignored) at
+            # trace time
+            if pred.tpu.decode_slots <= 0:
+                problems.append(
+                    f"predictor '{pred.name}' decode_spec_k/decode_spec_tree "
+                    "need decode_slots > 0 (the continuous-batching scheduler)"
+                )
+            if not pred.tpu.decode_draft_model:
+                problems.append(
+                    f"predictor '{pred.name}' decode_spec_k/decode_spec_tree "
+                    "need decode_draft_model (the draft that proposes)"
+                )
+        if pred.tpu.decode_spec_tree:
+            # the tree shape must parse AND fit the widened-verify /
+            # draft-cache headroom: the verify dispatch materializes
+            # [n_slots, 1 + n_tree, vocab] logits, so the flattened node
+            # count is capped (MAX_TREE_NODES) — an oversized tree (or a
+            # typo'd branching like "44" for "4,4") is a config error,
+            # caught here instead of at trace time. No decode_mesh_axes
+            # divisibility constraint exists for the tree: its axis is
+            # REPLICATED over the mesh (parallel/tp.tree_node_sharding) —
+            # the head/FFN divisibility rules, unchanged by the tree, are
+            # the only mesh constraints and are enforced at scheduler
+            # build where the model geometry is known.
+            from seldon_core_tpu.models.spec_tree import MAX_TREE_NODES, SpecTree
+
+            try:
+                tree = SpecTree.from_text(pred.tpu.decode_spec_tree)
+            except ValueError as e:
+                problems.append(f"predictor '{pred.name}' decode_spec_tree: {e}")
+            else:
+                if tree.n_tree > MAX_TREE_NODES:
+                    problems.append(
+                        f"predictor '{pred.name}' decode_spec_tree "
+                        f"'{pred.tpu.decode_spec_tree}' flattens to "
+                        f"{tree.n_tree} nodes — the widened verify dispatch "
+                        f"caps at {MAX_TREE_NODES}"
+                    )
+        elif pred.tpu.decode_spec_k > 0:
+            # the chain rides the same widened dispatch (a k-chain IS a
+            # branching-1 tree of k nodes) — same headroom cap; an
+            # oversized meta.tags.spec_k can only TIGHTEN below this
+            from seldon_core_tpu.models.spec_tree import MAX_TREE_NODES
+
+            if pred.tpu.decode_spec_k > MAX_TREE_NODES:
+                problems.append(
+                    f"predictor '{pred.name}' decode_spec_k "
+                    f"({pred.tpu.decode_spec_k}) exceeds the widened-verify "
+                    f"headroom ({MAX_TREE_NODES} proposed tokens per dispatch)"
+                )
+        if not (0.0 <= pred.tpu.decode_spec_accept_floor < 1.0):
+            problems.append(
+                f"predictor '{pred.name}' decode_spec_accept_floor "
+                f"({pred.tpu.decode_spec_accept_floor}) must be in [0, 1)"
+            )
+        if pred.tpu.decode_spec_accept_floor > 0 and not (
+            pred.tpu.decode_spec_k > 0 or pred.tpu.decode_spec_tree
+        ):
+            problems.append(
+                f"predictor '{pred.name}' decode_spec_accept_floor needs "
+                "decode_spec_k > 0 or decode_spec_tree (nothing to adapt)"
+            )
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

@@ -75,12 +75,30 @@ class NullMetrics:
         pass
 
     def decode_spec(
-        self, deployment: str, proposed: int, accepted: int, emitted: int
+        self,
+        deployment: str,
+        proposed: int,
+        accepted: int,
+        emitted: int,
+        mode: str = "chain",
     ) -> None:
-        """One speculative verify dispatch: ``proposed`` draft tokens
-        entered acceptance, ``accepted`` survived, ``emitted`` tokens
-        (accepted + one bonus per active slot) were emitted. Accept rate =
-        accepted_total / proposed_total."""
+        """One speculative verify dispatch: ``proposed`` depth positions
+        entered acceptance (draft tokens on a chain; path depths on a
+        tree), ``accepted`` survived, ``emitted`` tokens (accepted + one
+        bonus per active slot) were emitted. Accept rate = accepted_total
+        / proposed_total. ``mode`` labels the per-dispatch amortization
+        histogram "chain" | "tree" so the two round shapes compare
+        directly at the same 2-dispatch cost."""
+        pass
+
+    def decode_spec_tree(self, deployment: str, nodes: int, path_len: int) -> None:
+        """One slot's ride on a TREE verify dispatch: ``nodes`` candidate
+        nodes were allowed by the slot's per-depth width mask (the
+        adapt/tighten budget — the dispatch's static width is the
+        deployment tree), ``path_len`` the accepted-path depth the walk
+        reached. Wide nodes with short paths = wasted verify width (lower
+        the branching or the floor); long paths at small node budgets =
+        headroom (widen)."""
         pass
 
     def decode_prefix(self, deployment: str, hit: bool, tokens_saved: int) -> None:
@@ -285,10 +303,29 @@ class Metrics(NullMetrics):
         )
         self._spec_emitted = Histogram(
             "seldon_tpu_decode_spec_tokens_per_dispatch",
-            "Tokens emitted per speculative verify dispatch (accepted + bonus)",
+            "Tokens emitted per speculative verify dispatch (accepted + "
+            "bonus), by round shape (mode=chain|tree)",
+            ["deployment_name", "mode"],
+            registry=registry,
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        # tree speculation: per-slot allowed node budget vs the accepted
+        # PATH depth the walk actually reached — together they read as
+        # verify-width efficiency (wide trees with short paths waste the
+        # widened dispatch; the adaptive floor trims exactly that)
+        self._spec_tree_nodes = Histogram(
+            "seldon_tpu_decode_spec_tree_nodes",
+            "Allowed candidate tree nodes per slot per tree-verify dispatch",
             ["deployment_name"],
             registry=registry,
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        self._spec_tree_path = Histogram(
+            "seldon_tpu_decode_spec_tree_accepted_path_len",
+            "Accepted path depth per slot per tree-verify dispatch",
+            ["deployment_name"],
+            registry=registry,
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0),
         )
         # prefix-cache KV reuse (decode scheduler): lookup outcomes, the
         # prefill compute the pool actually displaced, eviction churn
@@ -456,10 +493,14 @@ class Metrics(NullMetrics):
     def decode_inter_token(self, deployment, duration_s):
         self._decode_itl.labels(deployment).observe(duration_s)
 
-    def decode_spec(self, deployment, proposed, accepted, emitted):
+    def decode_spec(self, deployment, proposed, accepted, emitted, mode="chain"):
         self._spec_proposed.labels(deployment).inc(proposed)
         self._spec_accepted.labels(deployment).inc(accepted)
-        self._spec_emitted.labels(deployment).observe(emitted)
+        self._spec_emitted.labels(deployment, mode).observe(emitted)
+
+    def decode_spec_tree(self, deployment, nodes, path_len):
+        self._spec_tree_nodes.labels(deployment).observe(nodes)
+        self._spec_tree_path.labels(deployment).observe(path_len)
 
     def decode_prefix(self, deployment, hit, tokens_saved):
         self._prefix_lookups.labels(deployment, "hit" if hit else "miss").inc()

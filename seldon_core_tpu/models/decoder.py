@@ -855,6 +855,415 @@ def speculative_accept(
     return out, n_acc.astype(jnp.int32)
 
 
+# ------------------------------------------------------- tree speculation
+# Multi-candidate (tree) speculation (SpecInfer; Medusa; EAGLE): instead of
+# one k-token chain, the draft proposes a token TREE — ``branching[d]``
+# candidates per depth under every surviving branch (models/spec_tree.py
+# owns the static layout) — and the target scores the whole flattened tree
+# in ONE widened dispatch. Acceptance walks the longest valid PATH, so
+# accepted-tokens-per-dispatch rises at the same 2-dispatch round cost:
+# where a chain dies at the first mismatch, a tree usually has a sibling
+# candidate covering the target's actual choice.
+#
+# Cache discipline differs from the chain on purpose: sibling nodes at one
+# depth would collide on the same (page, offset), so the tree forward
+# NEVER writes speculative K/V — in-dispatch queries read their ancestors
+# through the ancestor mask (the in-block causal mask generalized), and
+# only the ACCEPTED path is committed afterwards, every other column
+# junk-redirected. The pool never holds speculative garbage.
+
+
+def sequence_logits(params: dict, ids: jax.Array) -> jax.Array:
+    """Teacher-forced logits at every position: ids[b, s] -> [b, s, vocab]
+    (position j's row is the next-token distribution after consuming
+    tokens 0..j). One causal pass — the signal both sides of the draft
+    KL-distillation recipe (training/distill_draft.py) train on."""
+    ids = ids.astype(jnp.int32)
+    heads = _heads(params)
+    x = _embed(params, ids)
+    for lp in params["layers"]:
+        x, _, _ = _layer_prefill(lp, x, heads)
+    return _logits(params, x)
+
+
+def _layer_tree_flat(p, x, cache_k, cache_v, positions, h, ek, ev, sub_mask):
+    """One layer of a draft tree-expansion step over the FLAT draft cache:
+    x [n, c, d] carries one depth's nodes; attention reads the cache at
+    entries <= positions[i] (prompt + committed tokens + the root's fresh
+    write) PLUS the in-register K/V of every node proposed so far this
+    round (``ek``/``ev`` [n, h, E, hd], grown per depth — speculative
+    draft K/V is never written to the cache; the verify dispatch commits
+    the accepted path). ``sub_mask`` [c, E + c] is the ancestor-or-self
+    mask over those in-flight nodes. Returns (x_out, ek', ev') with this
+    depth's K/V appended."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, h)  # [n, h, c, hd]
+    k = _split_heads(k, h)
+    v = _split_heads(v, h)
+    ek = k if ek is None else jnp.concatenate([ek, k], axis=2)
+    ev = v if ev is None else jnp.concatenate([ev, v], axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qf = q.astype(jnp.float32)
+    s_cache = jnp.einsum("nhqd,nhkd->nhqk", qf, cache_k.astype(jnp.float32)) * scale
+    valid = jnp.arange(cache_k.shape[2])[None, None, None, :] <= positions[:, None, None, None]
+    s_cache = jnp.where(valid, s_cache, -1e30)
+    s_ext = jnp.einsum("nhqd,nhkd->nhqk", qf, ek.astype(jnp.float32)) * scale
+    s_ext = jnp.where(sub_mask[None, None, :, :], s_ext, -1e30)
+    p_attn = jax.nn.softmax(jnp.concatenate([s_cache, s_ext], axis=-1), axis=-1)
+    c_len = cache_k.shape[2]
+    ctx = jnp.einsum(
+        "nhqk,nhkd->nhqd", p_attn[..., :c_len], cache_v.astype(jnp.float32)
+    ) + jnp.einsum("nhqk,nhkd->nhqd", p_attn[..., c_len:], ev.astype(jnp.float32))
+    ctx = _merge_heads(ctx.astype(x.dtype))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, ek, ev
+
+
+def draft_propose_tree(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+    tree,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Grow the whole proposal tree in ONE program: a root decode step
+    (consume the last emitted token at ``pos``, write its K/V — always
+    consumed, so the write is never speculative), then ``tree.depth``
+    unrolled widened expansions, each proposing ``branching[d]`` children
+    per surviving node. Greedy rows take the top-b distinct tokens of the
+    parent's raw logits (branch 0 IS the chain's argmax proposal); sampled
+    rows draw b i.i.d. tokens from the transformed distribution
+    ``sample_tokens`` serves — i.i.d. candidates are what make the
+    per-depth recursive rejection resampling in ``speculative_accept_tree``
+    exact.
+
+    Returns (node_tokens [n, n_tree], block_logits [n, width, V],
+    node_k [L, n, h, n_tree, hd], node_v, cache_k, cache_v): block j's
+    logits are the draft's next-token distribution AFTER consuming block
+    j's token along its path (block 0 = the root) — the q each node's
+    children are corrected against. Speculative node K/V comes back
+    in-register for the verify dispatch to commit (``draft_tree_commit``);
+    the cache itself only gains the root's entry."""
+    heads = _heads(params)
+    max_len = params["pos_emb"].shape[0]
+    n = tokens.shape[0]
+    logits0, cache_k, cache_v = decode_step(params, cache_k, cache_v, tokens, positions)
+    block_logits = [logits0[:, None, :]]
+    node_tokens = []
+    ek: list = [None] * len(params["layers"])
+    ev: list = [None] * len(params["layers"])
+    parent_logits = logits0[:, None, :]  # [n, 1, V]
+    mask_np = tree.ancestor_mask
+    for d in range(1, tree.depth + 1):
+        b = tree.branching[d - 1]
+        c_prev = parent_logits.shape[1]
+        c_d = tree.level_counts[d - 1]
+        _, top_idx = lax.top_k(parent_logits, b)  # [n, c_prev, b]
+        flat_parent = parent_logits.reshape(n * c_prev, -1)
+        scaled = _transform_logits(
+            flat_parent, jnp.repeat(temperature, c_prev), jnp.repeat(top_k, c_prev)
+        )
+        samp = [
+            jax.random.categorical(
+                jax.random.fold_in(jax.random.fold_in(key, d), bi), scaled, axis=-1
+            ).astype(jnp.int32)
+            for bi in range(b)
+        ]
+        sampled = jnp.stack(samp, axis=-1).reshape(n, c_prev, b)
+        cand = jnp.where(
+            (temperature > 0)[:, None, None], sampled, top_idx.astype(jnp.int32)
+        )
+        toks_d = cand.reshape(n, c_d)  # parent-major: matches the block layout
+        node_tokens.append(toks_d)
+        x = jnp.asarray(params["tok_emb"])[toks_d]
+        pidx = jnp.clip(positions + d, 0, max_len - 1)
+        x = x + jnp.asarray(params["pos_emb"])[pidx][:, None, :]
+        start = tree.level_starts[d - 1]
+        sub_mask = jnp.asarray(mask_np[start : start + c_d, 1 : start + c_d])
+        for li, lp in enumerate(params["layers"]):
+            x, ek[li], ev[li] = _layer_tree_flat(
+                lp, x, cache_k[li], cache_v[li], positions, heads,
+                ek[li], ev[li], sub_mask,
+            )
+        depth_logits = _logits(params, x)  # [n, c_d, V]
+        block_logits.append(depth_logits)
+        parent_logits = depth_logits
+    return (
+        jnp.concatenate(node_tokens, axis=1),
+        jnp.concatenate(block_logits, axis=1),
+        jnp.stack(ek),
+        jnp.stack(ev),
+        cache_k,
+        cache_v,
+    )
+
+
+def _layer_tree_paged(p, x, kv, bt, positions, h, mask):
+    """One layer of the widened TARGET tree verify over the page pool:
+    all ``width`` blocks at once, attention over the gathered cache
+    (entries strictly before ``pos`` — nothing speculative lives there)
+    plus the dispatch's own fresh K/V under the ancestor mask. The pool
+    is NOT written (``paged_tree_commit`` writes the accepted path after
+    acceptance). int8 pools round-trip the fresh K/V through the same
+    per-page-row quantizer the commit will apply, so every value a query
+    reads is bit-identical to what the sequential plain path would have
+    read back from the pool. Returns (x_out, k, v) with the RAW fresh
+    K/V for the commit."""
+    normed = _ln(p["ln1"], x)
+    qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, h)  # [n, h, m, hd]
+    k = _split_heads(k, h)
+    v = _split_heads(v, h)
+    n, hh, m, hd = k.shape
+    if len(kv) == 6:
+        # int8 pool: quantize-dequantize the in-block K/V per token row —
+        # the exact transform _paged_write/_paged_gather would apply
+        def _rt(t):
+            rows = t.transpose(0, 2, 1, 3).reshape(n * m, hh, hd).astype(jnp.float32)
+            qr, sc, zp = _quant_rows(rows)
+            deq = qr.astype(jnp.float32) * sc[:, None, None] + zp[:, None, None]
+            return deq.reshape(n, m, hh, hd).transpose(0, 2, 1, 3)
+
+        k_att, v_att = _rt(k), _rt(v)
+    else:
+        # fp pool: round-trip through the pool dtype (no-op at float32)
+        k_att = k.astype(kv[0].dtype).astype(jnp.float32)
+        v_att = v.astype(kv[0].dtype).astype(jnp.float32)
+    cache_k, cache_v = _paged_gather(kv, bt)  # f32 virtual caches
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qf = q.astype(jnp.float32)
+    s_cache = jnp.einsum("nhqd,nhkd->nhqk", qf, cache_k) * scale
+    valid = jnp.arange(cache_k.shape[2])[None, None, None, :] < positions[:, None, None, None]
+    s_cache = jnp.where(valid, s_cache, -1e30)
+    s_blk = jnp.einsum("nhqd,nhkd->nhqk", qf, k_att) * scale
+    s_blk = jnp.where(jnp.asarray(mask)[None, None, :, :], s_blk, -1e30)
+    p_attn = jax.nn.softmax(jnp.concatenate([s_cache, s_blk], axis=-1), axis=-1)
+    c_len = cache_k.shape[2]
+    ctx = jnp.einsum("nhqk,nhkd->nhqd", p_attn[..., :c_len], cache_v) + jnp.einsum(
+        "nhqk,nhkd->nhqd", p_attn[..., c_len:], v_att
+    )
+    ctx = _merge_heads(ctx.astype(x.dtype))
+    x = x + ctx @ p["attn_out"]["w"].astype(x.dtype) + p["attn_out"]["b"].astype(x.dtype)
+    normed2 = _ln(p["ln2"], x)
+    hdn = jax.nn.gelu(
+        normed2 @ p["mlp_in"]["w"].astype(x.dtype) + p["mlp_in"]["b"].astype(x.dtype),
+        approximate=False,
+    )
+    x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
+    return x, k, v
+
+
+def paged_tree_verify(
+    params: dict, pool: tuple, bt: jax.Array, tokens: jax.Array,
+    positions: jax.Array, tree,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Score the flattened tree in ONE widened dispatch: tokens [n, width]
+    (block 0 = the last emitted token, blocks 1.. = tree nodes), block j
+    at position ``pos + depth(j)``. logits[i, j] is the target's
+    next-token distribution AFTER consuming block j's token along its
+    path — exactly what sequential decoding down that path would produce,
+    which is what keeps greedy path acceptance bit-exact. Returns
+    (logits [n, width, V], new_k [L, n, h, width, hd], new_v); the pool
+    is untouched — ``paged_tree_commit`` writes the accepted path."""
+    heads = _heads(params)
+    max_len = params["pos_emb"].shape[0]
+    x = jnp.asarray(params["tok_emb"])[tokens]  # [n, width, d]
+    pidx = jnp.clip(
+        positions[:, None] + jnp.asarray(tree.block_depth)[None, :], 0, max_len - 1
+    )
+    x = x + jnp.asarray(params["pos_emb"])[pidx]
+    mask = tree.ancestor_mask
+    nk, nv = [], []
+    for li, lp in enumerate(params["layers"]):
+        layer_kv = tuple(a[li] for a in pool)
+        x, k, v = _layer_tree_paged(lp, x, layer_kv, bt, positions, heads, mask)
+        nk.append(k)
+        nv.append(v)
+    logits = _logits(params, x)  # [n, width, V]
+    return logits, jnp.stack(nk), jnp.stack(nv)
+
+
+def paged_tree_commit(
+    pool: tuple, bt: jax.Array, new_k: jax.Array, new_v: jax.Array,
+    path_idx: jax.Array, positions: jax.Array, n_acc: jax.Array,
+) -> tuple:
+    """Write the ACCEPTED path's K/V — the root block plus the chosen
+    node at depths 1..n_acc — through the block tables at
+    ``pos..pos+n_acc``; every column beyond ``n_acc + 1`` is
+    junk-redirected by the counts mask, so the pool holds exactly what
+    sequential decoding would have written and no speculative garbage."""
+    L = new_k.shape[0]
+    idx = jnp.broadcast_to(
+        path_idx[None, :, None, :, None],
+        new_k.shape[:3] + (path_idx.shape[1], new_k.shape[4]),
+    )
+    k_sel = jnp.take_along_axis(new_k, idx, axis=3)  # [L, n, h, D+1, hd]
+    v_sel = jnp.take_along_axis(new_v, idx, axis=3)
+    counts = n_acc + 1
+    per_comp: list[list] = [[] for _ in pool]
+    for li in range(L):
+        layer_kv = _paged_write(
+            tuple(a[li] for a in pool), k_sel[li], v_sel[li], bt, positions, counts
+        )
+        for acc, a in zip(per_comp, layer_kv):
+            acc.append(a)
+    return tuple(jnp.stack(acc) for acc in per_comp)
+
+
+def draft_tree_commit(
+    cache_k: jax.Array, cache_v: jax.Array, node_k: jax.Array, node_v: jax.Array,
+    path_idx: jax.Array, positions: jax.Array, n_acc: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """The draft-side twin of ``paged_tree_commit``: write the accepted
+    path's draft K/V into the FLAT draft cache at ``pos+1..pos+n_acc``
+    (the root's entry at ``pos`` was written by the draft dispatch
+    itself). node_k/node_v [L, n, h, n_tree, hd] are in block order, so
+    ``path_idx[:, 1:] - 1`` selects the chosen node per depth; columns
+    beyond ``n_acc`` keep the cache's current bytes (a masked select, so
+    a zero-accept slot mutates nothing)."""
+    D = path_idx.shape[1] - 1
+    nidx = jnp.maximum(path_idx[:, 1:] - 1, 0)  # [n, D] node indices
+    idx = jnp.broadcast_to(
+        nidx[None, :, None, :, None], node_k.shape[:3] + (D, node_k.shape[4])
+    )
+    k_sel = jnp.take_along_axis(node_k, idx, axis=3)  # [L, n, h, D, hd]
+    v_sel = jnp.take_along_axis(node_v, idx, axis=3)
+
+    def upd(c, r, pos, cnt):  # c [h, ctx, hd]; r [h, D, hd]
+        cur = lax.dynamic_slice(c, (0, pos, 0), r.shape)
+        blk = jnp.where((jnp.arange(D) < cnt)[None, :, None], r, cur)
+        return lax.dynamic_update_slice(c, blk, (0, pos, 0))
+
+    write = jax.vmap(jax.vmap(upd), in_axes=(0, 0, None, None))
+    cache_k = write(cache_k, k_sel.astype(cache_k.dtype), positions + 1, n_acc)
+    cache_v = write(cache_v, v_sel.astype(cache_v.dtype), positions + 1, n_acc)
+    return cache_k, cache_v
+
+
+def speculative_accept_tree(
+    target_logits: jax.Array,
+    block_tokens: jax.Array,
+    draft_logits: jax.Array,
+    width_limits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+    tree,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Longest-accepted-PATH walk over the scored tree. Per depth, the
+    current node's children (in branch order, gated by ``width_limits
+    [n, depth]`` — the per-slot tighten/adapt mask; width 0 at a depth
+    ends that slot's walk as a limit clamp, not a rejection) are tried:
+
+    - greedy rows (temperature <= 0) accept the child matching the
+      target's own argmax at the current node — bit-identical to
+      sequential greedy decoding by induction, for ANY draft, since a
+      match at depth d makes depth d+1's scored context exact too;
+    - sampled rows run recursive rejection resampling (SpecInfer): each
+      candidate c_i (i.i.d. from the draft's q) accepts with probability
+      min(1, r(c_i)/q(c_i)) against the running residual r (r starts at
+      the target's p; every rejection folds q out: r <- norm(max(r - q,
+      0))), so the emitted marginal at every position is exactly the
+      target's.
+
+    The bonus token at the final node samples the target's p directly —
+    or, after a TRUE rejection (candidates existed and all lost), the
+    final residual, which is what preserves the distribution. Returns
+    (out_tokens [n, depth+1] — slot i emits out[:n_acc[i]+1], n_acc [n],
+    path_idx [n, depth+1] block indices, path_idx[:, 0] = 0)."""
+    n, width, vocab = target_logits.shape
+    D = tree.depth
+    rows = jnp.arange(n)
+    greedy_t = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # [n, width]
+    p_all = jax.nn.softmax(
+        _transform_logits(target_logits, temperature[:, None], top_k[:, None]), axis=-1
+    )
+    q_all = jax.nn.softmax(
+        _transform_logits(draft_logits, temperature[:, None], top_k[:, None]), axis=-1
+    )
+    child_tab = jnp.asarray(tree.child_table)  # [width, max_b]
+    sampled_row = temperature > 0
+    cur = jnp.zeros(n, jnp.int32)
+    alive = jnp.ones(n, bool)
+    n_acc = jnp.zeros(n, jnp.int32)
+    rejected = jnp.zeros(n, bool)
+    rej_dist = jnp.zeros((n, vocab), jnp.float32)
+    path_blocks = []
+    for d in range(1, D + 1):
+        b = tree.branching[d - 1]
+        kd = jax.random.fold_in(key, d)
+        ch = child_tab[cur][:, :b]  # [n, b] candidate block indices
+        ch_tok = jnp.take_along_axis(block_tokens, ch, axis=1)  # [n, b]
+        p_cur = p_all[rows, cur]  # [n, V]
+        q_cur = q_all[rows, cur]
+        gt = greedy_t[rows, cur]  # [n]
+        wl = width_limits[:, d - 1]
+        step_ok = alive & (wl > 0)
+        in_w = jnp.arange(b)[None, :] < wl[:, None]
+        # greedy arm: at most one candidate can match (top-b is distinct)
+        g_match = (ch_tok == gt[:, None]) & in_w
+        g_any = jnp.any(g_match, axis=1)
+        g_sel = jnp.argmax(g_match, axis=1).astype(jnp.int32)
+        # sampled arm: recursive rejection over the i.i.d. candidates
+        r = p_cur
+        s_acc = jnp.zeros(n, bool)
+        s_sel = jnp.zeros(n, jnp.int32)
+        for bi in range(b):
+            c_tok = ch_tok[:, bi]
+            r_c = jnp.take_along_axis(r, c_tok[:, None], axis=1)[:, 0]
+            q_c = jnp.take_along_axis(q_cur, c_tok[:, None], axis=1)[:, 0]
+            u = jax.random.uniform(jax.random.fold_in(kd, bi), (n,))
+            considered = in_w[:, bi] & ~s_acc
+            ok_bi = considered & (u * q_c < r_c)  # u < r/q without dividing
+            s_sel = jnp.where(ok_bi, bi, s_sel)
+            s_acc = s_acc | ok_bi
+            # a rejected candidate folds its proposal out of the residual
+            upd = considered & ~ok_bi
+            r_new = jnp.maximum(r - q_cur, 0.0)
+            rs = jnp.sum(r_new, axis=-1, keepdims=True)
+            r_new = jnp.where(rs > 1e-9, r_new / jnp.maximum(rs, 1e-9), p_cur)
+            r = jnp.where(upd[:, None], r_new, r)
+        acc_d = jnp.where(sampled_row, s_acc, g_any) & step_ok
+        sel = jnp.where(sampled_row, s_sel, g_sel)
+        new_cur = ch[rows, sel]
+        # a TRUE rejection (candidates existed, all lost) pins the final
+        # residual as this slot's bonus distribution; a limit clamp does
+        # not (nothing was proposed there — bonus samples p directly)
+        rej_now = step_ok & ~acc_d
+        rej_dist = jnp.where((rej_now & ~rejected)[:, None], r, rej_dist)
+        rejected = rejected | rej_now
+        cur = jnp.where(acc_d, new_cur, cur)
+        n_acc = n_acc + acc_d.astype(jnp.int32)
+        alive = alive & acc_d
+        path_blocks.append(cur)
+    p_fin = p_all[rows, cur]
+    dist = jnp.where(rejected[:, None], rej_dist, p_fin)
+    bonus_sampled = jax.random.categorical(
+        jax.random.fold_in(key, 0), jnp.log(dist + 1e-38), axis=-1
+    ).astype(jnp.int32)
+    bonus = jnp.where(sampled_row, bonus_sampled, greedy_t[rows, cur])
+    path_idx = jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.int32), jnp.stack(path_blocks, axis=1)], axis=1
+    )
+    out = jnp.take_along_axis(block_tokens, path_idx[:, 1:], axis=1)  # [n, D]
+    out = jnp.concatenate([out, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    out = out.at[rows, n_acc].set(bonus)
+    return out, n_acc.astype(jnp.int32), path_idx
+
+
 def reference_generate(params: dict, ids: np.ndarray, max_new_tokens: int) -> np.ndarray:
     """Cache-less reference: full forward per step (the slow obvious
     implementation the scan version must match token-for-token)."""

@@ -433,6 +433,7 @@ def build_draft(
     resid_scale: float = 1.0,
     seq: int = 32,
     max_new_tokens: int = 16,
+    distilled: str = "",
     **_,
 ) -> ModelSpec:
     """Draft decoder for speculative decoding (tpu.decode_draft_model):
@@ -449,12 +450,25 @@ def build_draft(
     docs/generative.md. Serves standalone like any other zoo entry —
     it IS tiny_gpt with a 1-layer default, so it delegates (any change to
     the target's ModelSpec wiring automatically carries to the draft,
-    which the truncation property depends on)."""
-    return build_tiny_gpt(
+    which the truncation property depends on).
+
+    ``distilled=/path/to.npz`` refills the build's weights from a
+    KL-distillation checkpoint (training/distill_draft.py) trained
+    against the target — acceptance from LEARNING the target's
+    conditionals instead of seed-shared layer truncation alone. The
+    checkpoint must match this build's geometry exactly (the loader
+    asserts every leaf's shape), so the URI still carries the full
+    architecture and ``distilled`` only swaps the values."""
+    ms = build_tiny_gpt(
         seed=seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
         max_len=max_len, seq=seq, max_new_tokens=max_new_tokens,
         resid_scale=resid_scale,
     )
+    if distilled:
+        from seldon_core_tpu.training.distill_draft import load_draft_checkpoint
+
+        ms.params = load_draft_checkpoint(str(distilled), ms.params)
+    return ms
 
 
 def _apply_tiny_gpt(p, x, *, max_new_tokens: int):

@@ -180,3 +180,17 @@ def kv_sharding(mesh: Mesh, axis: str, arr) -> NamedSharding:
     if getattr(arr, "ndim", 0) == 5:
         return NamedSharding(mesh, P(None, None, axis, None, None))
     return NamedSharding(mesh, P())
+
+
+def tree_node_sharding(mesh: Mesh, axis: str) -> NamedSharding:
+    """Sharding for the tree-speculation round's IN-REGISTER node K/V
+    ``[L, n_slots, h, n_tree, hd]`` (models/decoder.draft_propose_tree /
+    paged_tree_verify outputs, alive only between the round's two
+    dispatches): heads shard at axis 2 exactly like the persistent KV
+    buffers; the TREE axis is replicated. Widening the verify to a token
+    tree therefore adds NO collective — per-head scores/softmax over the
+    tree's queries stay device-local and each residual branch still ends
+    in the one fused all-reduce, so the tree composes with any mesh width
+    the head/FFN divisibility rules admit (no tree-width divisibility
+    constraint exists, by construction)."""
+    return NamedSharding(mesh, P(None, None, axis, None, None))

@@ -92,19 +92,26 @@ from seldon_core_tpu import telemetry
 from seldon_core_tpu.models.decoder import (
     decoder_dims,
     draft_propose,
+    draft_propose_tree,
+    draft_tree_commit,
     init_slot_cache,
     paged_chunk_prefill,
     paged_decode_step,
+    paged_tree_commit,
+    paged_tree_verify,
     paged_verify_step,
     prefill,
     sample_tokens,
     speculative_accept,
+    speculative_accept_tree,
 )
+from seldon_core_tpu.models.spec_tree import MAX_TREE_NODES, SpecTree, parse_spec_tree
 from seldon_core_tpu.parallel.tp import (
     decode_mesh_problems,
     decode_tp_mesh,
     decoder_param_shardings,
     kv_sharding,
+    tree_node_sharding,
 )
 from seldon_core_tpu.serving.kv_pool import PagedKVPool
 
@@ -203,6 +210,92 @@ def _fused_verify(
         logits, drafts, draft_logits, limits, temps, topks, key
     )
     return out, acc, pool
+
+
+def _fused_draft_tree(
+    params, cache_k, cache_v, tokens, positions, temps, topks, seed, tick, tree
+):
+    """One device program per TREE speculation round, draft side: a root
+    decode step + ``tree.depth`` unrolled widened expansions proposing the
+    whole candidate tree (models/decoder.draft_propose_tree). The
+    speculative node K/V comes back in-register — the draft cache gains
+    only the root's entry; the verify dispatch commits the accepted path."""
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 1)
+    return draft_propose_tree(
+        params, cache_k, cache_v, tokens, positions, temps, topks, key, tree
+    )
+
+
+def _fused_tree_verify(
+    params, pool, bt, tokens, node_tokens, block_logits, node_k, node_v,
+    dck, dcv, positions, width_limits, temps, topks, seed, tick, tree,
+):
+    """One device program per TREE speculation round, target side: the
+    whole flattened tree scored in ONE widened dispatch
+    (paged_tree_verify — the pool is NOT written by the forward), the
+    longest-accepted-path walk, then BOTH commits: the accepted path's
+    target K/V through the block tables (non-accepted columns
+    junk-redirected — the pool never holds speculative garbage) and its
+    draft K/V into the flat draft cache. Readback is (out_tokens
+    [n, depth+1], n_accepted [n]); everything else stays on device."""
+    queries = jnp.concatenate([tokens[:, None], node_tokens], axis=1)  # [n, width]
+    logits, new_k, new_v = paged_tree_verify(params, pool, bt, queries, positions, tree)
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
+    out, acc, path_idx = speculative_accept_tree(
+        logits, queries, block_logits, width_limits, temps, topks, key, tree
+    )
+    pool = paged_tree_commit(pool, bt, new_k, new_v, path_idx, positions, acc)
+    dck, dcv = draft_tree_commit(dck, dcv, node_k, node_v, path_idx, positions, acc)
+    return out, acc, pool, dck, dcv
+
+
+class _SpecAdapt:
+    """Rolling per-deployment accept-rate estimate driving the EFFECTIVE
+    speculation depth between a configured floor and the deployment's
+    ceiling (the chain's spec_k, or the tree's configured depth — the
+    per-depth branchings themselves are the width ceiling and are never
+    exceeded). Adaptation changes only DATA (per-slot accept limits /
+    per-depth width masks), never program shapes, so it costs zero
+    recompiles by construction.
+
+    Policy: an EWMA of per-round ``accepted / allowed`` path fractions.
+    Below ``floor`` the scheduler degrades to PLAIN decode (a cold or
+    adversarial workload stops paying draft + widened-verify cost for
+    tokens it won't accept), with a cheap depth-1 probe round every
+    ``probe_every`` rounds so the estimate can recover when the workload
+    turns draftable again. At/above the floor the depth scales linearly
+    up to the ceiling. ``floor <= 0`` disables adaptation (fixed shape)."""
+
+    def __init__(
+        self, floor: float, ceiling: int, alpha: float = 0.2, probe_every: int = 16
+    ):
+        self.floor = float(floor)
+        self.ceiling = int(ceiling)
+        self.alpha = float(alpha)
+        self.probe_every = int(probe_every)
+        # optimistic start: the first rounds run the full configured shape
+        # so a warm workload never pays a ramp-up
+        self.rate = 1.0
+        self.plain_rounds = 0
+        self.probes = 0
+
+    def update(self, accepted: int, allowed: int) -> None:
+        if allowed > 0:
+            self.rate += self.alpha * (accepted / allowed - self.rate)
+
+    def depth(self) -> int:
+        """Effective speculation depth for the NEXT round (0 = plain)."""
+        if self.floor <= 0.0:
+            return self.ceiling
+        if self.rate < self.floor:
+            self.plain_rounds += 1
+            if self.probe_every and self.plain_rounds % self.probe_every == 0:
+                self.probes += 1
+                return 1
+            return 0
+        self.plain_rounds = 0
+        frac = (self.rate - self.floor) / max(1.0 - self.floor, 1e-6)
+        return max(1, min(self.ceiling, int(np.ceil(frac * self.ceiling))))
 
 
 class _PrefixEntry:
@@ -328,7 +421,8 @@ class _Seq:
     """One in-flight generation request."""
 
     __slots__ = (
-        "prompt", "max_new", "temperature", "top_k", "spec_k", "on_token", "future",
+        "prompt", "max_new", "temperature", "top_k", "spec_k", "tree_widths",
+        "on_token", "future",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
         "deadline", "trace_ctxs", "gen_spans",
         "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
@@ -341,6 +435,9 @@ class _Seq:
         self.temperature = temperature
         self.top_k = top_k
         self.spec_k = spec_k
+        # tree mode: per-depth branching widths this request rides (the
+        # deployment tree tightened by meta.tags.spec_tree); () elsewhere
+        self.tree_widths: tuple[int, ...] = ()
         self.on_token = on_token
         self.future = future
         self.tokens: list[int] = []
@@ -388,6 +485,8 @@ class DecodeScheduler:
         queue_timeout_s: float = 0.0,
         draft_params=None,
         spec_k: int = 0,
+        spec_tree: str = "",
+        spec_accept_floor: float = 0.0,
         prefix_slots: int = 0,
         prefix_ctx: int = 0,
         prefill_chunk: int = 0,
@@ -437,10 +536,54 @@ class DecodeScheduler:
         # slot cache beside the target's, and k columns of cache headroom —
         # the widened verify writes a fixed [k+1]-wide K/V block at each
         # slot's position, and a slot one token from its budget must not
-        # have that block clamp backwards over accepted entries
-        self.spec_enabled = draft_params is not None and spec_k >= 1
-        self.spec_k = int(spec_k) if self.spec_enabled else 0
+        # have that block clamp backwards over accepted entries.
+        # decode_spec_tree upgrades the round from a k-chain to a token
+        # TREE (models/spec_tree.py): the draft proposes branching[d]
+        # candidates per depth, ONE widened target dispatch scores the
+        # whole flattened tree, and acceptance walks the longest valid
+        # path — spec_k then reads as the tree's DEPTH (the per-request
+        # spec_k tighten caps depth; meta.tags.spec_tree tightens widths).
+        tree_text = str(spec_tree or "").strip()
+        self.spec_tree: SpecTree | None = None
+        if tree_text:
+            if draft_params is None:
+                raise ValueError(
+                    "decode_spec_tree needs a draft model (decode_draft_model)"
+                )
+            self.spec_tree = SpecTree.from_text(tree_text)
+            # the knob string as span-attribute text ("4,2,1") — traces
+            # name the shape without re-deriving it from branching
+            self._tree_text = ",".join(str(b) for b in self.spec_tree.branching)
+            if self.spec_tree.n_tree > MAX_TREE_NODES:
+                raise ValueError(
+                    f"decode_spec_tree {tree_text!r} flattens to "
+                    f"{self.spec_tree.n_tree} nodes — the widened verify "
+                    f"dispatch caps at {MAX_TREE_NODES}"
+                )
+        self.spec_enabled = draft_params is not None and (
+            spec_k >= 1 or self.spec_tree is not None
+        )
+        self.spec_k = (
+            self.spec_tree.depth
+            if self.spec_tree is not None
+            else (int(spec_k) if self.spec_enabled else 0)
+        )
+        if self.spec_tree is None and self.spec_k > MAX_TREE_NODES:
+            # same verify-width headroom cap as the tree (a k-chain IS a
+            # branching-1 tree of k nodes) — enforced here so an oversized
+            # decode_spec_k fails at build, not at trace time
+            raise ValueError(
+                f"decode_spec_k={self.spec_k} exceeds the widened-verify "
+                f"headroom ({MAX_TREE_NODES} proposed tokens per dispatch)"
+            )
         self.draft_params = draft_params if self.spec_enabled else None
+        # accept-rate-adaptive speculation depth: EWMA of accepted/allowed
+        # drives the EFFECTIVE depth between plain decode (rate < floor)
+        # and the configured ceiling — data-only adaptation, zero
+        # recompiles. floor <= 0 pins the configured shape.
+        self._adapt = (
+            _SpecAdapt(spec_accept_floor, self.spec_k) if self.spec_enabled else None
+        )
 
         # prefix cache: the radix index over pool-page references.
         # prefix_slots caps the INDEX (entries), not device rows — pages
@@ -505,6 +648,23 @@ class DecodeScheduler:
                 self.draft_params = draft_params = jax.device_put(
                     draft_params,
                     decoder_param_shardings(draft_params, self.mesh, self._tp_axis),
+                )
+        elif self.spec_enabled:
+            # no decode mesh: commit the draft to the TARGET params'
+            # sharding. On the defaulted serving path the runtime commits
+            # the target to the deployment mesh while the builder
+            # device_put the draft bare (single device) — the verify
+            # program takes both and jit refuses mixed device sets
+            # (latent since PR 4; only a defaulted boot presents it).
+            leaves = [
+                leaf
+                for leaf in jax.tree_util.tree_leaves(params)
+                if isinstance(leaf, jax.Array)
+            ]
+            if leaves:
+                sharding = leaves[0].sharding
+                self.draft_params = draft_params = jax.tree.map(
+                    lambda a: jax.device_put(a, sharding), draft_params
                 )
         # span attributes distinguishing sharded deployments in /traces
         self._mesh_attrs = (
@@ -575,15 +735,47 @@ class DecodeScheduler:
             )
             draft_kw = {"out_shardings": (rep, rep) + dc_sh} if dc_sh else {}
             draft_admit_kw = {"out_shardings": dc_sh} if dc_sh else {}
+            # tree round pair: the in-register node K/V rides head-sharded
+            # like every 5-D KV buffer; the TREE axis is replicated (heads
+            # stay sharded — parallel/tp.py), so the widened dispatch
+            # needs no new collective beyond the fused all-reduces
+            kvp = tree_node_sharding(self.mesh, self._tp_axis)
+            draft_tree_kw = (
+                {"out_shardings": (rep, rep, kvp, kvp) + dc_sh} if dc_sh else {}
+            )
+            tree_verify_kw = (
+                {"out_shardings": (rep, rep, pool_sh) + dc_sh} if dc_sh else {}
+            )
         else:
             step_kw = verify_kw = draft_kw = draft_admit_kw = {}
+            draft_tree_kw = tree_verify_kw = {}
         self._step_fn = jax.jit(_fused_step, donate_argnums=(1,), **step_kw)
         self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,), **step_kw)
         if self.spec_enabled:
-            self._draft_fn = jax.jit(
-                _fused_draft, donate_argnums=(1, 2), static_argnums=(9,), **draft_kw
-            )
-            self._verify_fn = jax.jit(_fused_verify, donate_argnums=(1,), **verify_kw)
+            if self.spec_tree is not None:
+                # tree mode subsumes the chain (a branching-1 tree IS the
+                # chain), so the chain draft/verify pair is not compiled —
+                # per-request chain/plain tightening rides the SAME tree
+                # programs through data-only width masks
+                self._draft_tree_fn = jax.jit(
+                    _fused_draft_tree,
+                    donate_argnums=(1, 2),
+                    static_argnums=(9,),
+                    **draft_tree_kw,
+                )
+                self._tree_verify_fn = jax.jit(
+                    _fused_tree_verify,
+                    donate_argnums=(1, 8, 9),
+                    static_argnums=(16,),
+                    **tree_verify_kw,
+                )
+            else:
+                self._draft_fn = jax.jit(
+                    _fused_draft, donate_argnums=(1, 2), static_argnums=(9,), **draft_kw
+                )
+                self._verify_fn = jax.jit(
+                    _fused_verify, donate_argnums=(1,), **verify_kw
+                )
             self._draft_admit_fn = jax.jit(
                 _fused_draft_admit, donate_argnums=(1, 2), **draft_admit_kw
             )
@@ -624,6 +816,14 @@ class DecodeScheduler:
         self.stat_spec_proposed = 0
         self.stat_spec_accepted = 0
         self.stat_spec_emitted = 0
+        # slot-rides: occupied generating slots that rode a spec round
+        # with a nonzero limit, and the tokens THOSE slots emitted —
+        # ride_emitted/rides is the PER-SLOT accepted-tokens-per-dispatch
+        # (the amortization a single sequence sees; emitted/dispatches is
+        # the batch-wide one and also counts limit-0 slots' plain-
+        # equivalent tokens, which must not inflate the per-ride figure)
+        self.stat_spec_rides = 0
+        self.stat_spec_ride_emitted = 0
         # prefix cache / chunked prefill attribution
         self.stat_prefix_hits = 0
         self.stat_prefix_misses = 0
@@ -710,14 +910,30 @@ class DecodeScheduler:
             # the speculative round pair: junk writes land in page 0
             zi = np.zeros(self.n_slots, np.int32)
             zf = np.zeros(self.n_slots, np.float32)
-            drafts, dlogits, self._dck, self._dcv = self._draft_fn(
-                self.draft_params, self._dck, self._dcv,
-                zi, zi, zf, zi, self._seed, np.int32(0), self.spec_k,
-            )
-            out_t, acc, self.pool.state = self._verify_fn(
-                self.params, self.pool.state, bt0,
-                zi, drafts, dlogits, zi, zi, zf, zi, self._seed, np.int32(0),
-            )
+            if self.spec_tree is not None:
+                node_toks, blogits, nk, nv, self._dck, self._dcv = (
+                    self._draft_tree_fn(
+                        self.draft_params, self._dck, self._dcv,
+                        zi, zi, zf, zi, self._seed, np.int32(0), self.spec_tree,
+                    )
+                )
+                wl0 = np.zeros((self.n_slots, self.spec_tree.depth), np.int32)
+                out_t, acc, self.pool.state, self._dck, self._dcv = (
+                    self._tree_verify_fn(
+                        self.params, self.pool.state, bt0, zi, node_toks,
+                        blogits, nk, nv, self._dck, self._dcv,
+                        zi, wl0, zf, zi, self._seed, np.int32(0), self.spec_tree,
+                    )
+                )
+            else:
+                drafts, dlogits, self._dck, self._dcv = self._draft_fn(
+                    self.draft_params, self._dck, self._dcv,
+                    zi, zi, zf, zi, self._seed, np.int32(0), self.spec_k,
+                )
+                out_t, acc, self.pool.state = self._verify_fn(
+                    self.params, self.pool.state, bt0,
+                    zi, drafts, dlogits, zi, zi, zf, zi, self._seed, np.int32(0),
+                )
             jax.block_until_ready(out_t)
         jax.block_until_ready(many)
         # record the compile cost on the existing compile metric (bucket
@@ -736,8 +952,12 @@ class DecodeScheduler:
             "copy": self.pool.compile_count(),
         }
         if self.spec_enabled:
-            counts["draft"] = self._draft_fn._cache_size()
-            counts["verify"] = self._verify_fn._cache_size()
+            if self.spec_tree is not None:
+                counts["draft_tree"] = self._draft_tree_fn._cache_size()
+                counts["tree_verify"] = self._tree_verify_fn._cache_size()
+            else:
+                counts["draft"] = self._draft_fn._cache_size()
+                counts["verify"] = self._verify_fn._cache_size()
             counts["draft_admit"] = self._draft_admit_fn._cache_size()
         return counts
 
@@ -768,6 +988,7 @@ class DecodeScheduler:
         temperature: float | None = None,
         top_k: int | None = None,
         spec_k: int | None = None,
+        spec_tree: str | None = None,
         cache_prefix: int | None = None,
         prefill_chunk: int | None = None,
         on_token: OnToken | None = None,
@@ -801,6 +1022,24 @@ class DecodeScheduler:
         sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
         seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
+        if self.spec_tree is not None:
+            # per-request branching tighten (meta.tags.spec_tree): per
+            # depth min(request, deployment), omitted depths -> 0 (depth
+            # tightening) — a request can narrow or shorten the tree,
+            # never widen it; malformed strings are a client error
+            widths = self.spec_tree.branching
+            if spec_tree is not None:
+                try:
+                    # min_branch=0: a 0 width is the documented per-
+                    # request opt-out (depth truncation / full plain)
+                    widths = self.spec_tree.tighten(
+                        parse_spec_tree(spec_tree, min_branch=0)
+                    )
+                except ValueError as e:
+                    raise APIException(
+                        ErrorCode.ENGINE_INVALID_JSON, f"meta.tags.spec_tree: {e}"
+                    )
+            seq.tree_widths = widths
         seq.chunk_cap = self.prefill_chunk
         if prefill_chunk is not None:
             pc = int(prefill_chunk)
@@ -1226,25 +1465,41 @@ class DecodeScheduler:
             if self._finished(seq, int(toks[i])):
                 self._retire(i)
 
-    async def _spec_round(self, bt, toks, pos, temps, topks, limits, tick) -> None:
+    async def _spec_round(self, bt, toks, pos, temps, topks, limits, wlimits, tick) -> None:
         """One speculative round: ONE draft dispatch proposes spec_k
-        tokens per slot, ONE widened target dispatch verifies them, and
-        every slot advances by its accepted length + the bonus token
-        (limit-0 slots — per-request opt-outs, budget edges, free slots —
-        ride the same round and get exactly their plain-step token).
-        Emission, EOS/budget retirement, and per-token streaming run
-        token-by-token exactly as on the plain path, so mid-burst
-        retirement and SSE keep working."""
+        tokens per slot (or the whole candidate TREE on tree deployments),
+        ONE widened target dispatch verifies them, and every slot advances
+        by its accepted length + the bonus token (limit-0 slots —
+        per-request opt-outs, budget edges, free slots — ride the same
+        round and get exactly their plain-step token). Emission,
+        EOS/budget retirement, and per-token streaming run token-by-token
+        exactly as on the plain path, so mid-burst retirement and SSE keep
+        working. Tree rounds roll the caches forward by PATH positions:
+        ``out_t``'s row layout ([n, depth+1], accepted-path tokens + bonus)
+        is identical to the chain's, so the host-side emission walk below
+        is shared between the modes."""
+        tree = self.spec_tree
 
         def _do_spec():
-            drafts, dlogits, dck, dcv = self._draft_fn(
-                self.draft_params, self._dck, self._dcv, toks, pos, temps,
-                topks, self._seed, tick, self.spec_k,
-            )
-            out_t, acc, state = self._verify_fn(
-                self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
-                limits, temps, topks, self._seed, tick,
-            )
+            if tree is not None:
+                node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
+                    self.draft_params, self._dck, self._dcv, toks, pos, temps,
+                    topks, self._seed, tick, tree,
+                )
+                out_t, acc, state, dck, dcv = self._tree_verify_fn(
+                    self.params, self.pool.state, bt, toks, node_toks, blogits,
+                    nk, nv, dck, dcv, pos, wlimits, temps, topks,
+                    self._seed, tick, tree,
+                )
+            else:
+                drafts, dlogits, dck, dcv = self._draft_fn(
+                    self.draft_params, self._dck, self._dcv, toks, pos, temps,
+                    topks, self._seed, tick, self.spec_k,
+                )
+                out_t, acc, state = self._verify_fn(
+                    self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
+                    limits, temps, topks, self._seed, tick,
+                )
             return np.asarray(out_t), np.asarray(acc), state, dck, dcv
 
         t0 = telemetry.now_ns()
@@ -1257,9 +1512,14 @@ class DecodeScheduler:
         active = self.active
         self.stat_occupancy_sum += active / self.n_slots
         self._metrics.decode_step(self._deployment, active, self.n_slots)
+        # ``proposed`` is the round's ACCEPTANCE OPPORTUNITY — depth
+        # positions a path could advance through — for both modes, so
+        # accept rate means the same thing on chain and tree deployments
+        # (and is what the adaptive controller steers on)
         proposed = int(limits.sum())
         accepted = int(acc.sum())  # limit-0 and free slots contribute 0
         emitted = 0
+        mode = "chain" if tree is None else "tree"
         for i, seq in enumerate(list(self._slots)):
             if seq is None or seq.prefilling:
                 # prefilling slots ride the round at limit 0 with their
@@ -1267,28 +1527,52 @@ class DecodeScheduler:
                 continue
             # one decode.verify span per round on the sequence's own
             # trace(s), the accept count as an event — per-round, not
-            # per-token, so a k=4 generation adds ~len/5 spans
+            # per-token, so a k=4 generation adds ~len/5 spans. Tree
+            # rounds carry the tree shape + this slot's allowed node
+            # budget so traces explain the per-round speedup.
+            riding = int(limits[i]) > 0
+            attrs = {"slot": i, "proposed": int(limits[i]), **self._mesh_attrs}
+            if tree is not None:
+                nodes = int(wlimits[i].sum())
+                attrs["tree"] = self._tree_text
+                attrs["tree_nodes"] = nodes
+                if riding:
+                    # limit-0 slots (opt-outs, budget edges) would record
+                    # structural nodes=0 samples and skew the histogram
+                    self._metrics.decode_spec_tree(
+                        self._deployment, nodes, int(acc[i])
+                    )
             for c in seq.trace_ctxs:
                 vs = c.buf.begin(
-                    "decode.verify",
-                    c.span.span_id,
-                    {"slot": i, "proposed": int(limits[i]), **self._mesh_attrs},
-                    start_ns=t0,
+                    "decode.verify", c.span.span_id, attrs, start_ns=t0
                 )
-                vs.add_event("accept", {"accepted": int(acc[i])})
+                ev = {"accepted": int(acc[i])}
+                if tree is not None:
+                    ev["path_depth"] = int(acc[i])
+                vs.add_event("accept", ev)
                 vs.end(t1)
             for j in range(int(acc[i]) + 1):
                 tok = int(out_t[i, j])
                 seq.pos += 1
                 self._emit(seq, tok)
                 emitted += 1
+                if riding:
+                    # only tokens from slots that actually speculated count
+                    # toward the per-ride amortization — a limit-0 slot's
+                    # plain-equivalent token would inflate emitted/rides
+                    self.stat_spec_ride_emitted += 1
                 if self._finished(seq, tok):
                     self._retire(i)
                     break
         self.stat_spec_proposed += proposed
         self.stat_spec_accepted += accepted
         self.stat_spec_emitted += emitted
-        self._metrics.decode_spec(self._deployment, proposed, accepted, emitted)
+        self.stat_spec_rides += int((limits > 0).sum())
+        if self._adapt is not None:
+            self._adapt.update(accepted, proposed)
+        self._metrics.decode_spec(
+            self._deployment, proposed, accepted, emitted, mode=mode
+        )
 
     async def _run(self) -> None:
         try:
@@ -1340,7 +1624,13 @@ class DecodeScheduler:
                     await asyncio.sleep(0)
                     continue
                 limits = None
+                wlimits = None
                 if self.spec_enabled:
+                    # accept-rate-adaptive effective depth for THIS round:
+                    # the ceiling is the configured spec_k / tree depth,
+                    # 0 degrades the round to plain decode (data-only —
+                    # the program set never changes)
+                    ad = self._adapt.depth()
                     limits = np.zeros(self.n_slots, np.int32)
                     for i, seq in enumerate(self._slots):
                         if seq is None or seq.prefilling:
@@ -1350,10 +1640,37 @@ class DecodeScheduler:
                         # accepted + 1 tokens) — a slot one token from its
                         # budget rides the round with limit 0
                         limits[i] = max(
-                            0, min(seq.spec_k, seq.max_new - len(seq.tokens) - 1)
+                            0, min(seq.spec_k, ad, seq.max_new - len(seq.tokens) - 1)
                         )
+                    if self.spec_tree is not None:
+                        # per-slot per-depth branching widths: the request's
+                        # tightened tree, cut to the slot's depth allowance
+                        # (budget + adaptation). Width 0 at a depth ends the
+                        # acceptance walk there as a limit clamp.
+                        wlimits = np.zeros(
+                            (self.n_slots, self.spec_tree.depth), np.int32
+                        )
+                        for i, seq in enumerate(self._slots):
+                            if seq is None or seq.prefilling or limits[i] <= 0:
+                                continue
+                            w = seq.tree_widths or self.spec_tree.branching
+                            for d in range(min(int(limits[i]), len(w))):
+                                if w[d] <= 0:
+                                    break
+                                wlimits[i, d] = w[d]
+                            # limits[i] must equal the depth the walk can
+                            # actually reach: a spec_tree tighten ("0", or
+                            # a short/zeroed width string) otherwise leaves
+                            # unreachable depth positions in `proposed`,
+                            # which skews the accept-rate estimate (and the
+                            # adaptive floor) down for the whole deployment
+                            limits[i] = int((wlimits[i] > 0).sum())
                 tick = self._next_tick()
-                spec_round = limits is not None and bool(limits.any())
+                spec_round = (
+                    bool(wlimits.any())
+                    if wlimits is not None
+                    else (limits is not None and bool(limits.any()))
+                )
 
                 # page residency for the round's writes: 1 token per
                 # generating slot on the plain step, the full [k+1]-wide
@@ -1373,7 +1690,9 @@ class DecodeScheduler:
                 self._kv_gauges()
 
                 if spec_round:
-                    await self._spec_round(bt, toks, pos, temps, topks, limits, tick)
+                    await self._spec_round(
+                        bt, toks, pos, temps, topks, limits, wlimits, tick
+                    )
                     await asyncio.sleep(0)
                     continue
 
@@ -1452,10 +1771,11 @@ class DecodeScheduler:
     def request_params_from_meta(self, meta: Meta) -> dict:
         """Per-request overrides ride meta.tags (the JSON envelope's
         ``meta.tags`` — no schema change for existing clients): temperature,
-        top_k, max_new_tokens, spec_k, cache_prefix, prefill_chunk. Values
-        clamp to the deployment's caps (spec_k and prefill_chunk are
-        tighten-only: a request can reduce or disable them, never widen
-        past the deployment's; cache_prefix clamps to decode_prefix_ctx)."""
+        top_k, max_new_tokens, spec_k, spec_tree, cache_prefix,
+        prefill_chunk. Values clamp to the deployment's caps (spec_k,
+        spec_tree, and prefill_chunk are tighten-only: a request can
+        reduce or disable them, never widen past the deployment's;
+        cache_prefix clamps to decode_prefix_ctx)."""
         tags = meta.tags or {}
         out: dict = {}
         for key, cast in (
@@ -1474,6 +1794,11 @@ class DecodeScheduler:
                         ErrorCode.ENGINE_INVALID_JSON,
                         f"meta.tags.{key} must be a number, got {tags[key]!r}",
                     )
+        if "spec_tree" in tags:
+            # per-depth branching tighten, e.g. "2,1" — validated against
+            # the deployment tree at submit; ignored on non-tree
+            # deployments (the tighten-only contract: nothing to narrow)
+            out["spec_tree"] = str(tags["spec_tree"])
         return out
 
     async def execute_message(self, msg: SeldonMessage) -> SeldonMessage:
@@ -1552,8 +1877,39 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         return None
     draft_uri = str(getattr(tpu_spec, "decode_draft_model", "") or "")
     spec_k = int(getattr(tpu_spec, "decode_spec_k", 0))
+    spec_tree = str(getattr(tpu_spec, "decode_spec_tree", "") or "").strip()
+    if spec_tree:
+        # pre-check the tree shape with the same parser/caps the scheduler
+        # ctor enforces as hard errors — through serving an unservable
+        # opt-in degrades with a log line (the spec-mode precedent)
+        try:
+            if SpecTree.from_text(spec_tree).n_tree > MAX_TREE_NODES:
+                raise ValueError(
+                    f"flattens past the {MAX_TREE_NODES}-node verify headroom"
+                )
+        except ValueError as e:
+            log.warning(
+                "decode_spec_tree=%r unservable (%s) — tree speculation "
+                "disabled", spec_tree, e,
+            )
+            spec_tree = ""
+    if spec_tree and not draft_uri:
+        log.warning(
+            "decode_spec_tree=%r needs decode_draft_model — tree "
+            "speculation disabled", spec_tree,
+        )
+        spec_tree = ""
+    if not spec_tree and spec_k > MAX_TREE_NODES:
+        # the chain rides the same widened-dispatch headroom (a k-chain
+        # IS a branching-1 tree) — same warn-disable precedent as an
+        # unservable tree, so a stale CR degrades instead of failing boot
+        log.warning(
+            "decode_spec_k=%s exceeds the %s-token verify headroom — "
+            "speculation disabled", spec_k, MAX_TREE_NODES,
+        )
+        spec_k = 0
     draft_params = None
-    if draft_uri and spec_k > 0:
+    if draft_uri and (spec_k > 0 or spec_tree):
         from seldon_core_tpu.models.zoo import _parse_zoo_uri, get_model
 
         if draft_uri.startswith("zoo://"):
@@ -1572,12 +1928,14 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
                 draft_uri,
             )
             spec_k = 0
+            spec_tree = ""
         else:
             draft_params = jax.device_put(dspec.params)
     elif draft_uri or spec_k > 0:
         log.warning(
             "speculative decoding needs BOTH decode_draft_model and "
-            "decode_spec_k > 0 (got %r / %s) — speculation disabled",
+            "decode_spec_k > 0 (or decode_spec_tree) — got %r / %s — "
+            "speculation disabled",
             draft_uri, spec_k,
         )
         spec_k = 0
@@ -1608,6 +1966,8 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         queue_timeout_s=float(getattr(tpu_spec, "queue_timeout_ms", 0.0)) / 1000.0,
         draft_params=draft_params,
         spec_k=spec_k if draft_params is not None else 0,
+        spec_tree=spec_tree if draft_params is not None else "",
+        spec_accept_floor=float(getattr(tpu_spec, "decode_spec_accept_floor", 0.0)),
         prefix_slots=int(getattr(tpu_spec, "decode_prefix_slots", 0)),
         prefix_ctx=int(getattr(tpu_spec, "decode_prefix_ctx", 0)),
         prefill_chunk=int(getattr(tpu_spec, "decode_prefill_chunk", 0)),

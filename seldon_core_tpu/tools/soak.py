@@ -58,6 +58,7 @@ async def soak(
     fault_spec=None,
     trace_summary: int = 0,
     spec_k: int = 0,
+    spec_tree: str = "",
     prefix_share: float = 0.0,
     paged: bool = False,
     tp: int = 0,
@@ -86,7 +87,7 @@ async def soak(
         paged = True
         if prefix_share <= 0:
             prefix_share = 0.6
-    generative = spec_k > 0 or prefix_share > 0 or paged or tp > 1
+    generative = spec_k > 0 or bool(spec_tree) or prefix_share > 0 or paged or tp > 1
     if generative:
         if model != "iris_mlp":
             import sys as _sys
@@ -119,16 +120,22 @@ async def soak(
                 {"name": "ffn", "value": "1024", "type": "INT"},
             ]
             predictor_extra["tpu"]["decode_mesh_axes"] = {"tp": tp}
-        if spec_k > 0:
+        if spec_k > 0 or spec_tree:
             draft_uri = "zoo://draft?layers=1&resid_scale=0.1"
             if tp > 1:
                 # the draft shards on the same mesh — pin its geometry to
                 # the target's (only vocab/max_len are auto-injected)
                 draft_uri += "&hidden=256&ffn=1024"
-            predictor_extra["tpu"].update(
-                decode_spec_k=spec_k,
-                decode_draft_model=draft_uri,
-            )
+            predictor_extra["tpu"]["decode_draft_model"] = draft_uri
+            if spec_tree:
+                # tree speculation: the same draft proposes per-depth
+                # top-b candidate branches, one widened verify scores the
+                # flattened tree — sustained load drives the tree round
+                # pair (and, with --paged/--tp, the same allocator and
+                # per-shard audits the chain soaks run)
+                predictor_extra["tpu"]["decode_spec_tree"] = spec_tree
+            else:
+                predictor_extra["tpu"]["decode_spec_k"] = spec_k
         if prefix_share > 0:
             predictor_extra["tpu"].update(
                 decode_prefix_slots=8,
@@ -289,15 +296,18 @@ async def soak(
         traces = get_tracer().store.slowest_summaries(n=trace_summary)
     spec_stats = None
     sched = getattr(server, "decode_scheduler", None)
-    if spec_k > 0 and sched is not None:
+    if (spec_k > 0 or spec_tree) and sched is not None:
         spec_stats = {
-            "spec_k": spec_k,
+            **({"spec_tree": spec_tree} if spec_tree else {"spec_k": spec_k}),
             "spec_dispatches": sched.stat_spec_dispatches,
             "accept_rate": round(
                 sched.stat_spec_accepted / max(sched.stat_spec_proposed, 1), 3
             ),
             "tokens_per_dispatch": round(
                 sched.stat_spec_emitted / max(sched.stat_spec_dispatches, 1), 2
+            ),
+            "tokens_per_ride": round(
+                sched.stat_spec_ride_emitted / max(sched.stat_spec_rides, 1), 2
             ),
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
@@ -424,6 +434,16 @@ def main(argv=None) -> None:
         "accept_rate / tokens_per_dispatch under 'spec'",
     )
     ap.add_argument(
+        "--spec-tree",
+        default="",
+        metavar="B,B,...",
+        help="run the soak with TREE speculation (decode_spec_tree, e.g. "
+        "'2,2,1'): per-depth top-b candidate branches scored in one "
+        "widened verify dispatch; the report gains accept_rate / "
+        "tokens_per_ride under 'spec' and composes with --paged/--tp "
+        "(same allocator + per-shard audits)",
+    )
+    ap.add_argument(
         "--prefix-share",
         type=float,
         default=0.0,
@@ -491,6 +511,7 @@ def main(argv=None) -> None:
                 fault_spec=fault_spec,
                 trace_summary=args.trace_summary,
                 spec_k=args.spec_k,
+                spec_tree=args.spec_tree,
                 prefix_share=args.prefix_share,
                 paged=args.paged,
                 tp=args.tp,

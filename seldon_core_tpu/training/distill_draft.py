@@ -1,0 +1,269 @@
+"""Distill a speculative DRAFT against its serving target.
+
+The ``zoo://draft`` entry ships as a seed-shared layer truncation of its
+target — the untrained-weights analogue of a distilled draft (PR 4). Its
+accept rate comes entirely from the shared residual prefix; nothing ever
+LEARNS the target's conditionals. This module closes that gap with the
+idle training machinery (training/steps.py): teacher-forced target logits
+at every position (models/decoder.sequence_logits) -> KL into the draft,
+on a mix of ON-POLICY sequences (prompt + the target's own greedy
+continuation — the distribution verify rounds actually score the draft
+on, since context during decode IS the target's accepted chain) and
+uniform-random sequences (so the draft doesn't collapse off-path).
+
+Run:
+
+    python -m seldon_core_tpu.training.distill_draft \
+        --hidden 256 --layers 4 --ffn 1024 --draft-layers 1 \
+        --steps 300 --out /tmp/draft_distilled.npz
+
+and serve the result via the checkpoint-loading draft variant:
+
+    tpu.decode_draft_model: "zoo://draft?layers=1&...&distilled=/tmp/draft_distilled.npz"
+
+The report prints the greedy accept-rate proxy (draft/target argmax
+agreement along target-greedy trajectories — exactly the per-position
+acceptance probability of the chain/tree walk) before and after, plus the
+KL trajectory; the measured delta for the stock bench pair is recorded in
+PARITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+# ------------------------------------------------------- checkpoint format
+# A flat .npz keyed by dotted tree paths ("layers.0.qkv.w", "ln_f.g", ...):
+# readable with plain numpy, no pickle, geometry checked on load against
+# the receiving build's own init (a distilled checkpoint can only REFILL a
+# draft of the same architecture, never change it).
+
+
+def flatten_params(params) -> dict:
+    flat: dict = {}
+
+    def walk(p, prefix):
+        if isinstance(p, dict):
+            for k, v in p.items():
+                walk(v, f"{prefix}{k}.")
+        elif isinstance(p, (list, tuple)):
+            for i, v in enumerate(p):
+                walk(v, f"{prefix}{i}.")
+        else:
+            flat[prefix[:-1]] = np.asarray(p)
+
+    walk(params, "")
+    return flat
+
+
+def save_draft_checkpoint(path: str, params) -> None:
+    np.savez(path, **flatten_params(params))
+
+
+def load_draft_checkpoint(path: str, like):
+    """Rebuild ``like``'s tree structure from the checkpoint, raising on
+    any missing key or shape mismatch (the load is an architecture
+    assertion, not a best-effort merge)."""
+    data = np.load(path)
+
+    def walk(p, prefix):
+        if isinstance(p, dict):
+            return {k: walk(v, f"{prefix}{k}.") for k, v in p.items()}
+        if isinstance(p, (list, tuple)):
+            return [walk(v, f"{prefix}{i}.") for i, v in enumerate(p)]
+        key = prefix[:-1]
+        if key not in data:
+            raise ValueError(f"distilled checkpoint {path!r} is missing {key!r}")
+        arr = data[key]
+        want = np.shape(p)
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"distilled checkpoint {path!r} {key!r} has shape "
+                f"{tuple(arr.shape)}, the draft build wants {tuple(want)} — "
+                "the checkpoint was trained for a different geometry"
+            )
+        return arr.astype(np.asarray(p).dtype)
+
+    return walk(like, "")
+
+
+# ------------------------------------------------------------- the recipe
+
+
+def greedy_accept_proxy(target, draft, prompts: np.ndarray, max_new: int) -> float:
+    """Per-position greedy acceptance probability: along the TARGET's own
+    greedy continuation of each prompt, the fraction of generated
+    positions where the draft's argmax equals the target's. This is
+    exactly what the chain walk accepts per depth (and a lower bound per
+    depth for a top-b tree), so it converts directly into expected
+    accepted-tokens-per-dispatch."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.decoder import generate, sequence_logits
+
+    full = np.asarray(generate(target, jnp.asarray(prompts), max_new))
+    # position j's logits row predicts token j+1 — compare predictions
+    # for the GENERATED span only (the prompt is given, not predicted)
+    tl = np.asarray(sequence_logits(target, jnp.asarray(full[:, :-1])))
+    dl = np.asarray(sequence_logits(draft, jnp.asarray(full[:, :-1])))
+    gen = slice(prompts.shape[1] - 1, full.shape[1] - 1)
+    return float(
+        np.mean(np.argmax(tl[:, gen], -1) == np.argmax(dl[:, gen], -1))
+    )
+
+
+def distill(
+    *,
+    seed: int = 0,
+    vocab: int = 512,
+    hidden: int = 256,
+    layers: int = 4,
+    ffn: int = 1024,
+    max_len: int = 80,
+    resid_scale: float = 1.0,
+    draft_layers: int = 1,
+    seq: int = 16,
+    horizon: int = 48,
+    batch: int = 16,
+    steps: int = 300,
+    lr: float = 1e-3,
+    teacher_temp: float = 0.5,
+    on_policy_frac: float = 0.5,
+    eval_prompts: int = 16,
+    out: str = "",
+    log_every: int = 50,
+    data_seed: int = 1234,
+) -> dict:
+    """Distill the seed-shared truncation draft against its target; returns
+    the report dict (accept proxy before/after, final KL) and writes the
+    checkpoint to ``out`` when set."""
+    import jax.numpy as jnp
+    import optax
+
+    from seldon_core_tpu.models.decoder import generate, init_decoder, sequence_logits
+    from seldon_core_tpu.training.steps import init_state, make_distill_step
+
+    target = init_decoder(
+        seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
+        max_len=max_len, resid_scale=resid_scale,
+    )
+    draft = init_decoder(
+        seed, vocab=vocab, hidden=hidden, layers=draft_layers, ffn=ffn,
+        max_len=max_len, resid_scale=resid_scale,
+    )
+
+    rng = np.random.default_rng(data_seed)
+    eval_ids = rng.integers(0, vocab, (eval_prompts, seq)).astype(np.int32)
+    accept_before = greedy_accept_proxy(target, draft, eval_ids, horizon - seq)
+
+    import jax
+
+    opt = optax.adam(lr)
+    teacher = jax.jit(lambda ids: sequence_logits(target, ids))
+    step = jax.jit(make_distill_step(sequence_logits, opt, teacher_temp))
+    state = init_state(draft, opt)
+
+    # on-policy pool: target-greedy continuations of random prompts,
+    # regenerated sparsely (they are the expensive half of the data).
+    # The teacher is FROZEN, so pool rows' logits are computed once per
+    # refresh and gathered per step — recomputing them every step would
+    # spend ~half the teacher forward cost on targets that cannot change.
+    def on_policy_batch(n):
+        p = rng.integers(0, vocab, (n, seq)).astype(np.int32)
+        ids = np.asarray(generate(target, jnp.asarray(p), horizon - seq))
+        return ids, np.asarray(teacher(jnp.asarray(ids)))
+
+    pool, pool_t = on_policy_batch(max(batch * 4, 32))
+    kl = agree = float("nan")
+    history = []
+    for i in range(steps):
+        n_on = int(round(batch * on_policy_frac))
+        idx = rng.integers(0, len(pool), n_on) if n_on else None
+        rand = rng.integers(0, vocab, (batch - n_on, horizon)).astype(np.int32)
+        rand_t = np.asarray(teacher(jnp.asarray(rand))) if len(rand) else None
+        if idx is not None:
+            ids = np.concatenate([pool[idx], rand])
+            t = (
+                np.concatenate([pool_t[idx], rand_t])
+                if rand_t is not None
+                else pool_t[idx]
+            )
+        else:
+            ids, t = rand, rand_t
+        state, m = step(state, {"x": jnp.asarray(ids), "t": jnp.asarray(t)})
+        kl, agree = float(m["kl"]), float(m["top1_agreement"])
+        if log_every and (i + 1) % log_every == 0:
+            history.append({"step": i + 1, "kl": round(kl, 4),
+                            "top1": round(agree, 4)})
+            print(f"step {i+1:5d}  kl {kl:.4f}  top1 {agree:.4f}", flush=True)
+        if (i + 1) % max(1, steps // 4) == 0:
+            pool, pool_t = on_policy_batch(len(pool))  # refresh as the draft moves
+
+    distilled = jax.tree.map(np.asarray, state.params)
+    accept_after = greedy_accept_proxy(target, distilled, eval_ids, horizon - seq)
+    if out:
+        save_draft_checkpoint(out, distilled)
+    return {
+        "accept_proxy_before": round(accept_before, 4),
+        "accept_proxy_after": round(accept_after, 4),
+        "final_kl": round(kl, 4),
+        "final_top1": round(agree, 4),
+        "steps": steps,
+        "history": history,
+        "checkpoint": out or None,
+        "geometry": {
+            "seed": seed, "vocab": vocab, "hidden": hidden, "layers": layers,
+            "ffn": ffn, "max_len": max_len, "resid_scale": resid_scale,
+            "draft_layers": draft_layers,
+        },
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4, help="TARGET layers")
+    ap.add_argument("--ffn", type=int, default=1024)
+    ap.add_argument("--max-len", type=int, default=80)
+    ap.add_argument("--resid-scale", type=float, default=1.0)
+    ap.add_argument("--draft-layers", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=16, help="prompt length")
+    ap.add_argument(
+        "--horizon", type=int, default=48,
+        help="full training-sequence length (prompt + on-policy span)",
+    )
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument(
+        "--teacher-temp", type=float, default=0.5,
+        help="sharpen the teacher before the KL (<1 weights its argmax; "
+        "1.0 is pure distribution-matching)",
+    )
+    ap.add_argument(
+        "--on-policy-frac", type=float, default=0.5,
+        help="fraction of each batch drawn from target-greedy continuations",
+    )
+    ap.add_argument("--out", default="", help="checkpoint path (.npz)")
+    ap.add_argument("--log-every", type=int, default=50)
+    args = ap.parse_args(argv)
+    report = distill(
+        seed=args.seed, vocab=args.vocab, hidden=args.hidden, layers=args.layers,
+        ffn=args.ffn, max_len=args.max_len, resid_scale=args.resid_scale,
+        draft_layers=args.draft_layers, seq=args.seq, horizon=args.horizon,
+        batch=args.batch, steps=args.steps, lr=args.lr,
+        teacher_temp=args.teacher_temp,
+        on_policy_frac=args.on_policy_frac, out=args.out,
+        log_every=args.log_every,
+    )
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,64 @@ def init_state(params: Any, optimizer: optax.GradientTransformation) -> TrainSta
     return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
 
+def kl_from_teacher(
+    teacher_logits: jax.Array,
+    student_logits: jax.Array,
+    teacher_temp: float = 1.0,
+) -> jax.Array:
+    """Mean KL(teacher || student) over every position: the distillation
+    objective — mass goes exactly where the teacher puts it, which for a
+    speculative DRAFT is the quantity that becomes accept rate (greedy
+    acceptance is argmax agreement; sampled acceptance is min(1, p/q)
+    overlap — both are maximized by matching the teacher's distribution,
+    not by one-hot cross-entropy on sampled tokens). ``teacher_temp`` < 1
+    SHARPENS the teacher before the KL (τ -> 0 is cross-entropy on the
+    teacher's argmax): for low-margin teachers — e.g. the depth-scaled
+    resid_scale builds, whose softmax is near-uniform even where the
+    argmax is stable — the unsharpened KL barely rewards ranking the
+    teacher's top token first, which is exactly what greedy acceptance
+    pays for."""
+    t = jax.nn.log_softmax(
+        teacher_logits.astype(jnp.float32) / teacher_temp, axis=-1
+    )
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.sum(jnp.exp(t) * (t - s), axis=-1))
+
+
+def make_distill_step(
+    logits_fn: LogitsFn,
+    optimizer: optax.GradientTransformation,
+    teacher_temp: float = 1.0,
+):
+    """KL-distillation train step: batch = {"x": token ids [b, s],
+    "t": teacher-forced TEACHER logits [b, s, vocab]} -> the student's
+    sequence logits chase the teacher's at every position. Teacher logits
+    ride the batch (computed once per batch by the caller, e.g. with
+    models/decoder.sequence_logits) so the teacher itself never traces
+    into the student's backward pass. Metrics: the KL itself and top-1
+    agreement — the direct proxy for greedy speculative accept rate."""
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        def loss_fn(p):
+            logits = logits_fn(p, batch["x"])
+            loss = kl_from_teacher(batch["t"], logits, teacher_temp)
+            agree = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == jnp.argmax(batch["t"], axis=-1))
+                .astype(jnp.float32)
+            )
+            return loss, agree
+
+        (loss, agree), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1),
+            {"kl": loss, "top1_agreement": agree},
+        )
+
+    return step
+
+
 def shard_state(
     state: TrainState, mesh: Mesh, param_pspecs: Any | None
 ) -> tuple[TrainState, Any]:

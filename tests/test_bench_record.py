@@ -191,6 +191,37 @@ def worst_case_full_record() -> dict:
                 "speedup_vs_tp1": 0.65,
             },
         },
+        "tree": {
+            "scenario": {
+                "requests": 24, "n_slots": 4, "seq": 32, "shared_prefix": 24,
+                "max_new": 32, "model": "hidden 64 x 2L, vocab 256",
+                "draft": "1L, KL-distilled in-leg (150 steps, resid_scale=1.0)",
+                "spec_k": 4, "spec_tree": "2,2,1,1", "rtt_floor_ms": 100.0,
+            },
+            "distill": {
+                "accept_proxy_before": 0.0664, "accept_proxy_after": 0.5352,
+                "final_kl": 0.006,
+            },
+            "plain": {
+                "dispatches": 207, "recompiles_after_warmup": 0,
+                "tokens_per_sec_raw": 2157.1, "tokens_per_sec_rtt": 35.6,
+            },
+            "chain": {
+                "dispatches": 106, "recompiles_after_warmup": 0,
+                "accept_rate": 0.352, "tokens_per_ride": 2.37,
+                "spec_dispatches": 85, "tokens_per_sec_raw": 1251.5,
+                "tokens_per_sec_rtt": 58.8,
+            },
+            "tree": {
+                "dispatches": 84, "recompiles_after_warmup": 0,
+                "accept_rate": 0.568, "tokens_per_ride": 3.21,
+                "spec_dispatches": 66, "tokens_per_sec_raw": 448.6,
+                "tokens_per_sec_rtt": 63.4,
+            },
+            "outputs_identical": True,
+            "tokens_per_ride_vs_chain": 1.35,
+            "rtt_speedup_vs_chain": 1.08,
+        },
         "tokens_per_sec_speedup": 2.64,
         "spec_tokens_per_sec_speedup": 1.71,
     }
@@ -303,6 +334,13 @@ def test_compact_record_carries_every_headline():
         "prefix_tok_s_chunked": 1389.77,
         "prefix_itl_p99": 44.91,
         "prefix_itl_p99_chunked": 21.08,
+        # tree-speculation sub-leg, [tree, chain] pairs: tokens/s under
+        # the dispatch-RTT floor and per-slot accepted+bonus per verify
+        # dispatch at the same 2-dispatch round shape (identity contract
+        # + distilled-draft delta live in the full record / PARITY.md)
+        "tree_tok_s": [63.4, 58.8],
+        "tree_ride": [3.21, 2.37],
+        "tree_speedup": 1.08,
         # tensor-parallel sub-leg: tokens/s per width (width order), the
         # widest leg's speedup + identity contract, recompiles all-zero
         "tp_widths": [1, 2, 4],

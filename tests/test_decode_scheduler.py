@@ -572,7 +572,8 @@ async def test_spec_eos_retirement_and_metrics_emission():
         def __init__(self):
             self.calls = []
 
-        def decode_spec(self, deployment, proposed, accepted, emitted):
+        def decode_spec(self, deployment, proposed, accepted, emitted, mode="chain"):
+            assert mode == "chain"  # spec_k deployments label the chain shape
             self.calls.append((proposed, accepted, emitted))
 
     params, draft = _draft_pair()

@@ -1,0 +1,736 @@
+"""Tree speculation (models/spec_tree.py + decoder tree blocks + scheduler).
+
+The load-bearing invariants, in dependency order:
+
+- the STATIC layout (SpecTree) is self-consistent: parent/child tables
+  agree, the ancestor mask is exactly ancestor-or-self, and a branching-1
+  tree reduces to the chain's lower-triangular mask;
+- the widened tree verify is the multi-path generalization of sequential
+  decode: every flattened node's logits equal a sequential paged decode
+  walk down that node's path, so greedy path acceptance is bit-exact for
+  ANY draft (the chain argument, per path);
+- acceptance preserves the target distribution at temperature > 0
+  (per-depth recursive rejection resampling over i.i.d. candidates — the
+  SpecInfer argument), checked both via the one-hot determinism trick and
+  an empirical-marginal test on the acceptance walk itself;
+- the scheduler's tree rounds stay greedy bit-identical to the plain
+  scheduler and the fused scan oracle, compose with tp/int8/paged/prefix,
+  never recompile on mixed plain/chain/tree traffic, and the adaptive
+  floor degrades a low-accept workload to plain decode.
+"""
+
+import asyncio
+import logging
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.decoder import generate, init_decoder
+from seldon_core_tpu.models.spec_tree import (
+    MAX_TREE_NODES,
+    SpecTree,
+    parse_spec_tree,
+)
+from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler, _SpecAdapt
+
+SEQ = 8
+MAX_NEW = 10
+VOCAB = 128
+
+
+def _params(layers=2):
+    return init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=layers, ffn=128, max_len=64,
+        resid_scale=0.1,
+    )
+
+
+def _draft():
+    """Seed-shared 1-of-2-layer truncation of _params(): high-accept."""
+    return init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=1, ffn=128, max_len=64,
+        resid_scale=0.1,
+    )
+
+
+def _unrelated_draft():
+    """No relation to the target — accept ~0, every round rejects."""
+    return init_decoder(seed=99, vocab=VOCAB, hidden=64, layers=1, ffn=128, max_len=64)
+
+
+def _prompts(n, seed=1):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n, SEQ)).astype(np.int32)
+
+
+def _shared_prompts(n, shared=5, seed=2):
+    rng = np.random.default_rng(seed)
+    head = rng.integers(0, VOCAB, shared).astype(np.int32)
+    return np.stack(
+        [
+            np.concatenate([head, rng.integers(0, VOCAB, SEQ - shared)]).astype(
+                np.int32
+            )
+            for _ in range(n)
+        ]
+    )
+
+
+def _scheduler(params, n_slots=2, **kw) -> DecodeScheduler:
+    s = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=n_slots, **kw
+    )
+    s.warmup()
+    return s
+
+
+def _oracle(params, ids, max_new=MAX_NEW) -> np.ndarray:
+    return np.asarray(generate(params, jnp.asarray(ids), max_new))
+
+
+# ------------------------------------------------------------ static layout
+
+
+def test_spec_tree_parse_and_layout():
+    assert parse_spec_tree("4, 2,1") == (4, 2, 1)
+    with pytest.raises(ValueError, match="at least one depth"):
+        parse_spec_tree("")
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_spec_tree("4,x")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_spec_tree("4,0")
+
+    t = SpecTree.from_text("2,2,1")
+    assert t.depth == 3
+    assert t.level_counts == (2, 4, 4)
+    assert t.n_tree == 10 and t.width == 11
+    assert t.level_starts == (1, 3, 7)
+    # depth-major parent-major layout: blocks 3..6 are the depth-2
+    # children — block 1's pair first, then block 2's
+    np.testing.assert_array_equal(
+        t.parent_block, [0, 0, 0, 1, 1, 2, 2, 3, 4, 5, 6]
+    )
+    np.testing.assert_array_equal(t.block_depth, [0, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3])
+    # child table inverts the parent table, in branch order
+    for j in range(t.width):
+        for c in t.child_table[j]:
+            if c:
+                assert t.parent_block[c] == j
+    # ancestor mask is exactly ancestor-or-self (root included)
+    m = t.ancestor_mask
+    assert m[0].sum() == 1  # the root sees only itself in-block
+    assert list(np.where(m[8])[0]) == [0, 1, 4, 8]  # 8 -> 4 -> 1 -> root
+
+
+def test_spec_tree_chain_reduces_to_lower_triangular():
+    t = SpecTree.chain(4)
+    assert t.branching == (1, 1, 1, 1) and t.width == 5
+    np.testing.assert_array_equal(t.ancestor_mask, np.tril(np.ones((5, 5), bool)))
+
+
+def test_spec_tree_tighten_only():
+    t = SpecTree.from_text("4,2,1")
+    assert t.tighten((2, 1, 1)) == (2, 1, 1)  # narrow
+    assert t.tighten((9, 9, 9)) == (4, 2, 1)  # widen attempts clamp
+    assert t.tighten((2,)) == (2, 0, 0)  # omitted depths = depth tighten
+    assert t.tighten((0,)) == (0, 0, 0)  # full opt-out
+
+
+# ---------------------------------------- tree verify vs sequential decode
+
+
+def test_tree_verify_logits_match_sequential_paged_decode():
+    """Every flattened node's logits from the ONE widened tree dispatch
+    equal a sequential paged decode walk down that node's path — the
+    per-path generalization of the PR 4 verify-vs-sequential contract,
+    and the property greedy path acceptance is exact because of."""
+    from seldon_core_tpu.models.decoder import (
+        paged_chunk_prefill, paged_decode_step, paged_kv_init, paged_tree_verify,
+    )
+
+    params = _params()
+    tree = SpecTree.from_text("2,2")
+    ps, ctx = 4, SEQ + MAX_NEW
+    pps = -(-ctx // ps)
+    n_slots, slot = 2, 1
+    pool = paged_kv_init(params, 1 + n_slots * pps, ps)
+    bt = np.zeros((n_slots, pps), np.int32)
+    bt[slot] = np.arange(1 + slot * pps, 1 + (slot + 1) * pps)
+    ids = _prompts(1, seed=9)[0]
+    toks = np.zeros((n_slots, SEQ), np.int32)
+    toks[slot] = ids
+    zero = np.zeros(n_slots, np.int32)
+    counts = np.zeros(n_slots, np.int32)
+    counts[slot] = SEQ
+    pl, pool = paged_chunk_prefill(
+        params, pool, jnp.asarray(bt), jnp.asarray(toks), jnp.asarray(zero),
+        jnp.asarray(counts),
+    )
+    root_tok = int(np.argmax(np.asarray(pl)[slot, SEQ - 1]))
+    # arbitrary DISTINCT node tokens (a worst-case draft — acceptance is
+    # not what's under test, the scoring is)
+    node_toks = (np.arange(tree.n_tree) * 7 + 3) % VOCAB
+    queries = np.zeros((n_slots, tree.width), np.int32)
+    queries[slot] = np.concatenate([[root_tok], node_toks])
+    pos = np.zeros(n_slots, np.int32)
+    pos[slot] = SEQ
+    logits, _, _ = paged_tree_verify(
+        params, pool, jnp.asarray(bt), jnp.asarray(queries), jnp.asarray(pos), tree
+    )
+    logits = np.asarray(logits)[slot]
+    # sequential oracle per block: consume the block's path token-by-token
+    # from the SAME pristine pool (jax arrays are immutable — each walk
+    # re-branches from the post-prefill pool)
+    for blk in range(tree.width):
+        path = [blk]
+        while path[0] != 0:
+            path.insert(0, int(tree.parent_block[path[0]]))
+        seq_pool, lg = pool, None
+        for d, b in enumerate(path):
+            t1 = np.zeros(n_slots, np.int32)
+            p1 = np.zeros(n_slots, np.int32)
+            t1[slot] = queries[slot, b]
+            p1[slot] = SEQ + d
+            lg, seq_pool = paged_decode_step(
+                params, seq_pool, jnp.asarray(bt), jnp.asarray(t1), jnp.asarray(p1)
+            )
+        np.testing.assert_allclose(
+            logits[blk], np.asarray(lg)[slot], rtol=2e-4, atol=2e-5
+        )
+        assert int(np.argmax(logits[blk])) == int(np.argmax(np.asarray(lg)[slot]))
+
+
+# ------------------------------------------------------- acceptance units
+
+
+def _one_hot_logits(n, width, vocab, tokens):
+    """[n, width, vocab] logits one-hot on ``tokens`` [width] (same for
+    every row): argmax-deterministic target/draft stand-ins."""
+    lg = np.full((n, width, vocab), -10.0, np.float32)
+    for j, t in enumerate(tokens):
+        lg[:, j, t] = 10.0
+    return lg
+
+
+def test_accept_tree_greedy_sibling_catch_unit():
+    """Hand-built one-hot logits on a '2,1' tree: the target's argmax at
+    the root matches the SECOND depth-1 candidate — a chain (branch 0
+    only) would die at depth 1, the tree walks the sibling and continues;
+    width-limit 0 at a depth ends the walk as a limit clamp with the
+    bonus from the target's own distribution."""
+    from seldon_core_tpu.models.decoder import speculative_accept_tree
+
+    tree = SpecTree.from_text("2,1")
+    n, vocab = 2, 16
+    # blocks: 0=root, 1/2=depth-1 candidates, 3/4=their depth-2 children
+    block_tokens = np.tile(np.array([5, 7, 9, 11, 13], np.int32), (n, 1))
+    # target argmax: after root -> 9 (block 2, the SIBLING), after block 2
+    # -> 13 (its child, block 4), after block 4 -> 3 (the bonus)
+    target = _one_hot_logits(n, tree.width, vocab, [9, 1, 13, 2, 3])
+    draft = _one_hot_logits(n, tree.width, vocab, [9, 1, 13, 2, 3])
+    temps = np.zeros(n, np.float32)
+    topks = np.zeros(n, np.int32)
+    wl = np.array([[2, 1], [2, 0]], np.int32)  # row 1: depth 2 clamped off
+    out, n_acc, path_idx = speculative_accept_tree(
+        jnp.asarray(target), jnp.asarray(block_tokens), jnp.asarray(draft),
+        jnp.asarray(wl), jnp.asarray(temps), jnp.asarray(topks),
+        __import__("jax").random.key(0), tree,
+    )
+    out, n_acc, path_idx = np.asarray(out), np.asarray(n_acc), np.asarray(path_idx)
+    # row 0: full path root -> block 2 -> block 4, bonus 3
+    assert n_acc[0] == 2
+    np.testing.assert_array_equal(path_idx[0], [0, 2, 4])
+    np.testing.assert_array_equal(out[0], [9, 13, 3])
+    # row 1: the limit clamp ends the walk after depth 1 — the bonus is
+    # the target's argmax AFTER block 2 (13), not a rejection residual
+    assert n_acc[1] == 1
+    assert out[1][0] == 9 and out[1][1] == 13
+
+
+def test_accept_tree_sampled_marginal_preserved():
+    """Distribution preservation at temperature > 0: feed the acceptance
+    walk i.i.d. draft candidates drawn from q (exactly what
+    draft_propose_tree emits) over 4096 independent rows and check the
+    FIRST emitted token's empirical marginal equals the target's softmax —
+    accept + residual-resample together must be a perfect sampler of p,
+    whatever q proposes."""
+    import jax
+
+    from seldon_core_tpu.models.decoder import speculative_accept_tree
+
+    tree = SpecTree.from_text("2")  # depth 1, two i.i.d. candidates
+    n, vocab = 4096, 8
+    rng = np.random.default_rng(7)
+    p_logits = rng.normal(size=vocab).astype(np.float32) * 1.5
+    q_logits = rng.normal(size=vocab).astype(np.float32) * 1.5
+    q = np.exp(q_logits) / np.exp(q_logits).sum()
+    target = np.tile(p_logits, (n, tree.width, 1))
+    draft = np.tile(q_logits, (n, tree.width, 1))
+    # candidates i.i.d. from q, per row; the root block token is irrelevant
+    cand = rng.choice(vocab, size=(n, 2), p=q).astype(np.int32)
+    block_tokens = np.concatenate([np.zeros((n, 1), np.int32), cand], axis=1)
+    out, n_acc, _ = speculative_accept_tree(
+        jnp.asarray(target), jnp.asarray(block_tokens), jnp.asarray(draft),
+        jnp.ones((n, 1), np.int32) * 2,
+        jnp.ones(n, np.float32), jnp.zeros(n, np.int32),
+        jax.random.key(11), tree,
+    )
+    out, n_acc = np.asarray(out), np.asarray(n_acc)
+    first = np.where(n_acc > 0, out[:, 0], out[:, 0])  # position 0 either way
+    p = np.exp(p_logits) / np.exp(p_logits).sum()
+    emp = np.bincount(first, minlength=vocab) / n
+    # 4-sigma binomial tolerance at n=4096 is ~0.031 for p=0.5
+    np.testing.assert_allclose(emp, p, atol=0.04)
+    assert n_acc.sum() > 0  # acceptances genuinely happened
+
+
+# ---------------------------------------------------- scheduler: identity
+
+
+@pytest.mark.parametrize("pair", ["high_accept", "low_accept"])
+async def test_tree_greedy_bit_identical_vs_plain_and_oracle(pair):
+    """The acceptance invariant: greedy output with tree speculation on is
+    bit-identical to the plain scheduler and the fused scan oracle — for
+    ANY draft (the walk only keeps nodes matching the target's own argmax
+    chain), with zero recompiles after warmup."""
+    if pair == "high_accept":
+        params, draft = _params(), _draft()
+    else:
+        params, draft = _params(), _unrelated_draft()
+    ids = _prompts(4, seed=21)
+    oracle = _oracle(params, ids)
+    plain = _scheduler(params, n_slots=2)
+    plain_outs = await asyncio.gather(*(plain.submit(row) for row in ids))
+    await plain.close()
+    sched = _scheduler(params, n_slots=2, draft_params=draft, spec_tree="2,2,1")
+    assert sched.spec_tree is not None and sched.spec_k == 3
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids))
+    for row, plain_row, out in zip(oracle, plain_outs, outs):
+        np.testing.assert_array_equal(plain_row, row)
+        np.testing.assert_array_equal(out, row)
+    assert sched.stat_spec_dispatches > 0
+    if pair == "high_accept":
+        # the tree genuinely amortizes: > 1 token per slot-ride on average
+        assert sched.stat_spec_ride_emitted / sched.stat_spec_rides > 1.5
+    # the per-ride numerator counts only riding slots' tokens — never
+    # more than the round total, never fewer than one per ride
+    assert sched.stat_spec_ride_emitted <= sched.stat_spec_emitted
+    assert sched.stat_spec_ride_emitted >= sched.stat_spec_rides
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_degenerate_tree_bit_identical_to_chain():
+    """'1,1,1' IS the PR 4 chain: the degenerate tree's greedy output
+    equals the chain scheduler's (spec_k=3) token-for-token, which equals
+    the oracle — the tree path is a strict generalization, not a fork."""
+    params, draft = _params(), _draft()
+    ids = _prompts(3, seed=31)
+    oracle = _oracle(params, ids)
+    chain = _scheduler(params, n_slots=2, draft_params=draft, spec_k=3)
+    chain_outs = await asyncio.gather(*(chain.submit(row) for row in ids))
+    assert chain.stat_spec_dispatches > 0
+    await chain.close()
+    tree = _scheduler(params, n_slots=2, draft_params=draft, spec_tree="1,1,1")
+    assert tree.spec_tree is not None and tree.spec_tree.n_tree == 3
+    tree_outs = await asyncio.gather(*(tree.submit(row) for row in ids))
+    assert tree.stat_spec_dispatches > 0
+    for row, c_out, t_out in zip(oracle, chain_outs, tree_outs):
+        np.testing.assert_array_equal(c_out, row)
+        np.testing.assert_array_equal(t_out, row)
+    assert tree.recompiles_since_warmup() == 0
+    await tree.close()
+
+
+async def test_tree_sampled_top_k1_matches_oracle():
+    """temperature > 0 with top_k=1 drives the SAMPLED path walk (p/q
+    ratios, per-depth residual resampling, bonus sampling) through
+    one-hot distributions — the emitted tokens must equal the greedy
+    oracle token-for-token: deterministic proof the resampling plumbing
+    preserves the target distribution end-to-end."""
+    params, draft = _params(), _draft()
+    ids = _prompts(3, seed=5)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(params, n_slots=2, draft_params=draft, spec_tree="2,2,1")
+    outs = await asyncio.gather(
+        *(sched.submit(row, temperature=5.0, top_k=1) for row in ids)
+    )
+    for row, out in zip(oracle, outs):
+        np.testing.assert_array_equal(out, row)
+    assert sched.stat_spec_dispatches > 0
+    await sched.close()
+
+
+# --------------------------------------------- scheduler: mixed + recompile
+
+
+async def test_tree_zero_recompiles_mixed_plain_chain_tree_traffic():
+    """The acceptance criterion: mixed traffic — plain opt-outs
+    (spec_k=0), chain-shaped tightens (spec_tree='1,1,1'), narrowed trees,
+    full trees, varying budgets and sampling — compiles NOTHING after
+    warmup; per-request tightening is data-only by construction."""
+    params, draft = _params(), _draft()
+    ids = _prompts(8, seed=2)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(params, n_slots=3, draft_params=draft, spec_tree="2,2,1")
+    counts = sched.compile_counts()
+    for prog in ("draft_tree", "tree_verify", "draft_admit", "step", "chunk"):
+        assert counts.get(prog, 0) >= 1, counts
+    variants = [
+        {},  # full tree
+        {"spec_k": 0},  # plain opt-out
+        {"spec_tree": "1,1,1"},  # chain-shaped tighten
+        {"spec_tree": "1,1"},  # narrower + shallower
+        {"spec_tree": "9,9,9"},  # widen attempt -> clamps to deployment
+        {"temperature": 0.7, "top_k": 3},
+        {"max_new_tokens": 3},
+        {"spec_tree": "2"},
+    ]
+    outs = await asyncio.gather(
+        *(sched.submit(row, **variants[i]) for i, row in enumerate(ids))
+    )
+    for i, (row, out) in enumerate(zip(oracle, outs)):
+        if "temperature" in variants[i]:
+            continue  # sampled rows follow their own branch
+        budget = variants[i].get("max_new_tokens", MAX_NEW)
+        np.testing.assert_array_equal(out, row[: SEQ + budget])
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_tree_meta_tags_tighten_and_reject():
+    """meta.tags.spec_tree rides the envelope: parse errors are 400-class
+    client errors at submit, tightens clamp element-wise, and non-tree
+    deployments ignore the tag (nothing to narrow)."""
+    from seldon_core_tpu.core.errors import APIException
+    from seldon_core_tpu.core.message import Meta
+
+    params, draft = _params(), _draft()
+    ids = _prompts(2, seed=41)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(params, n_slots=2, draft_params=draft, spec_tree="2,2,1")
+    out = sched.request_params_from_meta(Meta(tags={"spec_tree": "1,1"}))
+    assert out["spec_tree"] == "1,1"
+    with pytest.raises(APIException, match="spec_tree"):
+        await sched.submit(ids[0], spec_tree="4,nope")
+    # "0" is the documented per-request opt-out: the request rides plain
+    # rounds (no tree dispatches for an all-opted-out workload) and still
+    # matches the oracle; a mid-string 0 truncates the depth
+    before = sched.stat_spec_dispatches
+    np.testing.assert_array_equal(await sched.submit(ids[0], spec_tree="0"), oracle[0])
+    assert sched.stat_spec_dispatches == before
+    np.testing.assert_array_equal(
+        await sched.submit(ids[1], spec_tree="2,0,5"), oracle[1]
+    )
+    await sched.close()
+
+
+# --------------------------------------------- composition: tp, int8, prefix
+
+
+async def test_tree_tp2_int8_prefix_warm_agreement():
+    """Composition: tree speculation at tp=2 over an int8 paged pool with
+    a warm prefix cache emits exactly the plain int8 scheduler's tokens,
+    cold AND warm waves, with zero recompiles — the tree axis replicates
+    over the mesh (no new collective) and the verify round-trips fresh
+    K/V through the same per-page-row quantizer the commit applies."""
+    params = init_decoder(
+        seed=3, vocab=VOCAB, hidden=256, layers=2, ffn=512, max_len=64,
+        resid_scale=0.1,
+    )
+    draft = init_decoder(
+        seed=3, vocab=VOCAB, hidden=256, layers=1, ffn=512, max_len=64,
+        resid_scale=0.1,
+    )
+    ids = _shared_prompts(4, shared=5, seed=17)
+    kw = dict(
+        n_slots=2, mesh_axes={"tp": 2}, kv_page_size=4, kv_dtype="int8",
+        prefix_slots=4,
+    )
+    plain = _scheduler(params, **kw)
+    plain_cold = await asyncio.gather(*(plain.submit(row) for row in ids[:2]))
+    plain_warm = await asyncio.gather(*(plain.submit(row) for row in ids[2:]))
+    await plain.close()
+    sched = _scheduler(params, draft_params=draft, spec_tree="2,1", **kw)
+    assert sched.tp == 2 and sched.spec_tree is not None
+    cold = await asyncio.gather(*(sched.submit(row) for row in ids[:2]))
+    warm = await asyncio.gather(*(sched.submit(row) for row in ids[2:]))
+    for a, b in zip(plain_cold + plain_warm, cold + warm):
+        np.testing.assert_array_equal(a, b)
+    assert sched.stat_spec_dispatches > 0
+    assert sched.stat_prefix_hits > 0  # the warm wave genuinely hit
+    assert sched.recompiles_since_warmup() == 0
+    assert sched.shard_audit()["components_audited"] >= 4
+    await sched.close()
+
+
+# ------------------------------------------------------------- adaptive k
+
+
+def test_spec_adapt_unit():
+    """The controller in isolation: floor 0 pins the ceiling; the depth
+    never exceeds the ceiling at ANY rate; a sub-floor rate degrades to
+    plain (0) with a periodic depth-1 probe; good probes recover."""
+    a = _SpecAdapt(0.0, 4)
+    assert a.depth() == 4  # disabled -> fixed shape
+    a = _SpecAdapt(0.5, 4, alpha=0.5, probe_every=3)
+    assert a.depth() == 4  # optimistic start
+    for _ in range(8):
+        a.update(0, 4)  # nothing accepted
+        assert a.depth() in (0, 1)  # plain, or the periodic probe
+    assert a.rate < 0.5 and a.probes >= 1
+    for _ in range(12):
+        a.update(4, 4)  # probe rounds fully accept
+    assert a.depth() == 4  # recovered to the ceiling
+    a.rate = 10.0  # adversarial estimate: still clamped
+    assert a.depth() <= 4
+
+
+async def test_adaptive_degrades_to_plain_under_low_accept_draft():
+    """A forced low-accept draft under an accept floor: the EWMA converges
+    below the floor within one generation and later traffic runs PLAIN
+    rounds (spec dispatches stop growing, modulo the periodic probe) —
+    while greedy output stays oracle-exact throughout."""
+    params, draft = _params(), _unrelated_draft()
+    ids = _prompts(6, seed=23)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(
+        params, n_slots=2, draft_params=draft, spec_tree="2,2,1",
+        spec_accept_floor=0.6,
+    )
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids[:2]))
+    for row, out in zip(oracle, outs):
+        np.testing.assert_array_equal(out, row)
+    assert sched._adapt.rate < 0.6  # the estimate converged sub-floor
+    before = sched.stat_spec_dispatches
+    steps_before = sched.stat_steps
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids[2:]))
+    for row, out in zip(oracle[2:], outs):
+        np.testing.assert_array_equal(out, row)
+    spec_growth = sched.stat_spec_dispatches - before
+    rounds = sched.stat_steps - steps_before
+    # degraded: almost every round was plain (probes are the only spec)
+    assert spec_growth <= max(1, rounds // 4), (spec_growth, rounds)
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_validation_tree_knobs():
+    from seldon_core_tpu.graph.defaulting import default_deployment
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+    from seldon_core_tpu.graph.validation import ValidationError, validate_deployment
+
+    def _dep(**tpu):
+        return default_deployment(
+            SeldonDeployment.from_dict(
+                {
+                    "spec": {
+                        "name": "d",
+                        "predictors": [
+                            {
+                                "name": "p",
+                                "graph": {
+                                    "name": "m",
+                                    "type": "MODEL",
+                                    "implementation": "JAX_MODEL",
+                                },
+                                "tpu": tpu,
+                            }
+                        ],
+                    }
+                }
+            )
+        )
+
+    ok = dict(decode_slots=4, decode_draft_model="zoo://draft")
+    validate_deployment(_dep(decode_spec_tree="4,2,1", **ok))
+    validate_deployment(_dep(decode_spec_k=4, decode_spec_accept_floor=0.5, **ok))
+    # malformed / oversized trees are CR errors, not trace-time surprises
+    with pytest.raises(ValidationError, match="not an integer"):
+        validate_deployment(_dep(decode_spec_tree="4,x", **ok))
+    with pytest.raises(ValidationError, match="caps at"):
+        validate_deployment(_dep(decode_spec_tree="9,9", **ok))  # 90 nodes
+    with pytest.raises(ValidationError, match="widened-verify"):
+        validate_deployment(_dep(decode_spec_k=MAX_TREE_NODES + 1, **ok))
+    # speculation knobs need the scheduler and a draft
+    with pytest.raises(ValidationError, match="need decode_slots"):
+        validate_deployment(
+            _dep(decode_spec_tree="2,1", decode_draft_model="zoo://draft")
+        )
+    with pytest.raises(ValidationError, match="need decode_draft_model"):
+        validate_deployment(_dep(decode_slots=4, decode_spec_tree="2,1"))
+    # the adaptive floor: range-checked, and meaningless without spec
+    with pytest.raises(ValidationError, match="must be in"):
+        validate_deployment(
+            _dep(decode_spec_k=2, decode_spec_accept_floor=1.5, **ok)
+        )
+    with pytest.raises(ValidationError, match="nothing to adapt"):
+        validate_deployment(_dep(decode_slots=4, decode_spec_accept_floor=0.5))
+
+
+# ---------------------------------------------------------- serving wiring
+
+
+async def test_serving_tree_wiring_and_warn_disable(caplog):
+    """TpuSpec decode_spec_tree -> scheduler_for_executor: a servable
+    config builds a tree scheduler whose buffered response matches the
+    fused zoo apply; an unservable tree (past the node cap) or a tree
+    without a draft logs a warning and degrades instead of failing boot."""
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.graph.spec import PredictorSpec
+    from seldon_core_tpu.models.zoo import get_model
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    def _predictor(**tpu_extra):
+        return PredictorSpec.model_validate(
+            {
+                "name": "p",
+                "graph": {
+                    "name": "gpt",
+                    "type": "MODEL",
+                    "implementation": "JAX_MODEL",
+                    "parameters": [
+                        {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                        {"name": "seq", "value": str(SEQ), "type": "INT"},
+                        {"name": "max_new_tokens", "value": "6", "type": "INT"},
+                        {"name": "vocab", "value": str(VOCAB), "type": "INT"},
+                    ],
+                },
+                "tpu": {
+                    "max_batch": 4,
+                    "batch_buckets": [4],
+                    "decode_slots": 2,
+                    **tpu_extra,
+                },
+            }
+        )
+
+    server = PredictorServer(
+        _predictor(
+            decode_draft_model="zoo://draft?layers=1&resid_scale=0.1",
+            decode_spec_tree="2,2,1",
+        ),
+        deployment_name="d",
+    )
+    sched = server.decode_scheduler
+    assert sched is not None and sched.spec_tree is not None
+    assert sched.spec_tree.branching == (2, 2, 1)
+    server.warmup()
+    try:
+        ids = _prompts(2, seed=7)
+        out = await server.service.predict(SeldonMessage.from_array(ids))
+        ms = get_model("tiny_gpt", seq=SEQ, max_new_tokens=6, vocab=VOCAB)
+        oracle = np.asarray(ms.apply_fn(ms.params, jnp.asarray(ids)))
+        np.testing.assert_array_equal(np.asarray(out.array).astype(np.int32), oracle)
+        assert sched.stat_spec_dispatches > 0
+        assert sched.recompiles_since_warmup() == 0
+    finally:
+        await sched.close()
+
+    with caplog.at_level(logging.WARNING, "seldon_core_tpu.serving.decode_scheduler"):
+        server2 = PredictorServer(
+            _predictor(
+                decode_draft_model="zoo://draft?layers=1",
+                decode_spec_tree="9,9",  # 90 nodes > MAX_TREE_NODES
+            ),
+            deployment_name="d2",
+        )
+    sched2 = server2.decode_scheduler
+    assert sched2 is not None and sched2.spec_tree is None
+    assert not sched2.spec_enabled
+    assert any("unservable" in r.message for r in caplog.records)
+    await sched2.close()
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, "seldon_core_tpu.serving.decode_scheduler"):
+        server3 = PredictorServer(
+            _predictor(decode_spec_tree="2,1"), deployment_name="d3"
+        )
+    sched3 = server3.decode_scheduler
+    assert sched3 is not None and not sched3.spec_enabled
+    assert any("decode_draft_model" in r.message for r in caplog.records)
+    await sched3.close()
+
+
+# --------------------------------------------------------------- metrics
+
+
+async def test_tree_metrics_mode_label_and_histograms():
+    """Observability contract: spec dispatch metrics carry mode=tree, and
+    every generating slot's ride records (allowed nodes, accepted path
+    depth) into the tree histograms; chain deployments keep mode=chain."""
+    from seldon_core_tpu.metrics import NullMetrics
+
+    spec_calls: list[str] = []
+    tree_calls: list[tuple[int, int]] = []
+
+    class _Rec(NullMetrics):
+        def decode_spec(self, deployment, proposed, accepted, emitted, mode="chain"):
+            spec_calls.append(mode)
+
+        def decode_spec_tree(self, deployment, nodes, path_len):
+            tree_calls.append((nodes, path_len))
+
+    params, draft = _params(), _draft()
+    ids = _prompts(2, seed=3)
+    sched = _scheduler(
+        params, n_slots=2, draft_params=draft, spec_tree="2,1",
+        metrics=_Rec(), deployment_name="d",
+    )
+    await asyncio.gather(*(sched.submit(row) for row in ids))
+    assert spec_calls and all(m == "tree" for m in spec_calls)
+    # budget-edge slots ride with a 0 node allowance; real rides record
+    # the allowed node count and the accepted path depth
+    assert tree_calls and any(n > 0 for n, _ in tree_calls)
+    assert any(p > 0 for _, p in tree_calls)  # paths genuinely accepted
+    assert all(p <= n for n, p in tree_calls)  # never past the allowance
+    assert all(p <= 2 for _, p in tree_calls)  # never past the tree depth
+    await sched.close()
+
+    spec_calls.clear()
+    chain = _scheduler(
+        params, n_slots=2, draft_params=draft, spec_k=2,
+        metrics=_Rec(), deployment_name="d",
+    )
+    await chain.submit(ids[0])
+    assert spec_calls and all(m == "chain" for m in spec_calls)
+    await chain.close()
+
+
+# ------------------------------------------------- distillation round-trip
+
+
+def test_distill_and_zoo_distilled_roundtrip(tmp_path):
+    """The distillation recipe end-to-end at toy scale: a few KL steps
+    produce a checkpoint the zoo's ``distilled=`` variant loads back
+    bit-exact; a geometry-mismatched checkpoint is refused with the
+    architecture-assertion error, not silently merged."""
+    from seldon_core_tpu.models.zoo import get_model
+    from seldon_core_tpu.training.distill_draft import (
+        distill, flatten_params, load_draft_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "d.npz")
+    geom = dict(vocab=64, hidden=32, ffn=64, max_len=24)
+    report = distill(
+        seed=0, layers=2, draft_layers=1, seq=4, horizon=12, batch=4, steps=4,
+        eval_prompts=2, log_every=0, out=ckpt, **geom,
+    )
+    for key in ("accept_proxy_before", "accept_proxy_after", "final_kl"):
+        assert key in report
+    ms = get_model(
+        "draft", seed=0, layers=1, distilled=ckpt, seq=4, max_new_tokens=4,
+        **geom,
+    )
+    flat_ckpt = flatten_params(load_draft_checkpoint(ckpt, ms.params))
+    for k, v in flatten_params(ms.params).items():
+        np.testing.assert_array_equal(np.asarray(v), flat_ckpt[k])
+    # wrong geometry: the loader is an architecture assertion
+    other = get_model("draft", seed=0, layers=1, vocab=64, hidden=16, ffn=32,
+                      max_len=24, seq=4, max_new_tokens=4)
+    with pytest.raises(ValueError, match="different geometry"):
+        load_draft_checkpoint(ckpt, other.params)
