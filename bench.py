@@ -38,6 +38,11 @@ What is measured:
   dispatch_rtt_p50_ms + transfer_mb_s + a one-user jitter probe whose
   p99/p50 gap is the tunnel's own tail). Compare on-chip p50/p95 against
   floor_rtt_ms; a real TPU host pays microseconds.
+
+Regression gating: ``python bench.py --compare BENCH_rNN.json`` diffs this
+run's compact record against a prior round's and exits nonzero on
+configurable tolerance breaches (``--tolerance 0.25``); ``--record X.json``
+compares two records without running (see run_compare).
 """
 
 from __future__ import annotations
@@ -1150,6 +1155,18 @@ def serving_gen_cpu(
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
             "steps": sched.stat_steps,
         }
+        # gen.loop_*: the flight recorder's own read of the same run —
+        # per-round device-busy vs host-bubble split, occupancy as the
+        # frames saw it, blocked-admission rounds, and the recorder's
+        # measured per-round append cost (the <10 µs budget PARITY cites)
+        fa = sched.flight.aggregate()
+        out["loop"] = {
+            "frames": fa["rounds"],
+            "bubble_fraction": fa["bubble_fraction"],
+            "occupancy": fa["occupancy_mean"],
+            "blocked_rounds": sum(fa["blocked_rounds"].values()),
+            "record_us": sched.flight.measure_overhead(),
+        }
         if spec:
             out["accept_rate"] = round(
                 sched.stat_spec_accepted / max(sched.stat_spec_proposed, 1), 3
@@ -2003,7 +2020,7 @@ def compact_record(full: dict) -> dict:
     pallas-vs-blockwise, MoE, BERT MFU, the generative-tier scheduler-vs-
     scan leg (tokens/s, TTFT, inter-token, occupancy), floors."""
     c = {k: full[k] for k in ("metric", "value", "unit", "vs_baseline") if k in full}
-    c["legend"] = "[preds/s,p50_ms,p99_ms,errs]"
+    c["legend"] = "[pps,p50,p99,errs]"
     srv = full.get("serving") or {}
     s: dict = {}
     for key, short in (
@@ -2084,11 +2101,25 @@ def compact_record(full: dict) -> dict:
             "ttft_p50": gs.get("ttft_p50_ms"),
             "ttft_p99": gs.get("ttft_p99_ms"),
             "itl_p99": gs.get("inter_token_p99_ms"),
-            "scan_lat_p50": gn.get("ttft_p50_ms"),
+            "scan_p50": gn.get("ttft_p50_ms"),
             "occ": gs.get("slot_occupancy_mean"),
             "recompiles": gs.get("recompiles_after_warmup"),
             "slots": (gen.get("scenario") or {}).get("n_slots"),
         }
+        lp = gs.get("loop") or {}
+        if lp:
+            # flight-recorder sub-leg, packed [bubble_fraction, occupancy,
+            # record_us] to respect the byte budget (full names in the
+            # detail record; record_us is the measured per-round append
+            # cost PARITY cites)
+            def _r(v, nd):
+                return round(v, nd) if isinstance(v, (int, float)) else v
+
+            c["gen"]["loop"] = [
+                _r(lp.get("bubble_fraction"), 3),
+                _r(lp.get("occupancy"), 3),
+                _r(lp.get("record_us"), 1),
+            ]
         if gp:
             # speculative leg: delivered tokens/s, accept rate, and the
             # realized tokens-per-target-dispatch amortization
@@ -2129,9 +2160,9 @@ def compact_record(full: dict) -> dict:
             c["gen"]["prefix_hit_rate"] = gm.get("hit_rate")
             c["gen"]["prefix_saved_tok"] = gm.get("prefill_tokens_saved")
             c["gen"]["prefix_tok_s"] = gm.get("tokens_per_sec")
-            c["gen"]["prefix_tok_s_chunked"] = gc.get("tokens_per_sec")
+            c["gen"]["prefix_tok_s_ck"] = gc.get("tokens_per_sec")
             c["gen"]["prefix_itl_p99"] = gm.get("inter_token_p99_ms")
-            c["gen"]["prefix_itl_p99_chunked"] = gc.get("inter_token_p99_ms")
+            c["gen"]["prefix_itl_p99_ck"] = gc.get("inter_token_p99_ms")
         gpp = gen.get("paged") or {}
         if gpp:
             gf = gpp.get("fp") or {}
@@ -2203,6 +2234,139 @@ def compact_record(full: dict) -> dict:
     return c
 
 
+# ------------------------------------------------------- regression gating
+#
+# ``python bench.py --compare BENCH_r05.json`` runs the bench, then diffs
+# this run's compact record against the prior round's and exits nonzero on
+# tolerance breaches — the perf trajectory gets teeth instead of relying on
+# a human eyeballing two JSON lines. ``--record NEW.json`` skips the run
+# and compares two records directly (what CI and the guard test use);
+# ``--tolerance 0.25`` sets the fractional budget (default 25% — wide
+# enough for shared-host CPU noise, tight enough to catch a real cliff).
+
+
+def load_record(path: str) -> dict:
+    """A compact bench record from disk: either the raw compact line
+    (BENCH_DETAIL-style dict with "value") or the driver's BENCH_rNN.json
+    wrapper ({"n", "cmd", "rc", "tail", "parsed"})."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        return d["parsed"]
+    if isinstance(d, dict) and "parsed" in d and not isinstance(d["parsed"], dict):
+        raise ValueError(
+            f"{path}: driver record carries parsed={d['parsed']!r} "
+            "(truncated round) — nothing to compare against"
+        )
+    return d
+
+
+def _compare_pairs(rec: dict) -> dict:
+    """Flatten a compact record into {metric_key: (value, direction)}.
+    direction: "+" higher-is-better, "-" lower-is-better, "0" hard count
+    (any increase is a regression). Only the headline figures the docs
+    cite are gated — scenario/config fields are not metrics."""
+    out: dict = {}
+
+    def put(key: str, val, d: str) -> None:
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[key] = (float(val), d)
+
+    put("kernel.preds_s", rec.get("value"), "+")
+    for name, row in (rec.get("s") or {}).items():
+        if isinstance(row, list) and len(row) >= 3:
+            put(f"s.{name}.preds_s", row[0], "+")
+            put(f"s.{name}.p99_ms", row[2], "-")
+    gen = rec.get("gen") or {}
+    for k, d in (
+        ("tok_s", "+"), ("tok_s_scan", "+"), ("speedup", "+"),
+        ("spec_tok_s", "+"), ("spec_speedup", "+"),
+        ("ttft_p50", "-"), ("ttft_p99", "-"), ("itl_p99", "-"),
+        ("occ", "+"), ("prefix_tok_s", "+"), ("prefix_ttft_speedup", "+"),
+        ("prefix_hit_rate", "+"), ("paged_tok_s", "+"),
+        ("paged_slots_vs_flat", "+"), ("tree_speedup", "+"),
+        ("tp_speedup", "+"), ("recompiles", "0"),
+    ):
+        put(f"gen.{k}", gen.get(k), d)
+    lp = gen.get("loop")
+    if isinstance(lp, list) and len(lp) >= 2:
+        # packed flight sub-leg: [bubble_fraction, occupancy, record_us].
+        # record_us is deliberately NOT gated — a ~3 µs wall-clock
+        # measurement routinely wobbles past any sane tolerance on shared
+        # hosts; it's recorded for PARITY, not for the gate.
+        put("gen.loop_bubble", lp[0], "-")
+        put("gen.loop_occ", lp[1], "+")
+    put("bert_tflops", rec.get("bert_tflops"), "+")
+    put("bert_mfu_pct", rec.get("bert_mfu_pct"), "+")
+    fusion = rec.get("fusion_cpu") or {}
+    put("fusion_cpu.speedup", fusion.get("speedup"), "+")
+    mt = rec.get("mt") or {}
+    put("mt.agg", mt.get("agg"), "+")
+    put("mt.homo_agg", mt.get("homo_agg"), "+")
+    return out
+
+
+def compare_records(
+    base: dict, new: dict, tolerance: float = 0.25
+) -> tuple[list, list]:
+    """Diff two compact records: (failures, report_lines). A metric fails
+    when it regressed past ``tolerance`` in its bad direction (improvement
+    is never a failure); metrics missing on either side are reported and
+    skipped, so records from different configurations still compare on
+    their intersection."""
+    pairs_b = _compare_pairs(base)
+    pairs_n = _compare_pairs(new)
+    failures: list[str] = []
+    lines: list[str] = []
+    for key in sorted(pairs_b):
+        if key not in pairs_n:
+            lines.append(f"  ~ {key}: missing in new record (skipped)")
+            continue
+        b, d = pairs_b[key]
+        n, _ = pairs_n[key]
+        if d == "0":
+            bad = n > b
+            delta = n - b
+            desc = f"{b:g} -> {n:g}"
+        elif b == 0:
+            lines.append(f"  ~ {key}: base is 0 (skipped)")
+            continue
+        else:
+            delta = (n - b) / b
+            bad = delta < -tolerance if d == "+" else delta > tolerance
+            desc = f"{b:g} -> {n:g} ({delta:+.1%})"
+        if bad:
+            failures.append(key)
+            lines.append(f"  ! {key}: {desc}  REGRESSED")
+        else:
+            lines.append(f"  . {key}: {desc}")
+    for key in sorted(set(pairs_n) - set(pairs_b)):
+        lines.append(f"  + {key}: new metric (not gated)")
+    return failures, lines
+
+
+def run_compare(base_path: str, new_record: dict, tolerance: float = 0.25) -> int:
+    """Compare + report (stderr — stdout stays the driver's compact line);
+    exit code 1 on any tolerance breach."""
+    base = load_record(base_path)
+    failures, lines = compare_records(base, new_record, tolerance)
+    print(
+        f"bench --compare vs {base_path} (tolerance {tolerance:.0%}):",
+        file=sys.stderr,
+    )
+    for line in lines:
+        print(line, file=sys.stderr)
+    if failures:
+        print(
+            f"REGRESSED: {len(failures)} metric(s) breached tolerance: "
+            + ", ".join(failures),
+            file=sys.stderr,
+        )
+        return 1
+    print("compare clean", file=sys.stderr)
+    return 0
+
+
 def emit(full: dict) -> None:
     """Full record -> stderr + BENCH_DETAIL.json; compact line -> stdout
     (the driver's artifact of record, LAST line, < 2,000-byte tail)."""
@@ -2218,6 +2382,37 @@ def emit(full: dict) -> None:
 
 
 def main() -> None:
+    argv = sys.argv[1:]
+    compare_to = None
+    tolerance = 0.25
+    if "--compare" in argv:
+        try:
+            compare_to = argv[argv.index("--compare") + 1]
+        except IndexError:
+            print("--compare needs a record path", file=sys.stderr)
+            sys.exit(2)
+        if "--tolerance" in argv:
+            try:
+                tolerance = float(argv[argv.index("--tolerance") + 1])
+            except (IndexError, ValueError):
+                print("--tolerance needs a number", file=sys.stderr)
+                sys.exit(2)
+        try:
+            # fail FAST on a bad baseline: a typo'd path must not cost a
+            # full bench run before the compare step notices
+            load_record(compare_to)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"--compare: cannot load {compare_to}: {e}", file=sys.stderr)
+            sys.exit(2)
+        if "--record" in argv:
+            # pure record-vs-record diff (CI / tests): no bench run
+            try:
+                new = load_record(argv[argv.index("--record") + 1])
+            except (IndexError, OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"--record: cannot load: {e}", file=sys.stderr)
+                sys.exit(2)
+            sys.exit(run_compare(compare_to, new, tolerance))
+
     if "--gen-tp-only" in sys.argv:
         # same sitecustomize caveat as --serving-stack-only: pin the CPU
         # backend via config.update before first device access; the forced
@@ -2392,6 +2587,10 @@ def main() -> None:
     if floors:
         out["floors"] = floors
     emit(out)
+    if compare_to is not None:
+        # regression gate AFTER the record is emitted: the compact line is
+        # the artifact either way; the exit code is the verdict
+        sys.exit(run_compare(compare_to, compact_record(out), tolerance))
 
 
 if __name__ == "__main__":

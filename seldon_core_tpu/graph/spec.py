@@ -358,6 +358,16 @@ class TpuSpec(_Spec):
     # greedy output stays token-identical to the single-device scheduler
     # at any width. {} (default) keeps single-device dispatch.
     decode_mesh_axes: dict[str, int] = Field(default_factory=dict)
+    # Decode-loop SLO targets (serving/decode_scheduler.py + telemetry/
+    # flight.py): per-request TTFT / inter-token-latency budgets in ms the
+    # goodput/attainment telemetry is judged against. 0 (default) = not
+    # configured — no per-token comparisons run. Breaches feed the
+    # seldon_tpu_decode_slo_attainment_total counter (with a flight-ring
+    # dump exemplar) and flip the request's meta.tags.slo verdict; they do
+    # NOT fail the request (deadline_ms is the enforcement knob — these
+    # are the observation ones).
+    decode_slo_ttft_ms: float = 0.0
+    decode_slo_itl_ms: float = 0.0
     # True: binData that parses as npy decodes to the tensor arm at ingress
     # (the binary tensor fast path), including base64 binData inside the
     # JSON envelope. False: binData is NEVER sniffed — opaque passthrough

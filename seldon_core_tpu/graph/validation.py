@@ -89,9 +89,19 @@ def validate_deployment(dep: SeldonDeployment) -> None:
             "decode_prefill_chunk",
             "decode_kv_page_size",
             "decode_kv_pages",
+            "decode_slo_ttft_ms",
+            "decode_slo_itl_ms",
         ):
             if getattr(pred.tpu, knob) < 0:
                 problems.append(f"predictor '{pred.name}' {knob} must be >= 0")
+        if (
+            pred.tpu.decode_slo_ttft_ms > 0 or pred.tpu.decode_slo_itl_ms > 0
+        ) and pred.tpu.decode_slots <= 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_slo_ttft_ms/decode_slo_itl_ms "
+                "need decode_slots > 0 (the SLO attainment telemetry lives in "
+                "the decode scheduler)"
+            )
         if pred.tpu.decode_kv_dtype not in ("", "int8"):
             problems.append(
                 f"predictor '{pred.name}' decode_kv_dtype "

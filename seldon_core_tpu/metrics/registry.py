@@ -146,6 +146,34 @@ class NullMetrics:
         HBM. tp=1 on single-device deployments."""
         pass
 
+    # decode-loop flight telemetry (telemetry/flight.py + the scheduler's
+    # per-round commit point): round-level device-busy/host-gap split,
+    # the bubble-fraction gauge, goodput tokens, and SLO attainment
+    def decode_round(self, deployment: str, busy_s: float, gap_s: float) -> None:
+        """One scheduler round: ``busy_s`` device-dispatch wall time,
+        ``gap_s`` the host bubble around it (admission, emission, python)."""
+        pass
+
+    def decode_bubble(self, deployment: str, fraction: float) -> None:
+        """Cumulative host-bubble fraction gap/(busy+gap) — refreshed every
+        ~64 rounds off the flight recorder's O(1) totals."""
+        pass
+
+    def decode_goodput(self, deployment: str, tokens: int, met: bool) -> None:
+        """One retirement: ``tokens`` delivered by a request that met
+        (``met``) or breached its deadline budget — goodput counts only
+        the met side."""
+        pass
+
+    def decode_slo(
+        self, deployment: str, kind: str, ok: bool, trace_id: str | None = None
+    ) -> None:
+        """One SLO attainment sample (``kind`` = ttft | itl | deadline).
+        On a breach, ``trace_id`` names the flight-ring auto-dump retained
+        for it; real recorders attach it as an exemplar so the breach
+        counter links to the rounds surrounding the breach."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -393,6 +421,43 @@ class Metrics(NullMetrics):
             ["deployment_name", "tp"],
             registry=registry,
         )
+        # decode-loop flight telemetry: where each round's wall time went
+        # (device busy vs host bubble), the cumulative bubble fraction, and
+        # the goodput/SLO-attainment contract the ROADMAP's SLO-tiered
+        # scheduling + reward-driven routing consume
+        self._decode_round_busy = Histogram(
+            "seldon_tpu_decode_round_device_seconds",
+            "Device-dispatch wall time per decode scheduler round",
+            ["deployment_name"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._decode_round_gap = Histogram(
+            "seldon_tpu_decode_round_host_gap_seconds",
+            "Host bubble per decode scheduler round (wall minus device busy)",
+            ["deployment_name"],
+            registry=registry,
+            buckets=_LATENCY_BUCKETS,
+        )
+        self._decode_bubble = Gauge(
+            "seldon_tpu_decode_bubble_fraction",
+            "Cumulative host-bubble fraction of decode round wall time",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._decode_goodput = Counter(
+            "seldon_tpu_decode_goodput_tokens_total",
+            "Generated tokens by whether the request met its deadline budget",
+            ["deployment_name", "outcome"],
+            registry=registry,
+        )
+        self._decode_slo = Counter(
+            "seldon_tpu_decode_slo_attainment_total",
+            "Decode SLO attainment samples (kind=ttft|itl|deadline); breach "
+            "samples carry the flight-dump trace id as an exemplar",
+            ["deployment_name", "kind", "outcome"],
+            registry=registry,
+        )
         self._decode_ttft_split = Histogram(
             "seldon_tpu_decode_ttft_split_seconds",
             "TTFT split by admission path (warm = prefix hit, cold = full prefill)",
@@ -532,6 +597,32 @@ class Metrics(NullMetrics):
 
     def decode_kv_per_device(self, deployment, pages, tp):
         self._kv_per_device.labels(deployment, str(tp)).set(pages)
+
+    def decode_round(self, deployment, busy_s, gap_s):
+        self._decode_round_busy.labels(deployment).observe(busy_s)
+        self._decode_round_gap.labels(deployment).observe(gap_s)
+
+    def decode_bubble(self, deployment, fraction):
+        self._decode_bubble.labels(deployment).set(fraction)
+
+    def decode_goodput(self, deployment, tokens, met):
+        if tokens > 0:
+            self._decode_goodput.labels(
+                deployment, "met" if met else "breached"
+            ).inc(tokens)
+
+    def decode_slo(self, deployment, kind, ok, trace_id=None):
+        c = self._decode_slo.labels(deployment, kind, "ok" if ok else "breach")
+        if trace_id and not ok:
+            # exemplar: the breach-adjacent flight-ring dump's trace id —
+            # an OpenMetrics scrape links the breach straight to
+            # GET /traces/{id} (same mechanism as the ingress histogram)
+            try:
+                c.inc(exemplar={"trace_id": trace_id})
+                return
+            except (TypeError, ValueError):  # older client / invalid exemplar
+                pass
+        c.inc()
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

@@ -70,9 +70,21 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
     # device profiling (SURVEY §5.1: jax.profiler hooks): capture an XLA/
     # device trace viewable in XProf/TensorBoard. Admin surface only — the
     # capture has process-wide overhead, so it never rides the data plane.
-    prof_state = {"dir": None}
+    # ?duration_ms= arms a background auto-stop so an operator cannot leave
+    # a device trace running indefinitely; both responses name the resolved
+    # output dir.
+    prof_state = {"dir": None, "timer": None}
+
+    def _cancel_auto_stop() -> None:
+        timer = prof_state["timer"]
+        prof_state["timer"] = None
+        if timer is not None:
+            timer.cancel()
 
     async def profiler_start(request: web.Request) -> web.Response:
+        import asyncio
+        import os
+
         import jax
 
         if prof_state["dir"] is not None:
@@ -81,13 +93,38 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
             )
         out_dir = request.query.get("dir", "/tmp/seldon-tpu-profile")
         try:
+            duration_ms = float(request.query.get("duration_ms", 0) or 0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "duration_ms must be a number"}, status=400
+            )
+        try:
             jax.profiler.start_trace(out_dir)
         except Exception as e:  # noqa: BLE001 - surface profiler errors as JSON
             return web.json_response({"error": str(e)}, status=500)
         prof_state["dir"] = out_dir
-        return web.json_response({"tracing": out_dir})
+        resp = {"tracing": out_dir, "dir": os.path.abspath(out_dir)}
+        if duration_ms > 0:
+            async def _auto_stop() -> None:
+                await asyncio.sleep(duration_ms / 1e3)
+                # the guard re-checks the state: a manual stop (or a newer
+                # start) in the window wins and this timer is a no-op
+                if prof_state["dir"] != out_dir:
+                    return
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 - nothing to report it to
+                    pass
+                prof_state["dir"] = None
+                prof_state["timer"] = None
+
+            prof_state["timer"] = asyncio.ensure_future(_auto_stop())
+            resp["auto_stop_ms"] = duration_ms
+        return web.json_response(resp)
 
     async def profiler_stop(request: web.Request) -> web.Response:
+        import os
+
         import jax
 
         if prof_state["dir"] is None:
@@ -101,8 +138,13 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
             # 409s on retry and 500s on every future start
             return web.json_response({"error": str(e)}, status=500)
         prof_state["dir"] = None
+        _cancel_auto_stop()
         return web.json_response(
-            {"written": out_dir, "view": "xprof / tensorboard --logdir " + out_dir}
+            {
+                "written": out_dir,
+                "dir": os.path.abspath(out_dir),
+                "view": "xprof / tensorboard --logdir " + out_dir,
+            }
         )
 
     # distributed-tracing read-out (telemetry/): the process-global trace
@@ -136,6 +178,35 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
             )
         return web.json_response(rec.to_dict())
 
+    # decode-loop flight recorder read-out (telemetry/flight.py): every
+    # decode scheduler in the process registers its recorder, so these two
+    # serve live data DURING a bench/soak run. GET /decode/flight returns
+    # recent frames + windowed aggregates (?n= frames, ?window= aggregate
+    # span, ?name= one deployment); GET /decode/health the O(1) per-
+    # deployment health summaries (occupancy, bubble fraction, goodput,
+    # SLO attainment, blocked-admission causes).
+    async def decode_flight(request: web.Request) -> web.Response:
+        from seldon_core_tpu.telemetry import flight as flight_mod
+
+        def _int(key: str, default: int) -> int:
+            try:
+                return int(request.query.get(key, default))
+            except (TypeError, ValueError):
+                return default
+
+        return web.json_response(
+            flight_mod.flight_report(
+                n=_int("n", 64),
+                name=request.query.get("name"),
+                window=_int("window", 0),
+            )
+        )
+
+    async def decode_health(request: web.Request) -> web.Response:
+        from seldon_core_tpu.telemetry import flight as flight_mod
+
+        return web.json_response(flight_mod.health_report())
+
     app.router.add_post(BASE, apply_dep)
     app.router.add_put(BASE, apply_dep)
     app.router.add_get(BASE, list_deps)
@@ -143,5 +214,7 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
     app.router.add_delete(BASE + "/{name}", delete_dep)
     app.router.add_get("/traces", list_traces)
     app.router.add_get("/traces/{id}", get_trace)
+    app.router.add_get("/decode/flight", decode_flight)
+    app.router.add_get("/decode/health", decode_health)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)

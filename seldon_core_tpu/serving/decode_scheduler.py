@@ -59,6 +59,17 @@ scheduling over a vLLM-style PAGED KV pool into the stack:
   tokens, so stale entries sit beyond every later attention mask until
   the next consumed token overwrites them.
 
+- Flight recorder (telemetry/flight.py): every scheduler round commits ONE
+  compact frame — mode, slot/queue occupancy, admissions/retirements and
+  the blocked cause, tokens/accepted/effective depth, device-busy split per
+  fused program family vs host bubble, and the page pool's state — at the
+  single ``_commit_round`` point, into a fixed ring read out by
+  ``GET /decode/flight`` / ``GET /decode/health``. Goodput (tokens to
+  requests that met their deadline budget) and TTFT/ITL SLO attainment
+  (``tpu.decode_slo_{ttft,itl}_ms``) ride the same substrate; breaches
+  auto-dump the ring into the span store with a metric exemplar linking
+  back. ``ENGINE_FLIGHT=off`` kills it.
+
 Equivalence contract: with greedy sampling the scheduler produces token-
 for-token the fused oracle's output for every sequence, regardless of when
 each sequence was admitted — speculative or not (acceptance keeps exactly
@@ -87,8 +98,19 @@ import jax.numpy as jnp
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.engine.resilience import current_deadline
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu import telemetry
+from seldon_core_tpu.telemetry.flight import (
+    F_CHUNK,
+    F_COPY,
+    F_DRAFT,
+    F_STEP,
+    F_VERIFY,
+    FlightFrame,
+    FlightRecorder,
+)
+from seldon_core_tpu.telemetry.flight import register as flight_register
 from seldon_core_tpu.models.decoder import (
     decoder_dims,
     draft_propose,
@@ -427,6 +449,7 @@ class _Seq:
         "deadline", "trace_ctxs", "gen_spans",
         "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
         "cache_prefix", "chunk_idx",
+        "slo_deadline", "slo_ok", "slo_sink",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, spec_k, on_token, future):
@@ -455,6 +478,13 @@ class _Seq:
         self.chunk_cap = 0  # per-round prefill token cap (0 = whole suffix)
         self.cache_prefix = 0  # meta.tags.cache_prefix capture hint
         self.chunk_idx = 0
+        # goodput/SLO attribution: the request's deadline budget (absolute
+        # perf_counter; 0 = none) captured from the DEADLINE contextvar at
+        # submit, whether every configured SLO held so far, and an optional
+        # callback execute_message uses to tag the response
+        self.slo_deadline = 0.0
+        self.slo_ok = True
+        self.slo_sink = None
         # the submitter's trace context(s), captured at submit: the decode
         # loop runs in its OWN task (no ambient request context), so spans
         # are attached to each sequence's originating trace explicitly
@@ -494,6 +524,8 @@ class DecodeScheduler:
         kv_pages: int = 0,
         kv_dtype: str = "",
         mesh_axes: dict | None = None,
+        slo_ttft_ms: float = 0.0,
+        slo_itl_ms: float = 0.0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         dtype=jnp.float32,
@@ -839,6 +871,29 @@ class DecodeScheduler:
         # not an admission counter)
         self.stat_admit_blocked_rounds = 0
 
+        # SLO targets the goodput/attainment telemetry is judged against
+        # (tpu.decode_slo_{ttft,itl}_ms; 0 = not configured). The deadline
+        # leg needs no knob: a request that arrived under a deadline budget
+        # (tpu.deadline_ms / meta.tags.deadline_ms) is judged against it at
+        # retirement, and its tokens count as goodput only when it held.
+        self.slo_ttft_s = max(0.0, float(slo_ttft_ms)) / 1e3
+        self.slo_itl_s = max(0.0, float(slo_itl_ms)) / 1e3
+        # decode-loop flight recorder (telemetry/flight.py): ONE compact
+        # frame per scheduler round into a bounded ring, committed at the
+        # single _commit_round point so per-round accounting cannot drift
+        # between the spec and plain paths. ENGINE_FLIGHT=off is the kill
+        # switch; the operator API serves the registry (GET /decode/flight,
+        # GET /decode/health).
+        self.flight = flight_register(
+            FlightRecorder(
+                n_slots=n_slots,
+                name=deployment_name or "decode",
+                slo_ttft_ms=float(slo_ttft_ms),
+                slo_itl_ms=float(slo_itl_ms),
+            )
+        )
+        self._round_reset()
+
     def _commit_kv(self, params, arrs):
         """Commit cache/pool buffers to their serving-steady sharding
         before any compile. On a decode mesh that is the tensor-parallel
@@ -992,6 +1047,7 @@ class DecodeScheduler:
         cache_prefix: int | None = None,
         prefill_chunk: int | None = None,
         on_token: OnToken | None = None,
+        _slo_sink=None,
     ) -> np.ndarray:
         """Generate for one prompt [seq_len]; resolves with the full int32
         sequence (prompt echoed, generated ids appended). ``on_token`` is
@@ -1022,6 +1078,14 @@ class DecodeScheduler:
         sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
         seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
+        # goodput attribution: a request submitted under a deadline budget
+        # (tpu.deadline_ms stamped into the DEADLINE contextvar by the
+        # service) is judged against it at retirement — its tokens count
+        # as goodput only if the budget held
+        d = current_deadline()
+        if d is not None:
+            seq.slo_deadline = time.perf_counter() + max(d.remaining(), 0.0)
+        seq.slo_sink = _slo_sink
         if self.spec_tree is not None:
             # per-request branching tighten (meta.tags.spec_tree): per
             # depth min(request, deployment), omitted depths -> 0 (depth
@@ -1073,26 +1137,49 @@ class DecodeScheduler:
         seq.tokens.append(tok)
         if len(seq.tokens) == 1:
             seq.t_first_token = now
-            self._metrics.decode_ttft(self._deployment, now - seq.t_enqueued)
+            ttft = now - seq.t_enqueued
+            self._metrics.decode_ttft(self._deployment, ttft)
             if self.prefix_enabled:
                 # cold-vs-warm TTFT split: the latency contract prefix
                 # reuse exists to move
                 self._metrics.decode_ttft_split(
                     self._deployment,
-                    now - seq.t_enqueued,
+                    ttft,
                     "warm" if seq.prefix_len > 0 else "cold",
+                )
+            if self.slo_ttft_s > 0:
+                # TTFT attainment against the deployment SLO; a breach
+                # auto-dumps the flight ring (rate-limited) and the dump's
+                # trace id rides the breach counter as an exemplar, so a
+                # dashboard breach links to the rounds surrounding it
+                ok = ttft <= self.slo_ttft_s
+                if not ok:
+                    seq.slo_ok = False
+                tid = self.flight.note_ttft(ok)
+                self._metrics.decode_slo(
+                    self._deployment, "ttft", ok, trace_id=tid or None
                 )
             # TTFT as a trace event on the sequence's generate span — the
             # latency contract a streaming client actually feels
             for sp in seq.gen_spans:
                 sp.add_event(
                     "first_token",
-                    {"ttft_ms": round((now - seq.t_enqueued) * 1e3, 3)},
+                    {"ttft_ms": round(ttft * 1e3, 3)},
                 )
         else:
-            self._metrics.decode_inter_token(self._deployment, now - seq.t_last_token)
+            itl = now - seq.t_last_token
+            self._metrics.decode_inter_token(self._deployment, itl)
+            if self.slo_itl_s > 0:
+                ok = itl <= self.slo_itl_s
+                if not ok:
+                    seq.slo_ok = False
+                tid = self.flight.note_itl(ok)
+                self._metrics.decode_slo(
+                    self._deployment, "itl", ok, trace_id=tid or None
+                )
         seq.t_last_token = now
         self.stat_tokens += 1
+        self._rb_tokens += 1
         if seq.on_token is not None:
             try:
                 seq.on_token(tok, len(seq.tokens) - 1)
@@ -1211,7 +1298,28 @@ class DecodeScheduler:
         self._slots[slot] = None
         self._free.append(slot)
         self.stat_retired += 1
+        self._rb_retired += 1
         if seq is not None:
+            # goodput: this request's tokens count as delivered-within-SLO
+            # only when its deadline budget (captured at submit) held at
+            # retirement — the signal an SLO-tiered scheduler or a
+            # reward-driven router consumes (ROADMAP)
+            met = True
+            if seq.slo_deadline:
+                met = time.perf_counter() <= seq.slo_deadline
+                if not met:
+                    seq.slo_ok = False
+                tid = self.flight.note_deadline(met)
+                self._metrics.decode_slo(
+                    self._deployment, "deadline", met, trace_id=tid or None
+                )
+            self.flight.note_goodput(len(seq.tokens), met)
+            self._metrics.decode_goodput(self._deployment, len(seq.tokens), met)
+            if seq.slo_sink is not None:
+                try:
+                    seq.slo_sink(seq.slo_ok)
+                except Exception:  # noqa: BLE001 - tagging must not kill the loop
+                    log.exception("slo_sink callback failed")
             if self.prefix_enabled:
                 # automatic capture policy: a request that declared its
                 # reusable span (cache_prefix) captured at prefill
@@ -1245,13 +1353,82 @@ class DecodeScheduler:
 
         return await asyncio.get_running_loop().run_in_executor(compute_pool(), fn)
 
+    # --------------------------------------------------- round flight frame
+    def _round_reset(self, t_ns: int | None = None) -> None:
+        """Reset the per-round flight accumulators (one set of plain int
+        attrs — written on the hot path, read only at _commit_round)."""
+        self._rb_busy = [0, 0, 0, 0, 0]  # ns per flight.FAMILIES entry
+        self._rb_t0 = t_ns if t_ns is not None else time.perf_counter_ns()
+        self._rb_admitted = 0
+        self._rb_retired = 0
+        self._rb_blocked = ""
+        self._rb_tokens = 0
+        self._rb_cow = 0
+        self._rb_accepted = 0
+        self._rb_proposed = 0
+        self._rb_depth = 0
+        self._rb_active = 0
+
+    async def _timed_call(self, family: int, fn):
+        """_device_call with the dispatch's wall time attributed to one
+        fused program family in the current round's flight frame."""
+        t0 = time.perf_counter_ns()
+        try:
+            return await self._device_call(fn)
+        finally:
+            self._rb_busy[family] += time.perf_counter_ns() - t0
+
+    def _commit_round(self, mode: str, *, step: bool) -> None:
+        """THE single per-round commit point: round stats, prometheus round
+        metrics, and the flight frame all land here. (stat_occupancy_sum
+        used to be updated separately on the spec and plain paths — one
+        commit point means the two accounting paths cannot drift.) ``step``
+        marks rounds that ran a decode/verify dispatch; chunk-only rounds
+        keep stat_steps' historical meaning (decode steps, not prefill
+        rounds) but still record a frame."""
+        active = self._rb_active if step else self.active
+        if step:
+            self.stat_steps += 1
+            self.stat_occupancy_sum += active / self.n_slots
+            self._metrics.decode_step(self._deployment, active, self.n_slots)
+        now_ns = time.perf_counter_ns()
+        busy = sum(self._rb_busy)
+        gap = max(now_ns - self._rb_t0 - busy, 0)
+        if self.flight.enabled:
+            # the kill switch removes the whole frame cost (pool snapshot,
+            # slot scan, frame object), not just the ring store
+            snap = self.pool.alloc.snapshot()
+            prefilling = sum(
+                1 for s in self._slots if s is not None and s.prefilling
+            )
+            self.flight.record(
+                FlightFrame(
+                    self.flight.rounds, now_ns, mode, active, prefilling,
+                    len(self._waiting), self._rb_admitted, self._rb_retired,
+                    self._rb_blocked, self._rb_tokens, self._rb_accepted,
+                    self._rb_proposed, self._rb_depth, tuple(self._rb_busy),
+                    gap, snap["free"], snap["live"], snap["prefix"],
+                    self._rb_cow,
+                )
+            )
+        self._metrics.decode_round(self._deployment, busy / 1e9, gap / 1e9)
+        if self.flight.enabled and self.flight.rounds % 64 == 0:
+            # refresh the cumulative bubble gauge off the O(1) totals —
+            # per-64-rounds, not per-round, so the gauge write never shows
+            # up in the recorder's own overhead budget
+            self._metrics.decode_bubble(
+                self._deployment, self.flight.bubble_fraction()
+            )
+        self._round_reset(now_ns)
+
     async def _run_copies(self, copies: list[tuple[int, int]]) -> None:
         """Dispatch a round's copy-on-write page copies (batched through
         the pool's warmed ladder) BEFORE the round's write dispatch."""
         if not copies:
             return
-        await self._device_call(lambda: self.pool.run_copies(copies))
+        await self._timed_call(F_COPY, lambda: self.pool.run_copies(copies))
         self.stat_kv_copy_rounds += 1
+        self._rb_cow += len(copies)
         self._metrics.decode_kv_cow(self._deployment, len(copies))
 
     async def _admit(self) -> None:
@@ -1297,6 +1474,7 @@ class DecodeScheduler:
                 slot, entry.pages if entry is not None else (), reuse, extra
             ):
                 self.stat_admit_blocked_rounds += 1
+                self._rb_blocked = "pages"
                 break
             self._waiting.popleft()
             self._free.pop()
@@ -1304,6 +1482,7 @@ class DecodeScheduler:
             seq.prefilling = True
             self._slots[slot] = seq
             self.stat_admitted += 1
+            self._rb_admitted += 1
             shared_pages = self.pool.alloc.pages_for(reuse) if reuse else 0
             if self.prefix_enabled:
                 if entry is not None:
@@ -1335,6 +1514,11 @@ class DecodeScheduler:
                 )
                 ms.end()
         self._kv_gauges()
+        if self._waiting and not self._free and not self._rb_blocked:
+            # queue behind fully-occupied slots (the page-budget cause is
+            # recorded where try_admit refused above) — the flight frame's
+            # blocked-admission attribution
+            self._rb_blocked = "slots"
         if self._waiting:
             # whoever is STILL waiting after admission filled every free
             # slot: expire those past the queue deadline (the
@@ -1418,7 +1602,7 @@ class DecodeScheduler:
             return np.asarray(toks), state
 
         t0 = telemetry.now_ns()
-        toks, self.pool.state = await self._device_call(_do_chunk)
+        toks, self.pool.state = await self._timed_call(F_CHUNK, _do_chunk)
         t1 = telemetry.now_ns()
         self.stat_chunk_dispatches += 1
         finishing: list[tuple[_Seq, int]] = []
@@ -1442,7 +1626,11 @@ class DecodeScheduler:
             if seq.prefill_pos >= self.seq_len:
                 finishing.append((seq, i))
         if finishing and self.spec_enabled:
+            td = time.perf_counter_ns()
             self._draft_admit([i for _, i in finishing])
+            # async dispatch: this is enqueue cost; the device time lands
+            # in the next dispatch's blocked readback
+            self._rb_busy[F_DRAFT] += time.perf_counter_ns() - td
         t2 = telemetry.now_ns()
         for seq, i in finishing:
             seq.prefilling = False
@@ -1481,11 +1669,17 @@ class DecodeScheduler:
         tree = self.spec_tree
 
         def _do_spec():
+            # the draft/verify wall split feeds the flight frame's per-
+            # family attribution: with async dispatch the draft segment is
+            # the host-side dispatch cost and the verify segment carries
+            # the blocked readback of the whole round pair
+            td0 = time.perf_counter_ns()
             if tree is not None:
                 node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
                     self.draft_params, self._dck, self._dcv, toks, pos, temps,
                     topks, self._seed, tick, tree,
                 )
+                td1 = time.perf_counter_ns()
                 out_t, acc, state, dck, dcv = self._tree_verify_fn(
                     self.params, self.pool.state, bt, toks, node_toks, blogits,
                     nk, nv, dck, dcv, pos, wlimits, temps, topks,
@@ -1496,22 +1690,26 @@ class DecodeScheduler:
                     self.draft_params, self._dck, self._dcv, toks, pos, temps,
                     topks, self._seed, tick, self.spec_k,
                 )
+                td1 = time.perf_counter_ns()
                 out_t, acc, state = self._verify_fn(
                     self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
                     limits, temps, topks, self._seed, tick,
                 )
-            return np.asarray(out_t), np.asarray(acc), state, dck, dcv
+            out_t, acc = np.asarray(out_t), np.asarray(acc)
+            td2 = time.perf_counter_ns()
+            return out_t, acc, state, dck, dcv, td1 - td0, td2 - td1
 
         t0 = telemetry.now_ns()
-        out_t, acc, self.pool.state, self._dck, self._dcv = (
+        out_t, acc, self.pool.state, self._dck, self._dcv, d_ns, v_ns = (
             await self._device_call(_do_spec)
         )
         t1 = telemetry.now_ns()
-        self.stat_steps += 1
+        self._rb_busy[F_DRAFT] += d_ns
+        self._rb_busy[F_VERIFY] += v_ns
         self.stat_spec_dispatches += 1
-        active = self.active
-        self.stat_occupancy_sum += active / self.n_slots
-        self._metrics.decode_step(self._deployment, active, self.n_slots)
+        # dispatch-time occupancy, committed (with steps/metrics) at the
+        # round's single _commit_round point
+        active = self._rb_active = self.active
         # ``proposed`` is the round's ACCEPTANCE OPPORTUNITY — depth
         # positions a path could advance through — for both modes, so
         # accept rate means the same thing on chain and tree deployments
@@ -1568,6 +1766,8 @@ class DecodeScheduler:
         self.stat_spec_accepted += accepted
         self.stat_spec_emitted += emitted
         self.stat_spec_rides += int((limits > 0).sum())
+        self._rb_accepted = accepted
+        self._rb_proposed = proposed
         if self._adapt is not None:
             self._adapt.update(accepted, proposed)
         self._metrics.decode_spec(
@@ -1576,6 +1776,10 @@ class DecodeScheduler:
 
     async def _run(self) -> None:
         try:
+            # the round clock starts when the LOOP does: everything between
+            # __init__ and the first submit (warmup compiles, idle boot
+            # time) is not decode bubble and must not land in frame 0's gap
+            self._round_reset()
             while True:
                 await self._admit()
                 if self.active == 0:
@@ -1584,6 +1788,10 @@ class DecodeScheduler:
                             return
                         self._wake.clear()
                         await self._wake.wait()
+                        # idle wait is not decode bubble: restart the
+                        # round clock so the next frame's host gap is the
+                        # loop's own, not the queue's silence
+                        self._round_reset()
                     continue
                 # one prefill chunk per round, interleaved with the decode
                 # step below — running slots keep emitting while long
@@ -1617,10 +1825,15 @@ class DecodeScheduler:
                     topks[i] = seq.top_k
                     n_gen += 1
                 if self.active == 0:
+                    # chunk round retired everyone (EOS at prompt end,
+                    # cancellations): commit the round's frame without a
+                    # decode step
+                    self._commit_round("chunk", step=False)
                     continue
                 if n_gen == 0:
                     # pure-prefill round (every occupied slot still mid-
                     # prompt): loop straight to the next chunk round
+                    self._commit_round("chunk", step=False)
                     await asyncio.sleep(0)
                     continue
                 limits = None
@@ -1631,6 +1844,7 @@ class DecodeScheduler:
                     # 0 degrades the round to plain decode (data-only —
                     # the program set never changes)
                     ad = self._adapt.depth()
+                    self._rb_depth = int(ad)
                     limits = np.zeros(self.n_slots, np.int32)
                     for i, seq in enumerate(self._slots):
                         if seq is None or seq.prefilling:
@@ -1693,6 +1907,10 @@ class DecodeScheduler:
                     await self._spec_round(
                         bt, toks, pos, temps, topks, limits, wlimits, tick
                     )
+                    self._commit_round(
+                        "tree" if self.spec_tree is not None else "chain",
+                        step=True,
+                    )
                     await asyncio.sleep(0)
                     continue
 
@@ -1703,11 +1921,8 @@ class DecodeScheduler:
                     )
                     return np.asarray(nxt), state
 
-                nxt, self.pool.state = await self._device_call(_do_step)
-                self.stat_steps += 1
-                active = self.active
-                self.stat_occupancy_sum += active / self.n_slots
-                self._metrics.decode_step(self._deployment, active, self.n_slots)
+                nxt, self.pool.state = await self._timed_call(F_STEP, _do_step)
+                self._rb_active = self.active  # dispatch-time occupancy
                 for i, seq in enumerate(self._slots):
                     if seq is None or seq.prefilling:
                         continue
@@ -1716,6 +1931,7 @@ class DecodeScheduler:
                     self._emit(seq, tok)
                     if self._finished(seq, tok):
                         self._retire(i)
+                self._commit_round("plain", step=True)
                 # yield between steps so admissions/ingress interleave with
                 # the decode loop instead of starving behind it
                 await asyncio.sleep(0)
@@ -1723,6 +1939,10 @@ class DecodeScheduler:
             raise
         except Exception as e:  # noqa: BLE001 - fail every waiter, not just one
             log.exception("decode loop failed")
+            # flight auto-dump: the rounds LEADING UP to the error are the
+            # diagnostic; force-retain them in the span store before the
+            # ring keeps rolling (forced dumps bypass the rate limit)
+            self.flight.dump("round_error", force=True)
             for seq in list(self._slots) + list(self._waiting):
                 if seq is None:
                     continue
@@ -1819,11 +2039,28 @@ class DecodeScheduler:
             )
         rows = np.atleast_2d(np.asarray(arr)).astype(np.int32)
         overrides = self.request_params_from_meta(msg.meta)
+        # SLO outcome tagging: when the deployment declares TTFT/ITL SLOs
+        # or the request rode in under a deadline budget, each row's
+        # met/breached verdict is reported back via meta.tags.slo (what the
+        # access log and a fleet router read)
+        track_slo = bool(self.slo_ttft_s or self.slo_itl_s) or (
+            current_deadline() is not None
+        )
+        slo_flags: list[bool] = [True] * len(rows)
+
+        def _sink(i: int):
+            if not track_slo:
+                return None
+            return lambda ok: slo_flags.__setitem__(i, ok)
+
         # settle EVERY row before failing the request: plain gather would
         # raise on the first row's error while sibling rows keep decoding
         # detached (wasted slots) with their exceptions never retrieved
         outs = await asyncio.gather(
-            *(self.submit(row, **overrides) for row in rows),
+            *(
+                self.submit(row, **overrides, _slo_sink=_sink(i))
+                for i, row in enumerate(rows)
+            ),
             return_exceptions=True,
         )
         for o in outs:
@@ -1838,9 +2075,12 @@ class DecodeScheduler:
         for i, o in enumerate(outs):
             full[i, : len(o)] = o
             gen_lens.append(int(len(o) - rows.shape[1]))
+        tags = {**msg.meta.tags, "gen_lens": gen_lens}
+        if track_slo:
+            tags["slo"] = ["met" if ok else "breached" for ok in slo_flags]
         meta = Meta(
             puid=msg.meta.puid,
-            tags={**msg.meta.tags, "gen_lens": gen_lens},
+            tags=tags,
             routing=dict(msg.meta.routing),
             request_path=dict(msg.meta.request_path),
         )
@@ -1975,6 +2215,8 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         kv_pages=int(getattr(tpu_spec, "decode_kv_pages", 0)),
         kv_dtype=str(getattr(tpu_spec, "decode_kv_dtype", "") or ""),
         mesh_axes=mesh_axes,
+        slo_ttft_ms=float(getattr(tpu_spec, "decode_slo_ttft_ms", 0.0)),
+        slo_itl_ms=float(getattr(tpu_spec, "decode_slo_itl_ms", 0.0)),
         metrics=metrics,
         deployment_name=deployment_name,
         dtype=runtime.dtype,

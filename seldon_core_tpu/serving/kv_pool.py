@@ -125,6 +125,21 @@ class PageAllocator:
     def reserved_total(self) -> int:
         return int(self._reserved.sum())
 
+    def snapshot(self) -> dict:
+        """One cheap host-side read of the pool's occupancy + event
+        counters — the flight recorder's per-round hook and the soak/bench
+        summaries read this instead of poking individual properties (one
+        definition of "pool state at time t" for every consumer)."""
+        return {
+            "free": self.free_pages,
+            "live": self.live_pages,
+            "prefix": self.prefix_pages,
+            "reserved": self.reserved_total(),
+            "shared_total": self.stat_pages_shared,
+            "cow_total": self.stat_cow_copies,
+            "pin_reclaims": self.stat_pin_reclaims,
+        }
+
     def pages_for(self, tokens: int) -> int:
         return -(-int(tokens) // self.page_size)
 

@@ -54,6 +54,26 @@ def _batch_rows(msg: SeldonMessage) -> int:
     return 1
 
 
+def _gen_log_fields(out: "SeldonMessage | None") -> tuple[int, str]:
+    """The access log's generative goodput fields, read off the response
+    tags the decode scheduler stamped: (generated tokens, SLO verdict —
+    "breached" if ANY row breached, "" when the tier didn't judge)."""
+    if out is None:
+        return 0, ""
+    tokens = 0
+    gl = out.meta.tags.get("gen_lens")
+    if isinstance(gl, (list, tuple)):
+        try:
+            tokens = int(sum(int(x) for x in gl))
+        except (TypeError, ValueError):
+            tokens = 0
+    slo = ""
+    sl = out.meta.tags.get("slo")
+    if isinstance(sl, (list, tuple)) and sl:
+        slo = "breached" if any(x == "breached" for x in sl) else "met"
+    return tokens, slo
+
+
 class PredictionService:
     def __init__(
         self,
@@ -169,6 +189,7 @@ class PredictionService:
         buf = None
         status = 200
         degraded = ""
+        out = None
         try:
             with self.tracer.request_trace(
                 "ingress",
@@ -193,6 +214,7 @@ class PredictionService:
             raise
         finally:
             if access_log_enabled():
+                tokens, slo = _gen_log_fields(out)
                 log_request(
                     deployment=self.deployment_name,
                     method="predict",
@@ -203,6 +225,8 @@ class PredictionService:
                     batch=_batch_rows(msg),
                     degraded=degraded,
                     retries=buf.event_count("retry") if buf is not None else 0,
+                    tokens=tokens,
+                    slo=slo,
                 )
         if buf is not None and "trace" in msg.meta.tags:
             # the legacy opt-in contract, now fed by the telemetry spans:

@@ -9,6 +9,8 @@ The pieces:
 - tracer.py   — request lifecycle + the process-global tracer
 - export.py   — optional OTLP-JSON file export
 - access_log.py — env-gated structured JSON access logs
+- flight.py   — decode-loop flight recorder (per-round ring, goodput/SLO
+                counters, /decode/flight + /decode/health registry)
 
 Servers open an ingress root span per request (serving/service.py), the
 executor/batcher/decode-scheduler record spans through the contextvar, the
@@ -33,6 +35,7 @@ from seldon_core_tpu.telemetry.context import (
     span,
     traceparent,
 )
+from seldon_core_tpu.telemetry.flight import FlightFrame, FlightRecorder
 from seldon_core_tpu.telemetry.spans import Span, TraceBuf, new_trace_id, now_ns
 from seldon_core_tpu.telemetry.store import SpanStore, TraceRecord
 from seldon_core_tpu.telemetry.tracer import (
@@ -45,6 +48,8 @@ from seldon_core_tpu.telemetry.tracer import (
 __all__ = [
     "TRACE",
     "TraceContext",
+    "FlightFrame",
+    "FlightRecorder",
     "Span",
     "TraceBuf",
     "SpanStore",

@@ -52,8 +52,16 @@ def log_request(
     batch: int = 1,
     degraded: str = "",
     retries: int = 0,
+    tokens: int = 0,
+    slo: str = "",
 ) -> None:
-    """Emit one access-log line (no-op unless ENGINE_ACCESS_LOG=json)."""
+    """Emit one access-log line (no-op unless ENGINE_ACCESS_LOG=json).
+    ``tokens``/``slo`` are the generative tier's goodput fields: generated
+    tokens delivered by this request, and the decode scheduler's SLO
+    verdict ("met" | "breached" — present only when the deployment
+    declares decode_slo_* targets or the request rode a deadline budget),
+    so the log line, the goodput metrics, and the flight recorder agree
+    about what each request got."""
     if not enabled():
         return
     line = {
@@ -69,4 +77,8 @@ def log_request(
         line["degraded"] = degraded
     if retries:
         line["retries"] = retries
+    if tokens:
+        line["tokens"] = tokens
+    if slo:
+        line["slo"] = slo
     access_logger().info(json.dumps(line, separators=(",", ":")))

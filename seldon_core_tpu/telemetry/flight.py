@@ -1,0 +1,510 @@
+"""Decode-loop flight recorder: always-on, fixed-memory round attribution.
+
+PR 3 gave the graph tier request-scoped tracing, but the decode loop's unit
+of work is the ROUND, not the request: one fused dispatch serves every slot,
+so "where did the last 500 rounds go" (device busy vs host bubble, admission
+stalls, adaptive-depth degrades) is invisible to per-request spans and too
+fine-grained for the coarse ``stat_*`` counters. This module is the
+substrate between the two: every scheduler round appends ONE compact frame
+to a bounded ring —
+
+- round mode (``plain`` / ``chain`` / ``tree`` / ``chunk``), generating +
+  prefilling slot counts, queue depth;
+- admissions / retirements this round and the blocked-admission cause
+  (``pages``: the page pool could not guarantee the reservation;
+  ``slots``: every slot occupied);
+- tokens emitted, speculation accepted/proposed and the effective depth the
+  adaptive controller chose;
+- per-dispatch wall time split **device-busy vs host-gap** ("bubble"), the
+  busy side attributed per fused program family
+  (``chunk``/``step``/``draft``/``verify``/``copy``) — on async-dispatch
+  backends the draft column is the host-side dispatch cost and the verify
+  column carries the blocked readback of the whole round pair;
+- the page pool's free/live/prefix page counts and the round's CoW copies.
+
+Append is O(1) (one ``__slots__`` object + a ring store + a handful of
+integer adds) with a measured budget of a few µs/round
+(``measure_overhead``; the tier-1 guard test pins it). Memory is fixed:
+``capacity`` frames regardless of uptime. ``ENGINE_FLIGHT=off`` is the kill
+switch (``record`` becomes a no-op; the scheduler's behavior is unchanged).
+
+Layered on top:
+
+- **goodput / SLO attainment**: running counters of tokens delivered to
+  requests that met their ``deadline_ms`` vs breached it, and TTFT/ITL
+  attainment fractions against ``tpu.decode_slo_{ttft,itl}_ms`` — the
+  signals an SLO-tiered scheduler or a reward-driven router consumes
+  (ROADMAP), exported as metrics by the scheduler.
+- **auto-dump**: on a round error or an SLO breach the recent ring is
+  dumped into the telemetry span store as a force-retained trace (one
+  ``decode.flight`` root span, one event per frame), so the frames AROUND
+  a breach survive the ring's wraparound and a metric exemplar can link
+  the breach to them.
+- **read-out**: ``GET /decode/flight`` (recent frames + windowed
+  aggregates) and ``GET /decode/health`` on the operator API read the
+  process-global registry (one recorder per scheduler, keyed by
+  deployment name).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+from seldon_core_tpu.utils.env import ENGINE_FLIGHT, ENGINE_FLIGHT_FRAMES
+
+# fused program families a round's device-busy time is attributed to; the
+# indices are the positions in FlightFrame.busy_ns
+FAMILIES = ("chunk", "step", "draft", "verify", "copy")
+F_CHUNK, F_STEP, F_DRAFT, F_VERIFY, F_COPY = range(5)
+
+_DEFAULT_CAPACITY = 2048
+# frames carried per auto-dump (span events are capped at
+# MAX_EVENTS_PER_SPAN=128 per span; stay under it with headroom)
+DUMP_FRAMES = 64
+
+
+def flight_enabled(env: dict | None = None) -> bool:
+    env = env if env is not None else os.environ
+    return str(env.get(ENGINE_FLIGHT, "on")).strip().lower() not in (
+        "off",
+        "0",
+        "false",
+    )
+
+
+def _env_capacity(env: dict | None = None) -> int:
+    env = env if env is not None else os.environ
+    try:
+        n = int(env.get(ENGINE_FLIGHT_FRAMES, _DEFAULT_CAPACITY))
+    except (TypeError, ValueError):
+        n = _DEFAULT_CAPACITY
+    return max(n, 16)
+
+
+class FlightFrame:
+    """One scheduler round, compact. ``busy_ns`` is a 5-tuple aligned with
+    FAMILIES; ``gap_ns`` the round's host bubble (wall - device busy)."""
+
+    __slots__ = (
+        "seq", "t_ns", "mode", "active", "prefilling", "queued",
+        "admitted", "retired", "blocked", "tokens", "accepted", "proposed",
+        "spec_depth", "busy_ns", "gap_ns", "kv_free", "kv_live",
+        "kv_prefix", "cow",
+    )
+
+    def __init__(
+        self, seq, t_ns, mode, active, prefilling, queued, admitted,
+        retired, blocked, tokens, accepted, proposed, spec_depth,
+        busy_ns, gap_ns, kv_free, kv_live, kv_prefix, cow,
+    ):
+        self.seq = seq
+        self.t_ns = t_ns
+        self.mode = mode
+        self.active = active
+        self.prefilling = prefilling
+        self.queued = queued
+        self.admitted = admitted
+        self.retired = retired
+        self.blocked = blocked
+        self.tokens = tokens
+        self.accepted = accepted
+        self.proposed = proposed
+        self.spec_depth = spec_depth
+        self.busy_ns = busy_ns
+        self.gap_ns = gap_ns
+        self.kv_free = kv_free
+        self.kv_live = kv_live
+        self.kv_prefix = kv_prefix
+        self.cow = cow
+
+    def to_dict(self) -> dict:
+        d: dict = {
+            "seq": self.seq,
+            "t_ns": self.t_ns,
+            "mode": self.mode,
+            "active": self.active,
+            "prefilling": self.prefilling,
+            "queued": self.queued,
+            "tokens": self.tokens,
+            "busy_us": {
+                FAMILIES[i]: round(ns / 1e3, 1)
+                for i, ns in enumerate(self.busy_ns)
+                if ns
+            },
+            "gap_us": round(self.gap_ns / 1e3, 1),
+            "kv": [self.kv_free, self.kv_live, self.kv_prefix],
+        }
+        if self.admitted:
+            d["admitted"] = self.admitted
+        if self.retired:
+            d["retired"] = self.retired
+        if self.blocked:
+            d["blocked"] = self.blocked
+        if self.proposed:
+            d["accepted"] = self.accepted
+            d["proposed"] = self.proposed
+            d["spec_depth"] = self.spec_depth
+        if self.cow:
+            d["cow"] = self.cow
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of FlightFrames + O(1) running aggregates.
+
+    Single-writer (the decode loop's task); readers (the operator API,
+    bench/soak summaries) take best-effort snapshots — frames are immutable
+    once recorded and ring-slot assignment is atomic under the GIL, so a
+    concurrent read sees a consistent frame set without a lock on the hot
+    append path."""
+
+    def __init__(
+        self,
+        *,
+        n_slots: int = 1,
+        name: str = "decode",
+        capacity: int = 0,
+        enabled: bool | None = None,
+        slo_ttft_ms: float = 0.0,
+        slo_itl_ms: float = 0.0,
+        dump_interval_s: float = 5.0,
+    ):
+        self.name = name or "decode"
+        self.n_slots = max(int(n_slots), 1)
+        self.capacity = int(capacity) or _env_capacity()
+        self.enabled = flight_enabled() if enabled is None else bool(enabled)
+        self.slo_ttft_ms = float(slo_ttft_ms)
+        self.slo_itl_ms = float(slo_itl_ms)
+        self.dump_interval_s = float(dump_interval_s)
+        self._frames: list[FlightFrame | None] = [None] * self.capacity
+        self._n = 0  # total frames ever recorded
+        # O(1) running totals (the health read-out must not walk the ring)
+        self.busy_ns_total = [0] * len(FAMILIES)
+        self.gap_ns_total = 0
+        self.tokens_total = 0
+        self.occupancy_sum = 0.0
+        self.admitted_total = 0
+        self.retired_total = 0
+        self.blocked_rounds: dict[str, int] = {}
+        self.accepted_total = 0
+        self.proposed_total = 0
+        self.mode_rounds: dict[str, int] = {}
+        # goodput / SLO attainment counters
+        self.goodput_met_tokens = 0
+        self.goodput_breached_tokens = 0
+        self.ttft_ok = 0
+        self.ttft_total = 0
+        self.itl_ok = 0
+        self.itl_total = 0
+        self.deadline_met = 0
+        self.deadline_total = 0
+        self.dumps = 0
+        self._last_dump_ns = 0
+        # recency marker (round number of the last SLO breach) so health()
+        # reflects the CURRENT state instead of latching on lifetime
+        # counters after one incident (blocking recency is read off the
+        # retained frames directly)
+        self._last_breach_round = -(10**12)
+
+    # ---------------------------------------------------------------- append
+    def record(self, frame: FlightFrame) -> None:
+        """O(1): ring store + integer adds. The kill switch makes this a
+        no-op (the scheduler still commits its stat_* counters)."""
+        if not self.enabled:
+            return
+        self._frames[self._n % self.capacity] = frame
+        self._n += 1
+        busy = self.busy_ns_total
+        for i, ns in enumerate(frame.busy_ns):
+            busy[i] += ns
+        self.gap_ns_total += frame.gap_ns
+        self.tokens_total += frame.tokens
+        self.occupancy_sum += frame.active / self.n_slots
+        self.admitted_total += frame.admitted
+        self.retired_total += frame.retired
+        if frame.blocked:
+            self.blocked_rounds[frame.blocked] = (
+                self.blocked_rounds.get(frame.blocked, 0) + 1
+            )
+        self.accepted_total += frame.accepted
+        self.proposed_total += frame.proposed
+        self.mode_rounds[frame.mode] = self.mode_rounds.get(frame.mode, 0) + 1
+
+    @property
+    def rounds(self) -> int:
+        return self._n
+
+    # --------------------------------------------------- goodput / SLO notes
+    def note_goodput(self, tokens: int, met: bool) -> None:
+        if met:
+            self.goodput_met_tokens += tokens
+        else:
+            self.goodput_breached_tokens += tokens
+
+    def note_ttft(self, ok: bool) -> str:
+        """Record one TTFT attainment sample; on a breach, auto-dump the
+        ring (rate-limited) and return the dump's trace id for the metric
+        exemplar ('' otherwise)."""
+        self.ttft_total += 1
+        if ok:
+            self.ttft_ok += 1
+            return ""
+        self._last_breach_round = self._n
+        return self.dump("slo_ttft_breach")
+
+    def note_itl(self, ok: bool) -> str:
+        self.itl_total += 1
+        if ok:
+            self.itl_ok += 1
+            return ""
+        self._last_breach_round = self._n
+        return self.dump("slo_itl_breach")
+
+    def note_deadline(self, met: bool) -> str:
+        self.deadline_total += 1
+        if met:
+            self.deadline_met += 1
+            return ""
+        self._last_breach_round = self._n
+        return self.dump("slo_deadline_breach")
+
+    # --------------------------------------------------------------- readout
+    def snapshot(self, n: int = 0) -> list[FlightFrame]:
+        """The most recent ``n`` frames (all retained when n<=0), oldest
+        first."""
+        total = self._n
+        avail = min(total, self.capacity)
+        n = avail if n <= 0 else min(int(n), avail)
+        out = []
+        for i in range(total - n, total):
+            f = self._frames[i % self.capacity]
+            if f is not None:
+                out.append(f)
+        return out
+
+    def aggregate(self, window: int = 0) -> dict:
+        """Windowed aggregates over the last ``window`` frames (the whole
+        ring when 0). This walks frames — read-out path, not the hot one."""
+        frames = self.snapshot(window)
+        rounds = len(frames)
+        busy = [0] * len(FAMILIES)
+        gap = 0
+        tokens = admitted = retired = accepted = proposed = 0
+        occ = 0.0
+        modes: dict[str, int] = {}
+        blocked: dict[str, int] = {}
+        depth_sum = spec_rounds = 0
+        for f in frames:
+            for i, ns in enumerate(f.busy_ns):
+                busy[i] += ns
+            gap += f.gap_ns
+            tokens += f.tokens
+            admitted += f.admitted
+            retired += f.retired
+            accepted += f.accepted
+            proposed += f.proposed
+            occ += f.active / self.n_slots
+            modes[f.mode] = modes.get(f.mode, 0) + 1
+            if f.blocked:
+                blocked[f.blocked] = blocked.get(f.blocked, 0) + 1
+            if f.proposed:
+                depth_sum += f.spec_depth
+                spec_rounds += 1
+        busy_total = sum(busy)
+        wall = busy_total + gap
+        out = {
+            "name": self.name,
+            "rounds": rounds,
+            "rounds_total": self._n,
+            "modes": modes,
+            "occupancy_mean": round(occ / rounds, 4) if rounds else 0.0,
+            "busy_ms": {
+                FAMILIES[i]: round(ns / 1e6, 3) for i, ns in enumerate(busy) if ns
+            },
+            "gap_ms": round(gap / 1e6, 3),
+            "bubble_fraction": round(gap / wall, 4) if wall else 0.0,
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / (wall / 1e9), 1) if wall else 0.0,
+            "admitted": admitted,
+            "retired": retired,
+            "blocked_rounds": blocked,
+        }
+        if proposed:
+            out["accept_rate"] = round(accepted / proposed, 4)
+            out["spec_depth_mean"] = round(depth_sum / max(spec_rounds, 1), 2)
+        if frames:
+            last = frames[-1]
+            out["kv_pages"] = [last.kv_free, last.kv_live, last.kv_prefix]
+            out["queued"] = last.queued
+        out["goodput"] = self.goodput()
+        return out
+
+    def bubble_fraction(self) -> float:
+        """Lifetime host-bubble fraction from the O(1) running totals."""
+        wall = sum(self.busy_ns_total) + self.gap_ns_total
+        return self.gap_ns_total / wall if wall else 0.0
+
+    def goodput(self) -> dict:
+        """Goodput + SLO-attainment summary from the running counters."""
+        total_tokens = self.goodput_met_tokens + self.goodput_breached_tokens
+        out: dict = {
+            "tokens_met": self.goodput_met_tokens,
+            "tokens_breached": self.goodput_breached_tokens,
+            "goodput_fraction": (
+                round(self.goodput_met_tokens / total_tokens, 4)
+                if total_tokens
+                else 1.0
+            ),
+        }
+        if self.ttft_total:
+            out["ttft_attainment"] = round(self.ttft_ok / self.ttft_total, 4)
+            out["slo_ttft_ms"] = self.slo_ttft_ms
+        if self.itl_total:
+            out["itl_attainment"] = round(self.itl_ok / self.itl_total, 4)
+            out["slo_itl_ms"] = self.slo_itl_ms
+        if self.deadline_total:
+            out["deadline_attainment"] = round(
+                self.deadline_met / self.deadline_total, 4
+            )
+        return out
+
+    # how far back (in rounds) health() looks when classifying the CURRENT
+    # state — lifetime counters would latch "saturated"/"breaching" forever
+    # after one early incident
+    HEALTH_WINDOW = 128
+
+    def health(self) -> dict:
+        """Health summary (the /decode/health read-out): O(1) running
+        totals + the latest frame, with status classified from RECENT
+        rounds (a bounded HEALTH_WINDOW-frame walk for blocking, recency
+        markers for breaches) so a transient incident ages out."""
+        rounds = self._n
+        last = self._frames[(rounds - 1) % self.capacity] if rounds else None
+        status = "idle" if rounds == 0 else "ok"
+        recent = self.snapshot(self.HEALTH_WINDOW)
+        recent_blocked = sum(1 for f in recent if f.blocked)
+        if recent and recent_blocked >= max(len(recent) // 4, 8):
+            status = "saturated"
+        recently_breached = (
+            rounds - self._last_breach_round
+        ) <= self.HEALTH_WINDOW
+        if recently_breached and status == "ok":
+            status = "breaching"
+        out = {
+            "name": self.name,
+            "status": status,
+            "enabled": self.enabled,
+            "rounds": rounds,
+            "occupancy_mean": round(self.occupancy_sum / rounds, 4) if rounds else 0.0,
+            "bubble_fraction": round(self.bubble_fraction(), 4),
+            "tokens": self.tokens_total,
+            "admitted": self.admitted_total,
+            "retired": self.retired_total,
+            "blocked_rounds": dict(self.blocked_rounds),
+            "modes": dict(self.mode_rounds),
+            "goodput": self.goodput(),
+            "dumps": self.dumps,
+        }
+        if self.proposed_total:
+            out["accept_rate"] = round(
+                self.accepted_total / self.proposed_total, 4
+            )
+        if last is not None:
+            out["queued"] = last.queued
+            out["kv_pages"] = [last.kv_free, last.kv_live, last.kv_prefix]
+        return out
+
+    # ------------------------------------------------------------- auto-dump
+    def dump(self, reason: str, force: bool = False) -> str:
+        """Dump the recent ring into the process-global span store as a
+        force-retained trace (one ``decode.flight`` root span carrying the
+        aggregate attrs, one ``frame`` event per recent frame) so the
+        frames around a breach/error survive wraparound. Rate-limited to
+        one dump per ``dump_interval_s`` unless ``force`` (round errors
+        always dump). Returns the dump's trace id ('' when skipped)."""
+        if not self.enabled:
+            return ""
+        now = time.perf_counter_ns()
+        if not force and self._last_dump_ns:
+            if (now - self._last_dump_ns) < self.dump_interval_s * 1e9:
+                return ""
+        self._last_dump_ns = now
+        try:
+            from seldon_core_tpu.telemetry import get_tracer
+            from seldon_core_tpu.telemetry.spans import TraceBuf, new_trace_id
+
+            buf = TraceBuf(new_trace_id(), puid=f"flight:{self.name}")
+            buf.flags.add("forced")
+            agg = self.aggregate(DUMP_FRAMES)
+            root = buf.begin(
+                "decode.flight",
+                attrs={
+                    "deployment": self.name,
+                    "reason": reason,
+                    "rounds": agg["rounds"],
+                    "bubble_fraction": agg["bubble_fraction"],
+                    "occupancy_mean": agg["occupancy_mean"],
+                },
+            )
+            for f in self.snapshot(DUMP_FRAMES):
+                root.add_event("frame", f.to_dict())
+            root.end()
+            get_tracer().store.offer(buf)
+            self.dumps += 1
+            return buf.trace_id
+        except Exception:  # noqa: BLE001 - diagnostics must never kill the loop
+            return ""
+
+    # -------------------------------------------------------------- overhead
+    @staticmethod
+    def measure_overhead(n: int = 2000) -> float:
+        """Measured per-round recorder cost in µs (frame construction +
+        record) on a throwaway recorder — what PARITY.md documents and the
+        tier-1 guard test budgets."""
+        rec = FlightRecorder(n_slots=8, name="overhead", capacity=256, enabled=True)
+        t0 = time.perf_counter_ns()
+        for i in range(n):
+            rec.record(
+                FlightFrame(
+                    i, t0 + i, "plain", 7, 1, 3, 1, 1, "", 8, 4, 6, 3,
+                    (0, 120_000, 40_000, 180_000, 0), 90_000, 5, 12, 4, 1,
+                )
+            )
+        return round((time.perf_counter_ns() - t0) / n / 1e3, 3)
+
+
+# ----------------------------------------------------------------- registry
+
+_RECORDERS: dict[str, FlightRecorder] = {}
+
+
+def register(recorder: FlightRecorder) -> FlightRecorder:
+    """Register a scheduler's recorder under its deployment name (latest
+    wins — a redeploy replaces the entry) so the operator API can read it."""
+    _RECORDERS[recorder.name] = recorder
+    return recorder
+
+
+def recorders() -> dict[str, FlightRecorder]:
+    return dict(_RECORDERS)
+
+
+def flight_report(n: int = 64, name: str | None = None, window: int = 0) -> dict:
+    """The GET /decode/flight body: per-recorder recent frames + windowed
+    aggregates."""
+    out: dict = {"recorders": {}}
+    for rname, rec in _RECORDERS.items():
+        if name and rname != name:
+            continue
+        out["recorders"][rname] = {
+            "aggregate": rec.aggregate(window),
+            "frames": [f.to_dict() for f in rec.snapshot(n)],
+        }
+    return out
+
+
+def health_report() -> dict:
+    """The GET /decode/health body: per-recorder O(1) health summaries."""
+    return {name: rec.health() for name, rec in _RECORDERS.items()}

@@ -354,6 +354,22 @@ async def soak(
             "requested_tp": tp,
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    flight_stats = None
+    if generative and sched is not None and getattr(sched, "flight", None):
+        # the flight recorder's aggregate beside the allocator audit: the
+        # same bubble/occupancy/blocked-cause read-out GET /decode/flight
+        # serves live, as an end-of-run summary
+        fa = sched.flight.aggregate()
+        flight_stats = {
+            "rounds": fa["rounds"],
+            "modes": fa["modes"],
+            "occupancy_mean": fa["occupancy_mean"],
+            "bubble_fraction": fa["bubble_fraction"],
+            "busy_ms": fa["busy_ms"],
+            "gap_ms": fa["gap_ms"],
+            "blocked_rounds": fa["blocked_rounds"],
+            "goodput": fa["goodput"],
+        }
     prefix_stats = None
     if prefix_share > 0 and sched is not None:
         lookups = sched.stat_prefix_hits + sched.stat_prefix_misses
@@ -394,6 +410,7 @@ async def soak(
         ) if lag_sorted else None,
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
+        **({"flight": flight_stats} if flight_stats is not None else {}),
         **({"spec": spec_stats} if spec_stats is not None else {}),
         **({"prefix": prefix_stats} if prefix_stats is not None else {}),
         **({"paged": paged_stats} if paged_stats is not None else {}),

@@ -38,6 +38,11 @@ ENGINE_TRACE_MAX_SAMPLED = "ENGINE_TRACE_MAX_SAMPLED"  # default 64
 ENGINE_TRACE_SAMPLE_RATE = "ENGINE_TRACE_SAMPLE_RATE"  # default 0.05
 ENGINE_OTLP_FILE = "ENGINE_OTLP_FILE"  # path; unset = no export
 ENGINE_ACCESS_LOG = "ENGINE_ACCESS_LOG"  # "json" enables; default off
+# decode-loop flight recorder (telemetry/flight.py reads these): per-round
+# ring buffer kill switch + capacity. On by default — the measured append
+# cost is single-digit µs/round (PARITY.md "Flight recorder overhead").
+ENGINE_FLIGHT = "ENGINE_FLIGHT"  # "off" disables the recorder
+ENGINE_FLIGHT_FRAMES = "ENGINE_FLIGHT_FRAMES"  # ring capacity, default 2048
 
 
 def rest_timeouts(env: dict | None = None) -> tuple[float, float]:

@@ -125,6 +125,13 @@ def worst_case_full_record() -> dict:
             "slot_occupancy_mean": 0.893,
             "recompiles_after_warmup": 0,
             "steps": 1234,
+            "loop": {
+                "frames": 1234,
+                "bubble_fraction": 0.3127,
+                "occupancy": 0.8911,
+                "blocked_rounds": 17,
+                "record_us": 4.812,
+            },
         },
         "spec": {
             "tokens_per_sec": 2890.13,
@@ -314,10 +321,13 @@ def test_compact_record_carries_every_headline():
         "ttft_p50": 630.44,
         "ttft_p99": 1265.01,
         "itl_p99": 26.81,
-        "scan_lat_p50": 3279.11,
+        "scan_p50": 3279.11,
         "occ": 0.893,
         "recompiles": 0,
         "slots": 8,
+        # flight-recorder sub-leg, packed to fit the byte budget:
+        # [bubble_fraction, occupancy, record_us]
+        "loop": [0.313, 0.891, 4.8],
         "spec_tok_s": 2890.13,
         "accept_rate": 0.941,
         "tok_disp": 4.31,
@@ -331,9 +341,9 @@ def test_compact_record_carries_every_headline():
         "prefix_hit_rate": 0.958,
         "prefix_saved_tok": 1288,
         "prefix_tok_s": 1411.02,
-        "prefix_tok_s_chunked": 1389.77,
+        "prefix_tok_s_ck": 1389.77,
         "prefix_itl_p99": 44.91,
-        "prefix_itl_p99_chunked": 21.08,
+        "prefix_itl_p99_ck": 21.08,
         # tree-speculation sub-leg, [tree, chain] pairs: tokens/s under
         # the dispatch-RTT floor and per-slot accepted+bonus per verify
         # dispatch at the same 2-dispatch round shape (identity contract
