@@ -5,12 +5,15 @@ TEST_ENV = PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_dev
 
 IMAGE ?= seldon-core-tpu/platform:latest
 
-.PHONY: test test-fast bench dryrun protos native install-bundle image release clean
+.PHONY: lint test test-fast bench dryrun protos native install-bundle image release clean
 
-test:  ## full suite on the 8-device virtual CPU mesh
+lint:  ## invariant linter (trace-safety / commit-point / registry-drift / ladder)
+	$(PY) -m seldon_core_tpu.tools.lint
+
+test: lint  ## full suite on the 8-device virtual CPU mesh
 	$(PY) -m pytest tests/ -q
 
-test-fast:  ## skip the slow model/parallel tests
+test-fast: lint  ## skip the slow model/parallel tests
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_models_heavy.py --ignore=tests/test_parallel.py
 
 bench:  ## one-line JSON benchmark on the attached accelerator

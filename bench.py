@@ -2412,6 +2412,26 @@ def main() -> None:
                 print(f"--record: cannot load: {e}", file=sys.stderr)
                 sys.exit(2)
             sys.exit(run_compare(compare_to, new, tolerance))
+        if "--no-lint" not in argv:
+            # gating preflight: a regression-gated run on a lint-dirty tree
+            # gates garbage — the compare assumes the serving invariants
+            # the linter checks (warmed-ladder coverage above all) still
+            # hold. Pure-AST, sub-second; --no-lint is the escape hatch.
+            # Lint output rides stderr: bench stdout stays the driver's
+            # machine-parseable compact line.
+            import contextlib
+
+            from seldon_core_tpu.tools.lint import main as lint_main
+
+            with contextlib.redirect_stdout(sys.stderr):
+                lint_rc = lint_main([])
+            if lint_rc != 0:
+                print(
+                    "--compare: refusing a gating run on a dirty lint tree "
+                    "(fix the findings above or pass --no-lint)",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
 
     if "--gen-tp-only" in sys.argv:
         # same sitecustomize caveat as --serving-stack-only: pin the CPU

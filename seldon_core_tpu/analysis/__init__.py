@@ -1,0 +1,47 @@
+"""Invariant linter: AST static analysis for the repo's own bug classes.
+
+The serving tier rests on invariants that unit tests can only check after
+the fact: "zero recompiles" is guarded by warmed-ladder tests, the flight
+recorder *discovered* the ``stat_occupancy_sum`` two-site drift rather than
+preventing it, and the env/knob/metric registries (utils/env.py,
+graph/spec.py + graph/validation.py, metrics/registry.py) drift silently as
+modules grow. This package turns those invariants into review-time checks:
+
+- ``trace_safety``  (TS*): host-sync / recompile hazards inside functions
+  reachable from a ``jax.jit`` / fused-program definition.
+- ``commit_point``  (CP*): per-round scheduler state must funnel through
+  ``_commit_round``/``_round_reset``; ``self.*`` state mutated on both
+  sides of an ``await`` without a lock is an interleaving hazard.
+- ``registry_drift`` (RD*): owned env names read outside utils/env.py,
+  ``seldon_tpu_*`` metric names minted outside metrics/registry.py, and
+  TpuSpec knobs with no graph/validation.py rule.
+- ``ladder``        (LC*): every fused program handle / bucket ladder used
+  at a dispatch site must be warmed by ``warmup()`` and (for programs)
+  reported by ``compile_counts()``.
+
+Pure stdlib (``ast``) — no JAX import, so the CLI and the tier-1 guard test
+stay fast. CLI: ``python -m seldon_core_tpu.tools.lint`` (docs/linting.md).
+
+Suppression: a trailing ``# lint: ignore[RULE,...]`` (or bare
+``# lint: ignore``) comment silences findings on that line; deliberate
+whole-tree exceptions live in the checked-in ``lint-baseline.json``.
+"""
+
+from seldon_core_tpu.analysis.core import (
+    ALL_PASSES,
+    Project,
+    lint_paths,
+    lint_sources,
+    rule_catalogue,
+)
+from seldon_core_tpu.analysis.model import Baseline, Finding
+
+__all__ = [
+    "ALL_PASSES",
+    "Baseline",
+    "Finding",
+    "Project",
+    "lint_paths",
+    "lint_sources",
+    "rule_catalogue",
+]

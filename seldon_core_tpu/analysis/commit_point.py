@@ -1,0 +1,247 @@
+"""CP*: commit-point discipline for per-round scheduler state.
+
+The PR 9 drift class: ``stat_occupancy_sum`` was once updated at two
+sites (the spec and plain decode paths) that could silently diverge;
+the fix funneled every per-round commit through ONE ``_commit_round``
+point. These rules keep that invariant structural:
+
+- CP001: in any class defining ``_commit_round``, an attribute that
+  ``_commit_round`` mutates is ROUND-COMMITTED state — mutating it from
+  any other method (``_round_reset`` and ``__init__`` excepted) recreates
+  the two-site drift hazard.
+- CP002: in an ``async`` method, writing the same ``self.*`` attribute on
+  both sides of an ``await`` leaves a window where another coroutine
+  observes (or interleaves its own write into) a half-updated invariant.
+  Writes inside an ``async with self.<lock>`` block are exempt; loop
+  bodies are walked linearly (no wrap-around), so a single write site
+  inside a loop does not flag.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from seldon_core_tpu.analysis.core import ParsedFile, Project
+from seldon_core_tpu.analysis.model import Finding
+
+_EXEMPT_METHODS = ("__init__", "_commit_round", "_round_reset")
+
+
+def _self_attr_writes(stmt: ast.stmt) -> list[tuple[str, ast.AST]]:
+    """(attr, node) for every ``self.X`` / ``self.X[...]`` store in one
+    statement (no recursion into nested statements)."""
+    out: list[tuple[str, ast.AST]] = []
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for el in ast.walk(t):
+            node = el
+            if isinstance(node, ast.Starred):
+                node = node.value
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                out.append((node.attr, stmt))
+    return out
+
+
+class CommitPointPass:
+    name = "commit-point"
+    rules = {
+        "CP001": "round-committed attribute mutated outside _commit_round/_round_reset",
+        "CP002": "same self.* attribute written on both sides of an await without a lock",
+    }
+
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for pf in project.files:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._check_class(pf, node, findings)
+        return findings
+
+    # ------------------------------------------------------------ CP001
+    def _check_class(
+        self, pf: ParsedFile, cls: ast.ClassDef, findings: list[Finding]
+    ) -> None:
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        by_name = {m.name: m for m in methods}
+        commit = by_name.get("_commit_round")
+        if commit is not None:
+            protected: set[str] = set()
+            for stmt in ast.walk(commit):
+                for attr, _ in _self_attr_writes(stmt):
+                    protected.add(attr)
+            if protected:
+                for m in methods:
+                    if m.name in _EXEMPT_METHODS:
+                        continue
+                    for stmt in ast.walk(m):
+                        for attr, site in _self_attr_writes(stmt):
+                            if attr in protected:
+                                findings.append(
+                                    Finding(
+                                        rule="CP001",
+                                        path=pf.path,
+                                        line=site.lineno,
+                                        col=site.col_offset,
+                                        message=(
+                                            f"`self.{attr}` is round-committed "
+                                            f"state (mutated by `_commit_round`) "
+                                            f"but is also mutated in "
+                                            f"`{cls.name}.{m.name}` — the "
+                                            "two-site drift hazard"
+                                        ),
+                                        hint=(
+                                            "funnel the update through "
+                                            "_commit_round (accumulate into a "
+                                            "_rb_* field reset by _round_reset)"
+                                        ),
+                                        symbol=f"{cls.name}.{m.name}",
+                                    )
+                                )
+        for m in methods:
+            if isinstance(m, ast.AsyncFunctionDef):
+                self._check_async(pf, cls, m, findings)
+
+    # ------------------------------------------------------------ CP002
+    def _check_async(
+        self,
+        pf: ParsedFile,
+        cls: ast.ClassDef,
+        fn: ast.AsyncFunctionDef,
+        findings: list[Finding],
+    ) -> None:
+        # attr -> first await-epoch it was written in; flag the first write
+        # in a LATER epoch (a write before and after some await)
+        first_epoch: dict[str, int] = {}
+        flagged: set[str] = set()
+        epoch = 0
+
+        def has_await(node: ast.AST) -> bool:
+            return any(isinstance(n, ast.Await) for n in ast.walk(node))
+
+        def locked(item: ast.withitem) -> bool:
+            # async with self.<something lock-like>: the guarded block's
+            # writes are safe — the lock IS the commit funnel. Only
+            # name-plausible locks qualify; `async with self.session:`
+            # (transports, transactions) provides no mutual exclusion and
+            # its body is analyzed like any other statements.
+            e = item.context_expr
+            if isinstance(e, ast.Call):
+                e = e.func
+            return (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+                and re.search(r"lock|mutex|sem|cond", e.attr, re.IGNORECASE)
+                is not None
+            )
+
+        def note_writes(stmt: ast.stmt) -> None:
+            nonlocal epoch
+            # the awaited RHS runs BEFORE the store: bump the epoch first
+            # so `self.x = await f()` counts as a post-await write
+            if has_await(stmt):
+                epoch += 1
+            if (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is None
+            ):
+                return  # `self.x = None` is sentinel init, not a torn invariant
+            for attr, site in _self_attr_writes(stmt):
+                prev = first_epoch.setdefault(attr, epoch)
+                if prev != epoch and attr not in flagged:
+                    flagged.add(attr)
+                    findings.append(
+                        Finding(
+                            rule="CP002",
+                            path=pf.path,
+                            line=site.lineno,
+                            col=site.col_offset,
+                            message=(
+                                f"`self.{attr}` is written on both sides of "
+                                f"an await in async "
+                                f"`{cls.name}.{fn.name}` — another coroutine "
+                                "can observe or interleave with the "
+                                "half-updated state"
+                            ),
+                            hint=(
+                                "hold an asyncio.Lock across the writes, or "
+                                "funnel both into one commit point after "
+                                "the await"
+                            ),
+                            symbol=f"{cls.name}.{fn.name}",
+                        )
+                    )
+
+        def walk(body: list[ast.stmt]) -> None:
+            nonlocal epoch
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, (ast.AsyncWith, ast.With)):
+                    if isinstance(stmt, ast.AsyncWith):
+                        epoch += 1  # acquiring awaits
+                        if any(locked(i) for i in stmt.items):
+                            if any(has_await(s) for s in stmt.body):
+                                epoch += 1
+                            continue  # guarded writes are safe
+                    # non-lock context managers (sessions, transactions)
+                    # provide no exclusion — analyze the body normally
+                    walk(stmt.body)
+                elif isinstance(stmt, ast.If):
+                    # mutually exclusive branches do NOT see each other's
+                    # awaits: walk each from the same starting epoch and
+                    # join (max) afterward, else an await in the if-body
+                    # falsely elevates the else-body's writes
+                    if has_await(stmt.test):
+                        epoch += 1
+                    start = epoch
+                    walk(stmt.body)
+                    after_body = epoch
+                    epoch = start
+                    walk(stmt.orelse)
+                    epoch = max(epoch, after_body)
+                elif isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    if isinstance(stmt, ast.AsyncFor) or has_await(
+                        stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
+                        else stmt.test
+                    ):
+                        epoch += 1
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, ast.Try):
+                    # an exception can fire BEFORE any of the body's
+                    # awaits ran, so handlers walk from the body-START
+                    # epoch (error-path recovery writes are not "after
+                    # the await" on every execution); join (max) after
+                    start = epoch
+                    walk(stmt.body)
+                    body_end = epoch
+                    ends = [body_end]
+                    for h in stmt.handlers:
+                        epoch = start
+                        walk(h.body)
+                        ends.append(epoch)
+                    epoch = body_end
+                    walk(stmt.orelse)
+                    ends.append(epoch)
+                    epoch = max(ends)
+                    walk(stmt.finalbody)
+                else:
+                    note_writes(stmt)
+        walk(fn.body)

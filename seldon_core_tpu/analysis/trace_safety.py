@@ -1,0 +1,528 @@
+"""TS*: host-sync and recompile hazards inside traced code.
+
+Scope: functions reachable from a ``jax.jit``/``pjit`` definition (or
+matching the repo's ``_fused_*`` naming convention), followed across
+modules through ``from m import f`` / ``import m`` call edges. Parameters
+are assumed traced unless the jit site marks them static
+(``static_argnums``/``static_argnames``) — and staticness propagates
+through call edges: a callee parameter fed only static values / literals
+at every call site stays static, so per-depth Python loops over a static
+``SpecTree`` (models/spec_tree.py) do not false-positive.
+
+Inside traced code a simple forward taint walk tracks which locals carry
+traced values (``.shape``/``.ndim``/``.dtype``/``len()`` results are
+static by construction) and flags the operations that force a host sync,
+break tracing, or bake a recompile per distinct value:
+
+- TS001 host sync: ``np.*`` on a traced value, ``.item()/.tolist()``,
+  ``jax.block_until_ready`` / ``jax.device_get`` (always wrong in-trace).
+- TS002 Python control flow on a traced value (``if``/``while``/
+  ``for``/ternary/``assert`` — needs concrete values, aborts tracing).
+- TS003 stringifying a tracer (f-string/print/str) — prints the tracer
+  object, not the value; ``jax.debug.print`` is the in-trace tool.
+- TS004 ``float()/int()/bool()`` on a traced value — implicit host sync.
+- TS005 traced shape fed to a ``jnp`` constructor — a distinct program
+  per runtime value, i.e. a hidden recompile per shape.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from seldon_core_tpu.analysis.core import ParsedFile, Project
+from seldon_core_tpu.analysis.model import Finding
+
+# attribute reads that yield STATIC (python) values even on a tracer.
+# NOT `.at`: `x.at[i].set(v)` returns a traced array — washing taint
+# there would blind every TS rule to code built on the update idiom.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+# builtins whose result is static regardless of argument taint
+_STATIC_FUNCS = frozenset({"len", "isinstance", "type", "hasattr", "range"})
+# jnp constructors whose first (shape/count) argument must be static
+_SHAPE_CTORS = frozenset(
+    {"zeros", "ones", "full", "empty", "arange", "eye", "linspace", "tri"}
+)
+_CAST_FUNCS = frozenset({"float", "int", "bool", "complex"})
+_STR_FUNCS = frozenset({"print", "str", "repr", "format"})
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+def _module_aliases(pf: ParsedFile, module: str) -> set[str]:
+    """Local names bound to ``module`` ('numpy', 'jax.numpy', 'jax')."""
+    out = {a for a, m in pf.import_mod.items() if m == module}
+    if "." in module:
+        parent, _, leaf = module.rpartition(".")
+        out |= {
+            a for a, (m, n) in pf.import_from.items() if m == parent and n == leaf
+        }
+    return out
+
+
+@dataclass
+class _Root:
+    pf: ParsedFile
+    fn: ast.FunctionDef | ast.AsyncFunctionDef
+    static: frozenset[str]  # static param names
+
+
+def _param_names(fn) -> list[str]:
+    return [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+
+
+def _static_params(fn, call: ast.Call | None) -> frozenset[str]:
+    if call is None:
+        return frozenset()
+    names = _param_names(fn)
+    static: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(names):
+                        static.add(names[v.value])
+        elif kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    static.add(v.value)
+    return frozenset(static)
+
+
+class TraceSafetyPass:
+    name = "trace-safety"
+    rules = {
+        "TS001": "host sync inside traced code (np.* / .item() / block_until_ready)",
+        "TS002": "Python control flow on a traced value",
+        "TS003": "stringifying a tracer (f-string / print / str)",
+        "TS004": "float()/int()/bool() on a traced value",
+        "TS005": "traced shape fed to a jnp constructor (recompile per value)",
+    }
+
+    # ------------------------------------------------------------ roots
+    def _jit_callee(self, pf: ParsedFile, func: ast.expr) -> bool:
+        """Is ``func`` a reference to jax.jit / pjit?"""
+        if isinstance(func, ast.Name):
+            tgt = pf.import_from.get(func.id)
+            return tgt is not None and tgt[0] in ("jax", "jax.experimental.pjit") and (
+                tgt[1] in ("jit", "pjit")
+            )
+        if isinstance(func, ast.Attribute) and func.attr in ("jit", "pjit"):
+            base = func.value
+            return isinstance(base, ast.Name) and pf.import_mod.get(base.id) in (
+                "jax",
+                "jax.experimental.pjit",
+            )
+        return False
+
+    def _collect_roots(self, project: Project) -> list[_Root]:
+        roots: list[_Root] = []
+        seen: set[int] = set()
+
+        def add(pf, fn, call):
+            if id(fn) in seen:
+                return
+            seen.add(id(fn))
+            roots.append(_Root(pf, fn, _static_params(fn, call)))
+
+        for pf in project.files:
+            for node in ast.walk(pf.tree):
+                # jax.jit(f, ...) with a resolvable first argument
+                if (
+                    isinstance(node, ast.Call)
+                    and self._jit_callee(pf, node.func)
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                ):
+                    hit = project.resolve_function(pf, node.args[0].id)
+                    if hit is not None:
+                        add(hit[0], hit[1], node)
+                # @jax.jit / @jit / @partial(jax.jit, ...) decorators
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if self._jit_callee(pf, dec):
+                            add(pf, node, None)
+                        elif isinstance(dec, ast.Call):
+                            if self._jit_callee(pf, dec.func):
+                                add(pf, node, dec)
+                            elif (
+                                dec.args
+                                and self._jit_callee(pf, dec.args[0])
+                                # partial(jax.jit, static_argnums=...)
+                            ):
+                                add(pf, node, dec)
+        # naming convention fallback: the _fused_* family is traced even
+        # when the jit() wrap is built dynamically. Jit-site roots win so
+        # their static_argnums are honored.
+        for pf in project.files:
+            for fn in pf.functions.values():
+                if fn.name.startswith("_fused_") and id(fn) not in seen:
+                    add(pf, fn, None)
+        return roots
+
+    # -------------------------------------------------------------- run
+    def run(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        reported: set[tuple[str, str, int, int]] = set()
+
+        # traced-param masks per function, joined over call sites:
+        # param -> True means "some call site feeds this a traced value"
+        masks: dict[int, dict[str, bool]] = {}
+        nodes: dict[int, tuple[ParsedFile, ast.AST]] = {}
+        work: list[int] = []
+
+        def enqueue(pf, fn, traced: dict[str, bool]) -> None:
+            key = id(fn)
+            cur = masks.get(key)
+            if cur is None:
+                masks[key] = dict(traced)
+                nodes[key] = (pf, fn)
+                work.append(key)
+                return
+            grew = False
+            for name, t in traced.items():
+                if t and not cur.get(name, False):
+                    cur[name] = True
+                    grew = True
+            if grew and key not in work:
+                work.append(key)
+
+        for root in self._collect_roots(project):
+            enqueue(
+                root.pf,
+                root.fn,
+                {
+                    n: n not in root.static
+                    for n in _param_names(root.fn)
+                },
+            )
+
+        while work:
+            key = work.pop()
+            pf, fn = nodes[key]
+            traced_params = {n for n, t in masks[key].items() if t}
+            self._analyze(
+                project, pf, fn, traced_params, findings, reported, enqueue
+            )
+        return findings
+
+    # ---------------------------------------------------- per-function
+    def _analyze(
+        self, project, pf, fn, traced_params, findings, reported, enqueue
+    ) -> None:
+        np_alias = _module_aliases(pf, "numpy")
+        jnp_alias = _module_aliases(pf, "jax.numpy")
+        jax_alias = _module_aliases(pf, "jax")
+        tainted: set[str] = set(traced_params)
+        qual = pf.qualname(fn)
+
+        def flag(rule: str, node: ast.AST, message: str, hint: str) -> None:
+            k = (rule, pf.path, node.lineno, node.col_offset)
+            if k in reported:
+                return
+            reported.add(k)
+            findings.append(
+                Finding(
+                    rule=rule,
+                    path=pf.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=f"{message} (inside traced `{qual}`)",
+                    hint=hint,
+                    symbol=qual,
+                )
+            )
+
+        def taint(e: ast.expr) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return False
+                return taint(e.value)
+            if isinstance(e, ast.Subscript):
+                return taint(e.value)
+            if isinstance(e, ast.Call):
+                fname = e.func.id if isinstance(e.func, ast.Name) else None
+                if fname in _STATIC_FUNCS or fname in _CAST_FUNCS:
+                    # len()/int() results are host ints; the cast itself
+                    # is flagged as a sink, not propagated as taint
+                    return False
+                if (
+                    isinstance(e.func, ast.Attribute)
+                    and e.func.attr in ("item", "tolist")
+                ):
+                    return False
+                args = list(e.args) + [kw.value for kw in e.keywords]
+                return any(taint(a) for a in args) or (
+                    isinstance(e.func, ast.Attribute) and taint(e.func.value)
+                )
+            if isinstance(e, (ast.BinOp,)):
+                return taint(e.left) or taint(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return taint(e.operand)
+            if isinstance(e, ast.BoolOp):
+                return any(taint(v) for v in e.values)
+            if isinstance(e, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                    return False  # `x is None` is a static identity check
+                return taint(e.left) or any(taint(c) for c in e.comparators)
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                return any(taint(v) for v in e.elts)
+            if isinstance(e, ast.Dict):
+                return any(taint(v) for v in e.values if v is not None)
+            if isinstance(e, ast.IfExp):
+                return taint(e.body) or taint(e.orelse) or taint(e.test)
+            if isinstance(e, ast.Starred):
+                return taint(e.value)
+            if isinstance(e, ast.JoinedStr):
+                return False
+            return False
+
+        def module_of(base: ast.expr) -> str | None:
+            if not isinstance(base, ast.Name):
+                return None
+            if base.id in np_alias:
+                return "numpy"
+            if base.id in jnp_alias:
+                return "jax.numpy"
+            if base.id in jax_alias:
+                return "jax"
+            return None
+
+        def check_call(c: ast.Call) -> None:
+            args = list(c.args) + [kw.value for kw in c.keywords]
+            any_tainted = any(taint(a) for a in args)
+            if isinstance(c.func, ast.Attribute):
+                mod = module_of(c.func.value)
+                if mod == "numpy" and any_tainted:
+                    flag(
+                        "TS001",
+                        c,
+                        f"numpy call `np.{c.func.attr}` on a traced value "
+                        "forces a host transfer and breaks tracing",
+                        "use the jnp equivalent, or hoist the value out of "
+                        "the traced function",
+                    )
+                elif mod == "jax" and c.func.attr in (
+                    "block_until_ready",
+                    "device_get",
+                ):
+                    flag(
+                        "TS001",
+                        c,
+                        f"`jax.{c.func.attr}` inside traced code is a host "
+                        "sync at trace time",
+                        "sync outside the jitted function (the caller owns "
+                        "readback)",
+                    )
+                elif mod == "jax.numpy" and c.func.attr in _SHAPE_CTORS:
+                    if (c.args and taint(c.args[0])) or any(
+                        kw.arg == "shape" and taint(kw.value)
+                        for kw in c.keywords
+                    ):
+                        flag(
+                            "TS005",
+                            c,
+                            f"`jnp.{c.func.attr}` with a traced shape "
+                            "compiles one program per runtime value",
+                            "derive the shape from static `.shape` fields "
+                            "or pass it as a static argument",
+                        )
+                elif c.func.attr in _SYNC_METHODS and taint(c.func.value):
+                    flag(
+                        "TS001",
+                        c,
+                        f"`.{c.func.attr}()` on a traced value forces a "
+                        "device->host readback",
+                        "keep the value on device; read back after the "
+                        "jitted call returns",
+                    )
+            elif isinstance(c.func, ast.Name):
+                if c.func.id in _CAST_FUNCS and any_tainted:
+                    flag(
+                        "TS004",
+                        c,
+                        f"`{c.func.id}()` on a traced value is an implicit "
+                        "host sync",
+                        "keep arithmetic in jnp; cast outside the traced "
+                        "function",
+                    )
+                elif c.func.id in _STR_FUNCS and any_tainted:
+                    flag(
+                        "TS003",
+                        c,
+                        f"`{c.func.id}()` of a traced value renders the "
+                        "tracer object, not the value",
+                        "use jax.debug.print for in-trace values",
+                    )
+                # propagate into resolvable callees with per-arg taint
+                hit = project.resolve_function(pf, c.func.id)
+                if hit is not None:
+                    cpf, cfn = hit
+                    names = _param_names(cfn)
+                    mask: dict[str, bool] = {}
+                    for i, a in enumerate(c.args):
+                        if isinstance(a, ast.Starred):
+                            break
+                        if i < len(names):
+                            mask[names[i]] = taint(a)
+                    for kw in c.keywords:
+                        if kw.arg in names:
+                            mask[kw.arg] = taint(kw.value)
+                    if any(mask.values()):
+                        enqueue(cpf, cfn, mask)
+            # module-attribute calls into analyzed modules (import m; m.f())
+            if isinstance(c.func, ast.Attribute) and isinstance(
+                c.func.value, ast.Name
+            ):
+                target_mod = pf.import_mod.get(c.func.value.id)
+                other = (
+                    project.by_module.get(target_mod) if target_mod else None
+                )
+                if other is not None and c.func.attr in other.functions:
+                    cfn = other.functions[c.func.attr]
+                    names = _param_names(cfn)
+                    mask = {}
+                    for i, a in enumerate(c.args):
+                        if isinstance(a, ast.Starred):
+                            break
+                        if i < len(names):
+                            mask[names[i]] = taint(a)
+                    for kw in c.keywords:
+                        if kw.arg in names:
+                            mask[kw.arg] = taint(kw.value)
+                    if any(mask.values()):
+                        enqueue(other, cfn, mask)
+
+        def check_expr(e: ast.expr) -> None:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Call):
+                    check_call(node)
+                elif isinstance(node, ast.JoinedStr):
+                    if any(
+                        taint(v.value)
+                        for v in node.values
+                        if isinstance(v, ast.FormattedValue)
+                    ):
+                        flag(
+                            "TS003",
+                            node,
+                            "f-string interpolates a traced value — it "
+                            "renders the tracer, not the number",
+                            "use jax.debug.print for in-trace values",
+                        )
+                elif isinstance(node, ast.IfExp) and taint(node.test):
+                    flag(
+                        "TS002",
+                        node,
+                        "ternary on a traced condition aborts tracing "
+                        "(ConcretizationTypeError)",
+                        "use jnp.where / lax.select",
+                    )
+
+        def assign_target(t: ast.expr, is_tainted: bool) -> None:
+            if isinstance(t, ast.Name):
+                if is_tainted:
+                    tainted.add(t.id)
+                else:
+                    tainted.discard(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    assign_target(el, is_tainted)
+            elif isinstance(t, ast.Starred):
+                assign_target(t.value, is_tainted)
+
+        def do_body(body: list[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs analyzed only if jit-rooted
+                if isinstance(stmt, ast.Assign):
+                    check_expr(stmt.value)
+                    t = taint(stmt.value)
+                    for tgt in stmt.targets:
+                        assign_target(tgt, t)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    check_expr(stmt.value)
+                    assign_target(stmt.target, taint(stmt.value))
+                elif isinstance(stmt, ast.AugAssign):
+                    check_expr(stmt.value)
+                    if taint(stmt.value):
+                        assign_target(stmt.target, True)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    check_expr(stmt.test)
+                    if taint(stmt.test):
+                        kind = "if" if isinstance(stmt, ast.If) else "while"
+                        flag(
+                            "TS002",
+                            stmt,
+                            f"`{kind}` on a traced condition aborts tracing "
+                            "(ConcretizationTypeError)",
+                            "use jnp.where / lax.select / lax.cond on "
+                            "traced values",
+                        )
+                    do_body(stmt.body)
+                    do_body(stmt.orelse)
+                    if isinstance(stmt, ast.While):
+                        do_body(stmt.body)  # second pass: loop-carried taint
+                elif isinstance(stmt, ast.For):
+                    check_expr(stmt.iter)
+                    # iterating a pytree CONTAINER plucked off a traced
+                    # structure (`for lp in params["layers"]`) is the
+                    # unrolled-layers idiom and static; only a DIRECTLY
+                    # traced iterable (a tainted name, `range(traced)`,
+                    # `enumerate(traced_name)`) needs concrete values
+                    def _direct(it: ast.expr) -> bool:
+                        if isinstance(it, ast.Name):
+                            return taint(it)
+                        if isinstance(it, ast.Call) and isinstance(
+                            it.func, ast.Name
+                        ):
+                            if it.func.id == "range":
+                                return any(taint(a) for a in it.args)
+                        # enumerate/zip/tuple iters are overwhelmingly
+                        # pytree-container walks — not worth the noise
+                        return False
+
+                    if _direct(stmt.iter):
+                        flag(
+                            "TS002",
+                            stmt,
+                            "`for` over a traced value needs concrete "
+                            "lengths at trace time",
+                            "loop over static shapes, or use lax.scan / "
+                            "lax.fori_loop",
+                        )
+                    assign_target(stmt.target, taint(stmt.iter))
+                    do_body(stmt.body)
+                    do_body(stmt.body)  # second pass: loop-carried taint
+                    do_body(stmt.orelse)
+                elif isinstance(stmt, ast.Assert):
+                    check_expr(stmt.test)
+                    if taint(stmt.test):
+                        flag(
+                            "TS002",
+                            stmt,
+                            "`assert` on a traced value executes at trace "
+                            "time, not per call",
+                            "use checkify / debug_assert, or assert on "
+                            "static shape fields",
+                        )
+                elif isinstance(stmt, (ast.Return, ast.Expr)):
+                    if stmt.value is not None:
+                        check_expr(stmt.value)
+                elif isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        check_expr(item.context_expr)
+                    do_body(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    do_body(stmt.body)
+                    for h in stmt.handlers:
+                        do_body(h.body)
+                    do_body(stmt.orelse)
+                    do_body(stmt.finalbody)
+                elif isinstance(stmt, (ast.Raise, ast.Delete)):
+                    for node in ast.iter_child_nodes(stmt):
+                        if isinstance(node, ast.expr):
+                            check_expr(node)
+
+        do_body(fn.body)

@@ -28,6 +28,7 @@ from seldon_core_tpu.core.message import Feedback, SeldonMessage
 from seldon_core_tpu.gateway.audit import AuditSink, NullAuditSink
 from seldon_core_tpu.gateway.oauth import OAuthProvider
 from seldon_core_tpu.gateway.store import DeploymentStore
+from seldon_core_tpu.utils.env import TEST_CLIENT_KEY, TEST_CLIENT_SECRET
 
 
 class Backend:
@@ -234,9 +235,9 @@ class Gateway:
         self.metrics = metrics
         # reference backdoor: TEST_CLIENT_KEY env registers a test client
         # (AuthorizationServerConfiguration.java:78-96)
-        test_key = os.environ.get("TEST_CLIENT_KEY", "")
+        test_key = os.environ.get(TEST_CLIENT_KEY, "")
         if test_key:
-            self.oauth.add_client(test_key, os.environ.get("TEST_CLIENT_SECRET", "secret"))
+            self.oauth.add_client(test_key, os.environ.get(TEST_CLIENT_SECRET, "secret"))
 
     # ----- auth helpers
     def principal_from_auth(self, auth: str) -> str:
@@ -254,7 +255,7 @@ class Gateway:
         dep = self.store.by_principal(principal)
         if dep is None:
             # TEST_CLIENT_KEY principal maps to the sole deployment if any
-            if principal == os.environ.get("TEST_CLIENT_KEY", "") and self.store.names():
+            if principal == os.environ.get(TEST_CLIENT_KEY, "") and self.store.names():
                 return self.store.by_name(self.store.names()[0])
             raise APIException(ErrorCode.APIFE_NO_RUNNING_DEPLOYMENT, principal)
         return dep

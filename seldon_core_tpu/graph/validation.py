@@ -31,6 +31,25 @@ class ValidationError(ValueError):
         super().__init__("; ".join(problems))
 
 
+# TpuSpec knobs that deliberately carry NO validation rule, acknowledged
+# here so the registry-drift lint (RD003, seldon_core_tpu/analysis) can
+# hold "every knob has a rule or a recorded waiver" at zero:
+# - batch_across_requests / fuse_graph / donate_input / decode_npy_bindata:
+#   plain booleans, pydantic already rejects non-bool (npy_bindata
+#   additionally has its cross-predictor agreement rule below);
+# - decode_temperature: <= 0 means greedy by contract, any float is legal;
+# - decode_top_k: <= 0 means full vocabulary by contract;
+# - decode_seed: any int seeds the per-deployment RNG stream.
+UNCONSTRAINED_KNOBS = (
+    "batch_across_requests",
+    "fuse_graph",
+    "donate_input",
+    "decode_temperature",
+    "decode_top_k",
+    "decode_seed",
+)
+
+
 def _validate_unit(
     unit: PredictiveUnit, container_names: set[str], seen: set[str], problems: list[str]
 ) -> None:
@@ -83,6 +102,27 @@ def validate_deployment(dep: SeldonDeployment) -> None:
             problems.append(f"predictor '{pred.name}' batch_buckets must be ascending")
         if pred.tpu.dtype not in ("float32", "bfloat16", "float16"):
             problems.append(f"predictor '{pred.name}' dtype '{pred.tpu.dtype}' unsupported")
+        if pred.tpu.max_batch < 1:
+            problems.append(f"predictor '{pred.name}' max_batch must be >= 1")
+        for knob in ("batch_timeout_ms", "deadline_ms", "queue_timeout_ms"):
+            if getattr(pred.tpu, knob) < 0:
+                problems.append(f"predictor '{pred.name}' {knob} must be >= 0")
+        if pred.tpu.weight_quant not in ("", "int8"):
+            problems.append(
+                f"predictor '{pred.name}' weight_quant "
+                f"'{pred.tpu.weight_quant}' unsupported (want '' or 'int8')"
+            )
+        if pred.tpu.offload_compute not in ("auto", "always", "never"):
+            problems.append(
+                f"predictor '{pred.name}' offload_compute "
+                f"'{pred.tpu.offload_compute}' must be auto|always|never"
+            )
+        if pred.tpu.decode_eos_id < -1:
+            # -1 is the documented "no EOS" sentinel; anything below it is
+            # a typo that would silently disable early retirement
+            problems.append(
+                f"predictor '{pred.name}' decode_eos_id must be >= -1"
+            )
         for knob in (
             "decode_prefix_slots",
             "decode_prefix_ctx",

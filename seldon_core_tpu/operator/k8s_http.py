@@ -32,6 +32,8 @@ import urllib.error
 import urllib.request
 from typing import Iterable
 
+from seldon_core_tpu.utils.env import SELDON_TPU_K8S_API
+
 GROUP = "machinelearning.seldon.io"
 VERSION = "v1alpha1"
 PLURAL = "seldondeployments"
@@ -70,7 +72,7 @@ class HttpK8sApi:
     def from_env(cls) -> "HttpK8sApi":
         """In-cluster serviceaccount config, or SELDON_TPU_K8S_API (e.g.
         http://127.0.0.1:8001 from ``kubectl proxy``)."""
-        url = os.environ.get("SELDON_TPU_K8S_API", "")
+        url = os.environ.get(SELDON_TPU_K8S_API, "")
         if url:
             return cls(url)
         host = os.environ.get("KUBERNETES_SERVICE_HOST")

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from seldon_core_tpu.core.message import Feedback, SeldonMessage
+from seldon_core_tpu.utils.env import SELDON_TPU_ALLOW_PYTHON_CLASS
 from seldon_core_tpu.graph.defaulting import default_deployment
 from seldon_core_tpu.graph.spec import (
     DeploymentStatus,
@@ -259,7 +260,7 @@ class DeploymentManager:
         # code) keep it. Default comes from SELDON_TPU_ALLOW_PYTHON_CLASS.
         if allow_python_class is None:
             allow_python_class = os.environ.get(
-                "SELDON_TPU_ALLOW_PYTHON_CLASS", ""
+                SELDON_TPU_ALLOW_PYTHON_CLASS, ""
             ).strip().lower() in ("1", "true", "yes")
         self.allow_python_class = allow_python_class
         # None -> unlimited; set to (a fraction of) the slice's HBM so a new

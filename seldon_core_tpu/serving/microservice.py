@@ -32,6 +32,13 @@ from seldon_core_tpu.graph.spec import (
     PredictiveUnitType,
     PredictorSpec,
 )
+from seldon_core_tpu.utils.env import (
+    PERSISTENCE_STORE,
+    PREDICTIVE_UNIT_ID,
+    PREDICTIVE_UNIT_PARAMETERS,
+    PREDICTIVE_UNIT_SERVICE_PORT,
+    SELDON_DEPLOYMENT_ID,
+)
 
 log = logging.getLogger(__name__)
 
@@ -324,8 +331,8 @@ async def serve_microservice(
 
         store = make_state_store(persistence_url)
         if store is not None:
-            deployment_id = os.environ.get("SELDON_DEPLOYMENT_ID", name)
-            unit_id = os.environ.get("PREDICTIVE_UNIT_ID", name)
+            deployment_id = os.environ.get(SELDON_DEPLOYMENT_ID, name)
+            unit_id = os.environ.get(PREDICTIVE_UNIT_ID, name)
 
             class _UserStateAdapter:
                 """User objects persist whole (reference pickles the object);
@@ -351,7 +358,7 @@ async def serve_microservice(
         runner = web.AppRunner(build_app(service))
         await runner.setup()
         port = http_port or int(
-            os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "5000")
+            os.environ.get(PREDICTIVE_UNIT_SERVICE_PORT, "5000")
         )
         site = web.TCPSite(runner, host, port)
         await site.start()
@@ -369,12 +376,12 @@ async def serve_microservice(
 async def _amain(args) -> None:
     import signal
 
-    parameters = parse_parameters(os.environ.get("PREDICTIVE_UNIT_PARAMETERS"))
+    parameters = parse_parameters(os.environ.get(PREDICTIVE_UNIT_PARAMETERS))
     user_object = load_user_object(args.interface_name, args.model_dir, parameters)
     persistence_url = ""
     if args.persistence:
         persistence_url = os.environ.get(
-            "PERSISTENCE_STORE", "file://./.seldon_state"
+            PERSISTENCE_STORE, "file://./.seldon_state"
         )
     runner, grpc_server, persister = await serve_microservice(
         user_object,

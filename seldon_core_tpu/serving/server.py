@@ -32,7 +32,7 @@ from seldon_core_tpu.serving.rest import build_app
 from seldon_core_tpu.serving.service import PredictionService
 from seldon_core_tpu.utils import env as envmod
 
-GRACE_DRAIN_S = float(os.environ.get("ENGINE_DRAIN_SECONDS", "5"))
+GRACE_DRAIN_S = float(os.environ.get(envmod.ENGINE_DRAIN_SECONDS, "5"))
 
 
 class PredictorServer:

@@ -37,6 +37,8 @@ import tempfile
 import time
 from dataclasses import dataclass, field
 
+from seldon_core_tpu.utils.env import LOADTEST_OAUTH_KEY, LOADTEST_OAUTH_SECRET
+
 
 @dataclass
 class LoadStats:
@@ -535,9 +537,9 @@ def main() -> None:
     )
     # env fallbacks let a k8s Job inject credentials from a Secret instead
     # of exposing them in the pod spec's command args
-    p.add_argument("--oauth-key", default=os.environ.get("LOADTEST_OAUTH_KEY", ""))
+    p.add_argument("--oauth-key", default=os.environ.get(LOADTEST_OAUTH_KEY, ""))
     p.add_argument(
-        "--oauth-secret", default=os.environ.get("LOADTEST_OAUTH_SECRET", "")
+        "--oauth-secret", default=os.environ.get(LOADTEST_OAUTH_SECRET, "")
     )
     p.add_argument(
         "--feedback-route-rewards",

@@ -26,6 +26,8 @@ import re
 import subprocess
 import sys
 
+from seldon_core_tpu.utils.env import SELDON_TPU_REGISTRY
+
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 IMAGE_BASENAME = "seldon-core-tpu/platform"
 
@@ -76,7 +78,7 @@ def main() -> None:
     p.add_argument("--push", action="store_true", help="docker push (implies --build)")
     p.add_argument(
         "--registry",
-        default=os.environ.get("SELDON_TPU_REGISTRY", ""),
+        default=os.environ.get(SELDON_TPU_REGISTRY, ""),
         help="registry prefix for --push, e.g. ghcr.io/org (env SELDON_TPU_REGISTRY)",
     )
     args = p.parse_args()

@@ -18,9 +18,27 @@ ENGINE_PREDICTOR = "ENGINE_PREDICTOR"
 ENGINE_SELDON_DEPLOYMENT = "ENGINE_SELDON_DEPLOYMENT"
 ENGINE_SERVER_PORT = "ENGINE_SERVER_PORT"  # default 8000 (CustomizationBean.java)
 ENGINE_SERVER_GRPC_PORT = "ENGINE_SERVER_GRPC_PORT"  # default 5000 (SeldonGrpcServer.java:33)
+ENGINE_DRAIN_SECONDS = "ENGINE_DRAIN_SECONDS"  # graceful-drain window, default 5
 PREDICTIVE_UNIT_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
 PREDICTIVE_UNIT_ID = "PREDICTIVE_UNIT_ID"
+PREDICTIVE_UNIT_SERVICE_PORT = "PREDICTIVE_UNIT_SERVICE_PORT"  # default 5000
 SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
+# state persistence for wrapped user objects (serving/microservice.py):
+# store URL consumed by persistence/state.make_state_store
+PERSISTENCE_STORE = "PERSISTENCE_STORE"  # default file://./.seldon_state
+# control-plane / tooling (not injected by the operator; read by humans'
+# shells and CI): kubectl-proxy style API endpoint for the k8s watcher,
+# the PYTHON_CLASS capability gate, and the release registry prefix
+SELDON_TPU_K8S_API = "SELDON_TPU_K8S_API"
+SELDON_TPU_ALLOW_PYTHON_CLASS = "SELDON_TPU_ALLOW_PYTHON_CLASS"
+SELDON_TPU_REGISTRY = "SELDON_TPU_REGISTRY"
+# loadtest/soak credentials (tools/loadtest.py; install.py wires them from
+# a Secret in the rendered bundle) and the reference's test-client backdoor
+# (gateway/app.py — AuthorizationServerConfiguration.java:78-96)
+LOADTEST_OAUTH_KEY = "LOADTEST_OAUTH_KEY"
+LOADTEST_OAUTH_SECRET = "LOADTEST_OAUTH_SECRET"
+TEST_CLIENT_KEY = "TEST_CLIENT_KEY"
+TEST_CLIENT_SECRET = "TEST_CLIENT_SECRET"
 # RemoteUnit REST transport timeouts (engine/remote._RestSession). The
 # reference bakes one 5 s total deadline into every call
 # (InternalPredictionService.java:77); here connect and total are separate —

@@ -1,0 +1,607 @@
+"""Invariant linter (seldon_core_tpu/analysis + tools/lint).
+
+Pure-AST tests — no JAX import anywhere on this path, so the whole file
+(including the tier-1 guard that lints the real tree) stays fast. Fixture
+snippets are compiled via ast.parse inside lint_sources; the CLI contract
+(exit codes, --json schema, baseline flow) is exercised via subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from seldon_core_tpu.analysis import (
+    Baseline,
+    lint_paths,
+    lint_sources,
+    rule_catalogue,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "seldon_core_tpu")
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------- trace-safety
+TS_BAD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def _fused_step(params, tokens, temps):
+    x = jnp.dot(params, tokens)
+    if temps > 0:
+        x = x + 1
+    y = np.asarray(x)
+    z = float(x)
+    print(x)
+    s = f"tok {x}"
+    w = jnp.zeros(tokens)
+    jax.block_until_ready(x)
+    return x
+"""
+
+
+def test_trace_safety_positive_all_rules():
+    findings = lint_sources({"m.py": TS_BAD})
+    assert {"TS001", "TS002", "TS003", "TS004", "TS005"} <= rules_of(findings)
+    # every finding carries a file:line anchor and a fix hint
+    assert all(f.line > 0 and f.hint for f in findings)
+
+
+TS_CLEAN = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def _fused_step(params, pool, tokens, counts):
+    # static reads off traced values are fine
+    n = tokens.shape[0]
+    if counts is not None:          # identity check is static
+        tokens = tokens + counts
+    for lp in params["layers"]:     # pytree container walk is static
+        tokens = jnp.dot(lp, tokens)
+    out = jnp.zeros(n)              # shape from .shape is static
+    return out, len(params["layers"])
+
+def host_helper(x):
+    # NOT reachable from a jit root: host-side numpy is fine here
+    return float(np.asarray(x).mean())
+"""
+
+
+def test_trace_safety_negative_static_idioms():
+    assert lint_sources({"m.py": TS_CLEAN}, rules=["trace-safety"]) == []
+
+
+def test_trace_safety_static_argnums_respected():
+    src = """
+import jax
+
+def f(x, k):
+    for _ in range(k):
+        x = x + 1
+    return x
+
+g = jax.jit(f, static_argnums=(1,))
+"""
+    assert lint_sources({"m.py": src}, rules=["trace-safety"]) == []
+    # without the static marker the same loop is a traced-value iteration
+    bad = src.replace(", static_argnums=(1,)", "")
+    assert rules_of(lint_sources({"m.py": bad})) == {"TS002"}
+
+
+def test_trace_safety_cross_module_reachability():
+    # the jit root lives in a.py, the hazard in b.py — the call edge
+    # `from b import helper` must carry the taint across files
+    a = """
+import jax
+from b import helper
+
+def step(params, x):
+    return helper(params, x)
+
+jitted = jax.jit(step)
+"""
+    b = """
+def helper(params, x):
+    if x > 0:
+        return x
+    return -x
+"""
+    findings = lint_sources({"a.py": a, "b.py": b})
+    assert rules_of(findings) == {"TS002"}
+    assert findings[0].path == "b.py"
+    # the same helper with no traced caller is clean
+    assert lint_sources({"b.py": b}) == []
+
+
+def test_trace_safety_staticness_propagates_through_calls():
+    # k is static at the jit site and is passed straight through — the
+    # callee's Python loop over it must not flag
+    src = """
+import jax
+
+def inner(x, k):
+    for _ in range(k):
+        x = x + 1
+    return x
+
+def outer(x, k):
+    return inner(x, k)
+
+jitted = jax.jit(outer, static_argnums=(1,))
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_trace_safety_method_does_not_shadow_module_helper():
+    # a class METHOD sharing a traced helper's name must not absorb its
+    # call edges (bare-name calls never resolve to methods)
+    src = """
+import jax
+import numpy as np
+
+def _helper(x):
+    return np.asarray(x)
+
+def root(x):
+    return _helper(x)
+
+jitted = jax.jit(root)
+
+class Unrelated:
+    def _helper(self):
+        return 1
+"""
+    assert rules_of(lint_sources({"m.py": src})) == {"TS001"}
+
+
+def test_trace_safety_keyword_shape_ctor():
+    src = """
+import jax
+import jax.numpy as jnp
+
+def _fused_f(n):
+    return jnp.zeros(shape=n)
+"""
+    assert rules_of(lint_sources({"m.py": src})) == {"TS005"}
+
+
+def test_trace_safety_at_set_result_stays_traced():
+    # x.at[i].set(v) is the canonical traced update — its result must
+    # carry taint so downstream hazards still flag
+    src = """
+import jax
+
+def _fused_f(x):
+    y = x.at[0].set(1.0)
+    if y > 0:
+        return y
+    return -y
+"""
+    assert rules_of(lint_sources({"m.py": src})) == {"TS002"}
+
+
+# --------------------------------------------------------------- commit-point
+CP_DRIFT = """
+class Sched:
+    def __init__(self):
+        self.stat_occupancy_sum = 0.0
+
+    def _round_reset(self):
+        self._rb = 0
+
+    def _commit_round(self, step):
+        self.stat_occupancy_sum += 0.5
+
+    def _spec_round(self):
+        self.stat_occupancy_sum += 0.5  # the PR 9 two-site drift
+"""
+
+
+def test_commit_point_two_site_drift():
+    findings = lint_sources({"m.py": CP_DRIFT})
+    assert rules_of(findings) == {"CP001"}
+    assert findings[0].symbol == "Sched._spec_round"
+
+
+def test_commit_point_reset_and_init_exempt():
+    src = """
+class Sched:
+    def __init__(self):
+        self.stat_steps = 0
+
+    def _round_reset(self):
+        self.stat_steps = 0
+
+    def _commit_round(self):
+        self.stat_steps += 1
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_commit_point_cross_await_write():
+    src = """
+class S:
+    async def step(self):
+        self.depth = 1
+        await self.dispatch()
+        self.depth = 2
+"""
+    findings = lint_sources({"m.py": src})
+    assert rules_of(findings) == {"CP002"}
+    assert "both sides of an await" in findings[0].message
+
+
+def test_commit_point_lock_and_sentinel_exempt():
+    src = """
+class S:
+    async def locked(self):
+        async with self._lock:
+            self.depth = 1
+            await self.dispatch()
+            self.depth = 2
+
+    async def boot(self):
+        self.server = None
+        await self.setup()
+        self.server = 7
+
+    async def one_side(self):
+        before = self.depth
+        await self.dispatch()
+        self.depth = before + 1
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_commit_point_exclusive_branches_do_not_share_awaits():
+    # an await inside the if-body must not elevate the else-body's epoch:
+    # the two writes sit on mutually exclusive paths with no await
+    # between them on any execution
+    src = """
+class S:
+    async def handle(self, fast):
+        if fast:
+            self.state = "a"
+            await self.flush()
+        else:
+            self.state = "b"
+
+    async def trying(self):
+        try:
+            self.state = "a"
+            await self.flush()
+        except Exception:
+            self.state = "b"
+"""
+    assert lint_sources({"m.py": src}) == []
+    # but a write before the branch and one after a branch containing an
+    # await IS flagged — the hazard exists on that path
+    src2 = """
+class S:
+    async def handle(self, fast):
+        self.state = "start"
+        if fast:
+            await self.flush()
+        self.state = "end"
+"""
+    assert rules_of(lint_sources({"m.py": src2})) == {"CP002"}
+
+
+def test_commit_point_non_lock_context_manager_still_analyzed():
+    # `async with self.session:` is a transport, not a lock — writes
+    # inside it get no exclusion and must still flag across the await
+    src = """
+class S:
+    async def step(self):
+        async with self.session:
+            self.state = "partial"
+            await self.fetch()
+            self.state = "done"
+"""
+    assert rules_of(lint_sources({"m.py": src})) == {"CP002"}
+
+
+def test_commit_point_catches_seeded_scheduler_drift():
+    # the acceptance-criteria scenario: a second stat_occupancy_sum
+    # mutation site seeded into the REAL scheduler source is caught
+    with open(os.path.join(PKG, "serving", "decode_scheduler.py")) as f:
+        src = f.read()
+    marker = "        self.stat_spec_dispatches += 1"
+    assert marker in src
+    seeded = src.replace(
+        marker, marker + "\n        self.stat_occupancy_sum += 1.0", 1
+    )
+    findings = lint_sources(
+        {"serving/decode_scheduler.py": seeded}, rules=["commit-point"]
+    )
+    assert rules_of(findings) == {"CP001"}
+    assert "stat_occupancy_sum" in findings[0].message
+    # and the unseeded source is clean
+    assert (
+        lint_sources(
+            {"serving/decode_scheduler.py": src}, rules=["commit-point"]
+        )
+        == []
+    )
+
+
+# -------------------------------------------------------------- registry-drift
+def test_registry_env_read_flagged_and_constant_clean():
+    bad = """
+import os
+FLIGHT = os.environ.get("ENGINE_FLIGHT", "on")
+PORT = os.environ["ENGINE_SERVER_PORT"]
+EXTERNAL = os.environ.get("KUBERNETES_SERVICE_HOST")
+"""
+    findings = lint_sources({"pkg/telemetry/x.py": bad})
+    assert [f.symbol for f in findings] == [
+        "ENGINE_FLIGHT",
+        "ENGINE_SERVER_PORT",
+    ]  # external names are not ours to register
+    clean = """
+import os
+from seldon_core_tpu.utils.env import ENGINE_FLIGHT
+FLIGHT = os.environ.get(ENGINE_FLIGHT, "on")
+"""
+    assert lint_sources({"pkg/telemetry/x.py": clean}) == []
+    # the registry file itself may spell the names out
+    assert (
+        lint_sources({"seldon_core_tpu/utils/env.py": bad}) == []
+    )
+
+
+def test_registry_metric_literal_flagged_outside_registry():
+    bad = 'NAME = "seldon_tpu_decode_new_thing_total"\n'
+    findings = lint_sources({"pkg/serving/x.py": bad})
+    assert rules_of(findings) == {"RD002"}
+    assert lint_sources({"pkg/metrics/registry.py": bad}) == []
+    # docstrings are exempt (prose references, not minted names)
+    doc = '"""Reads the seldon_tpu_event_loop_lag_ms gauge."""\n'
+    assert lint_sources({"pkg/serving/x.py": doc}) == []
+
+
+def test_registry_knob_without_validation_rule():
+    spec = """
+class TpuSpec:
+    decode_slots: int = 0
+    decode_new_knob: int = 0
+"""
+    validation = """
+def validate(pred):
+    if pred.tpu.decode_slots < 0:
+        raise ValueError("decode_slots")
+"""
+    findings = lint_sources(
+        {"pkg/graph/spec.py": spec, "pkg/graph/validation.py": validation}
+    )
+    assert rules_of(findings) == {"RD003"}
+    assert findings[0].symbol == "decode_new_knob"
+    # an UNCONSTRAINED_KNOBS acknowledgment counts as the rule
+    acked = validation + 'UNCONSTRAINED_KNOBS = ("decode_new_knob",)\n'
+    assert (
+        lint_sources(
+            {"pkg/graph/spec.py": spec, "pkg/graph/validation.py": acked}
+        )
+        == []
+    )
+    # word-boundary matching: a knob that is a PREFIX of a validated
+    # knob's name is NOT covered by that longer name's error message
+    prefix_spec = """
+class TpuSpec:
+    decode_slo: int = 0
+"""
+    prefix_validation = """
+def validate(pred):
+    if pred.tpu.decode_slo_ttft_ms < 0:
+        raise ValueError("decode_slo_ttft_ms must be >= 0")
+"""
+    findings = lint_sources(
+        {
+            "pkg/graph/spec.py": prefix_spec,
+            "pkg/graph/validation.py": prefix_validation,
+        }
+    )
+    assert [f.symbol for f in findings] == ["decode_slo"]
+
+
+# --------------------------------------------------------------------- ladder
+LC_BAD = """
+class Sched:
+    def warmup(self):
+        self._step_fn(0)
+
+    def compile_counts(self):
+        return {"step": self._step_fn._cache_size()}
+
+    def run(self):
+        toks = self._step_fn(1)
+        extra = self._verify_fn(2)          # never warmed, never counted
+        b = next(b for b in self.chunk_buckets if b)  # ladder not walked
+        return toks, extra, b
+"""
+
+
+def test_ladder_coverage_positive():
+    findings = lint_sources({"m.py": LC_BAD})
+    assert rules_of(findings) == {"LC001", "LC002", "LC003"}
+    by_rule = {f.rule: f for f in findings}
+    assert "_verify_fn" in by_rule["LC001"].message
+    assert "_verify_fn" in by_rule["LC002"].message
+    assert "chunk_buckets" in by_rule["LC003"].message
+
+
+def test_ladder_coverage_clean_and_warmup_helpers_counted():
+    src = """
+class Sched:
+    def warmup(self):
+        self._warm_all()
+
+    def _warm_all(self):
+        for b in self.chunk_buckets:
+            self._step_fn(b)
+        self._verify_fn(0)
+
+    def compile_counts(self):
+        return {
+            "step": self._step_fn._cache_size(),
+            "verify": self._verify_fn._cache_size(),
+        }
+
+    def run(self):
+        b = next(b for b in self.chunk_buckets if b)
+        return self._step_fn(b), self._verify_fn(b)
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_ladder_out_of_scope_without_warmup():
+    src = """
+class Helper:
+    def run(self):
+        return self._step_fn(1)
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+# ------------------------------------------------------- suppression/baseline
+def test_inline_suppression_semantics():
+    line = 'import os\nX = os.environ.get("ENGINE_FLIGHT", "on")'
+    assert rules_of(lint_sources({"p/x.py": line})) == {"RD001"}
+    assert (
+        lint_sources({"p/x.py": line + "  # lint: ignore[RD001]"}) == []
+    )
+    assert lint_sources({"p/x.py": line + "  # lint: ignore"}) == []
+    # a non-matching rule list does not suppress
+    assert rules_of(
+        lint_sources({"p/x.py": line + "  # lint: ignore[TS001]"})
+    ) == {"RD001"}
+
+
+def test_baseline_split_and_stale():
+    findings = lint_sources(
+        {"p/x.py": 'import os\nX = os.environ.get("ENGINE_FLIGHT")'}
+    )
+    bl = Baseline.from_findings(findings)
+    new, old, stale = bl.split(findings)
+    assert new == [] and len(old) == 1 and stale == []
+    # a baseline entry matching nothing is reported stale
+    bl.entries.append({"rule": "RD001", "path": "gone.py", "symbol": "X_GONE"})
+    new, old, stale = bl.split(findings)
+    assert len(stale) == 1 and stale[0]["path"] == "gone.py"
+
+
+def test_rules_filter_and_catalogue():
+    cat = rule_catalogue()
+    assert set(cat) == {"trace-safety", "commit-point", "registry-drift", "ladder"}
+    assert {"TS001", "TS002", "TS003", "TS004", "TS005"} == set(
+        cat["trace-safety"]
+    )
+    # selecting one family drops the others' findings
+    both = TS_BAD + '\nimport os\nY = os.environ.get("ENGINE_FLIGHT")\n'
+    assert rules_of(lint_sources({"m.py": both}, rules=["registry-drift"])) == {
+        "RD001"
+    }
+    assert "TS002" in rules_of(lint_sources({"m.py": both}, rules=["TS002"]))
+    with pytest.raises(ValueError):
+        lint_sources({"m.py": both}, rules=["no-such-pass"])
+
+
+# ------------------------------------------------------------------------ CLI
+def run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.tools.lint", *args],
+        cwd=cwd,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_exit_codes_and_json_schema(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\nY = os.environ.get("ENGINE_FLIGHT")\n')
+
+    r = run_cli([str(clean)], cwd=tmp_path)
+    assert r.returncode == 0, r.stderr
+    assert "clean" in r.stdout
+
+    r = run_cli([str(bad)], cwd=tmp_path)
+    assert r.returncode == 1
+    assert "RD001" in r.stdout and "bad.py:2:" in r.stdout
+
+    r = run_cli([str(bad), "--json"], cwd=tmp_path)
+    assert r.returncode == 1
+    obj = json.loads(r.stdout)
+    assert set(obj) == {
+        "version",
+        "findings",
+        "baselined",
+        "stale_baseline_entries",
+        "counts",
+    }
+    (f,) = obj["findings"]
+    assert {
+        "rule",
+        "path",
+        "line",
+        "col",
+        "message",
+        "hint",
+        "severity",
+        "symbol",
+    } == set(f)
+    assert f["rule"] == "RD001" and f["line"] == 2
+
+    # usage errors are exit 2
+    assert run_cli(["/no/such/path.py"], cwd=tmp_path).returncode == 2
+    assert (
+        run_cli([str(bad), "--rules", "bogus"], cwd=tmp_path).returncode == 2
+    )
+
+
+def test_cli_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\nY = os.environ.get("ENGINE_FLIGHT")\n')
+    bl = tmp_path / "bl.json"
+    r = run_cli([str(bad), "--write-baseline", str(bl)], cwd=tmp_path)
+    assert r.returncode == 0 and bl.exists()
+    r = run_cli([str(bad), "--baseline", str(bl)], cwd=tmp_path)
+    assert r.returncode == 0
+    assert "baselined" in r.stdout
+    # --no-baseline reports it again
+    assert run_cli([str(bad), "--no-baseline"], cwd=tmp_path).returncode == 1
+
+
+# ----------------------------------------------------------------- tier-1 gate
+def test_tree_is_clean_under_the_checked_in_baseline():
+    """THE guard: lint over seldon_core_tpu/ reports zero non-baselined
+    findings. A new violation of any of the four rule families fails
+    tier-1 here with the same file:line finding `make lint` prints."""
+    findings = lint_paths([PKG], root=REPO)
+    bl = Baseline.load(os.path.join(REPO, "lint-baseline.json"))
+    new, _old, stale = bl.split(findings)
+    assert new == [], "non-baselined lint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert stale == [], f"stale lint-baseline.json entries: {stale}"
+
+
+def test_cli_clean_on_repo():
+    r = run_cli([], cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
