@@ -5,13 +5,16 @@ TEST_ENV = PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_dev
 
 IMAGE ?= seldon-core-tpu/platform:latest
 
-.PHONY: lint test test-fast bench dryrun protos native install-bundle image release clean
+.PHONY: lint test test-fast bench dryrun protos native install-bundle image release clean profile-smoke
 
-lint:  ## invariant linter (trace-safety / commit-point / registry-drift / ladder)
+lint:  ## invariant linter (trace-safety / commit-point / registry-drift / phase-registry / ladder)
 	$(PY) -m seldon_core_tpu.tools.lint
 
-test: lint  ## full suite on the 8-device virtual CPU mesh
+test: lint profile-smoke  ## full suite on the 8-device virtual CPU mesh
 	$(PY) -m pytest tests/ -q
+
+profile-smoke:  ## short generative soak: the decode-loop sampling profiler must capture >=1 stack (folded output -> /tmp)
+	$(TEST_ENV) $(PY) -m seldon_core_tpu.tools.soak --duration 3 --users 4 --prefix-share 0.5 --profile /tmp/decode_profile.folded
 
 test-fast: lint  ## skip the slow model/parallel tests
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_models_heavy.py --ignore=tests/test_parallel.py

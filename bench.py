@@ -1160,12 +1160,21 @@ def serving_gen_cpu(
         # frames saw it, blocked-admission rounds, and the recorder's
         # measured per-round append cost (the <10 µs budget PARITY cites)
         fa = sched.flight.aggregate()
+        gap_ms = fa["gap_ms"]
         out["loop"] = {
             "frames": fa["rounds"],
             "bubble_fraction": fa["bubble_fraction"],
             "occupancy": fa["occupancy_mean"],
             "blocked_rounds": sum(fa["blocked_rounds"].values()),
             "record_us": sched.flight.measure_overhead(),
+            # per-phase fractions OF THE GAP (telemetry/flight.PHASES):
+            # what the host bubble decomposes into — the evidence the
+            # pipelined-decode ROADMAP item spends. Recorded, not gated
+            # (the record_us precedent: attribution, not a perf contract).
+            "phases": {
+                k: round(v / gap_ms, 3) if gap_ms else 0.0
+                for k, v in (fa.get("phase_ms") or {}).items()
+            },
         }
         if spec:
             out["accept_rate"] = round(
@@ -2120,6 +2129,16 @@ def compact_record(full: dict) -> dict:
                 _r(lp.get("occupancy"), 3),
                 _r(lp.get("record_us"), 1),
             ]
+            ph = lp.get("phases") or {}
+            if ph:
+                # top-3 gap-phase fractions (full table in the detail
+                # record) — recorded for the host-bubble attribution
+                # story, NOT gated by --compare (same precedent as
+                # record_us: wall-noise attribution, not a contract)
+                c["gen"]["loop_ph"] = {
+                    k: _r(v, 3)
+                    for k, v in sorted(ph.items(), key=lambda kv: -kv[1])[:3]
+                }
         if gp:
             # speculative leg: delivered tokens/s, accept rate, and the
             # realized tokens-per-target-dispatch amortization
@@ -2154,15 +2173,21 @@ def compact_record(full: dict) -> dict:
             # chunked (decode-interleaved) prefill
             gm = gx.get("monolithic") or {}
             gc = gx.get("chunked") or {}
-            c["gen"]["prefix_cold_ttft"] = gm.get("ttft_cold_p50_ms")
-            c["gen"]["prefix_warm_ttft"] = gm.get("ttft_warm_p50_ms")
+            # byte-budget renames (PR 11 pays for gen.loop_ph the PR 9
+            # way): prefix_{cold,warm}_ttft -> prefix_{cold,warm},
+            # prefix_saved_tok -> prefix_saved, prefix_itl_p99[_ck] ->
+            # prefix_itl[_ck]; tp_widths/tp_ttft_p50/tp_itl_p99/
+            # tp_identical/tp_recompiles -> tp_w/tp_ttft/tp_itl/tp_ident/
+            # tp_rc (full names stay in the detail record)
+            c["gen"]["prefix_cold"] = gm.get("ttft_cold_p50_ms")
+            c["gen"]["prefix_warm"] = gm.get("ttft_warm_p50_ms")
             c["gen"]["prefix_ttft_speedup"] = gx.get("warm_ttft_speedup")
             c["gen"]["prefix_hit_rate"] = gm.get("hit_rate")
-            c["gen"]["prefix_saved_tok"] = gm.get("prefill_tokens_saved")
+            c["gen"]["prefix_saved"] = gm.get("prefill_tokens_saved")
             c["gen"]["prefix_tok_s"] = gm.get("tokens_per_sec")
             c["gen"]["prefix_tok_s_ck"] = gc.get("tokens_per_sec")
-            c["gen"]["prefix_itl_p99"] = gm.get("inter_token_p99_ms")
-            c["gen"]["prefix_itl_p99_ck"] = gc.get("inter_token_p99_ms")
+            c["gen"]["prefix_itl"] = gm.get("inter_token_p99_ms")
+            c["gen"]["prefix_itl_ck"] = gc.get("inter_token_p99_ms")
         gpp = gen.get("paged") or {}
         if gpp:
             gf = gpp.get("fp") or {}
@@ -2181,14 +2206,14 @@ def compact_record(full: dict) -> dict:
             # speedup of the widest leg vs tp=1, and the identity +
             # zero-recompile contracts as recorded facts
             widths = (gt.get("scenario") or {}).get("widths") or []
-            c["gen"]["tp_widths"] = widths
+            c["gen"]["tp_w"] = widths
             c["gen"]["tp_tok_s"] = [
                 (gt.get(f"tp{w}") or {}).get("tokens_per_sec") for w in widths
             ]
-            c["gen"]["tp_ttft_p50"] = [
+            c["gen"]["tp_ttft"] = [
                 (gt.get(f"tp{w}") or {}).get("ttft_p50_ms") for w in widths
             ]
-            c["gen"]["tp_itl_p99"] = [
+            c["gen"]["tp_itl"] = [
                 (gt.get(f"tp{w}") or {}).get("inter_token_p99_ms") for w in widths
             ]
             wide = max((w for w in widths if w > 1), default=0)
@@ -2196,10 +2221,10 @@ def compact_record(full: dict) -> dict:
                 c["gen"]["tp_speedup"] = (gt.get(f"tp{wide}") or {}).get(
                     "speedup_vs_tp1"
                 )
-                c["gen"]["tp_identical"] = (gt.get(f"tp{wide}") or {}).get(
+                c["gen"]["tp_ident"] = (gt.get(f"tp{wide}") or {}).get(
                     "outputs_identical_to_tp1"
                 )
-            c["gen"]["tp_recompiles"] = [
+            c["gen"]["tp_rc"] = [
                 (gt.get(f"tp{w}") or {}).get("recompiles_after_warmup")
                 for w in widths
             ]

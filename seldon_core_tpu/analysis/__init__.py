@@ -15,6 +15,9 @@ modules grow. This package turns those invariants into review-time checks:
 - ``registry_drift`` (RD*): owned env names read outside utils/env.py,
   ``seldon_tpu_*`` metric names minted outside metrics/registry.py, and
   TpuSpec knobs with no graph/validation.py rule.
+- ``phase_registry`` (PH*): every ``_timed_call``/``_phase`` site names a
+  registered ``F_*``/``P_*`` flight constant, and every registered
+  constant is consumed by at least one instrumentation site.
 - ``ladder``        (LC*): every fused program handle / bucket ladder used
   at a dispatch site must be warmed by ``warmup()`` and (for programs)
   reported by ``compile_counts()``.

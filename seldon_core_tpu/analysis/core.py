@@ -175,6 +175,7 @@ def _passes():
     from seldon_core_tpu.analysis import (  # noqa: PLC0415
         commit_point,
         ladder,
+        phase_registry,
         registry_drift,
         trace_safety,
     )
@@ -183,6 +184,7 @@ def _passes():
         trace_safety.TraceSafetyPass(),
         commit_point.CommitPointPass(),
         registry_drift.RegistryDriftPass(),
+        phase_registry.PhaseRegistryPass(),
         ladder.LadderCoveragePass(),
     ]
 

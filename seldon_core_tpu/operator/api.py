@@ -10,10 +10,13 @@ seldondeployments) so tooling written against the CRD path maps 1:1.
 from __future__ import annotations
 
 import json
+import logging
 
 from aiohttp import web
 
 from seldon_core_tpu.operator.reconciler import DeploymentManager
+
+log = logging.getLogger(__name__)
 
 BASE = "/apis/machinelearning.seldon.io/v1alpha1/seldondeployments"
 
@@ -183,22 +186,47 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
     # serve live data DURING a bench/soak run. GET /decode/flight returns
     # recent frames + windowed aggregates (?n= frames, ?window= aggregate
     # span, ?name= one deployment); GET /decode/health the O(1) per-
-    # deployment health summaries (occupancy, bubble fraction, goodput,
-    # SLO attainment, blocked-admission causes).
+    # deployment health summaries (occupancy, bubble fraction, the top
+    # gap-phase contributor, goodput, SLO attainment, blocked-admission
+    # causes). Query validation contract (shared with /decode/profile):
+    # a present-but-malformed ?n/?window/?hz is a 400 with a parseable
+    # {"error", "param", "got"} body, never a 500 or a silent default —
+    # a dashboard polling with a typo'd range must see its own bug.
+    def _query_int(request: web.Request, key: str):
+        """(value, error_response): value None when absent; error set when
+        the param is present but not a positive integer."""
+        raw = request.query.get(key)
+        if raw is None:
+            return None, None
+        try:
+            value = int(raw)
+        except (TypeError, ValueError):
+            value = 0
+        if value < 1:
+            return None, web.json_response(
+                {
+                    "error": f"?{key} must be a positive integer",
+                    "param": key,
+                    "got": raw,
+                },
+                status=400,
+            )
+        return value, None
+
     async def decode_flight(request: web.Request) -> web.Response:
         from seldon_core_tpu.telemetry import flight as flight_mod
 
-        def _int(key: str, default: int) -> int:
-            try:
-                return int(request.query.get(key, default))
-            except (TypeError, ValueError):
-                return default
-
+        n, err = _query_int(request, "n")
+        if err is not None:
+            return err
+        window, err = _query_int(request, "window")
+        if err is not None:
+            return err
         return web.json_response(
             flight_mod.flight_report(
-                n=_int("n", 64),
+                n=n if n is not None else 64,
                 name=request.query.get("name"),
-                window=_int("window", 0),
+                window=window if window is not None else 0,
             )
         )
 
@@ -206,6 +234,31 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
         from seldon_core_tpu.telemetry import flight as flight_mod
 
         return web.json_response(flight_mod.health_report())
+
+    # decode-loop sampling profiler read-out (telemetry/profile.py): the
+    # always-on low-rate folded-stack sampler over the decode loop's
+    # thread. ?n= caps the top self-time frame list; ?hz= retunes the
+    # sampling rate live (clamped at the profiler's ceiling) — both
+    # validated like the flight queries above.
+    async def decode_profile(request: web.Request) -> web.Response:
+        from seldon_core_tpu.telemetry import profile as profile_mod
+
+        n, err = _query_int(request, "n")
+        if err is not None:
+            return err
+        hz, err = _query_int(request, "hz")
+        if err is not None:
+            return err
+        prof = profile_mod.get_profiler()
+        if hz is not None:
+            # the retune persists for the process (the report always shows
+            # the live rate); cap what a GET can request well below the
+            # profiler's own ceiling so a cached/prefetched link cannot
+            # silently turn the always-on sampler hot, and log every
+            # retune so a silent DE-tune (hz=1) leaves an operator trail
+            effective = prof.set_hz(min(hz, 200))
+            log.info("decode profiler retuned to %s Hz via GET /decode/profile", effective)
+        return web.json_response(prof.report(n=n if n is not None else 30))
 
     app.router.add_post(BASE, apply_dep)
     app.router.add_put(BASE, apply_dep)
@@ -216,5 +269,6 @@ def add_operator_routes(app: web.Application, manager: DeploymentManager) -> Non
     app.router.add_get("/traces/{id}", get_trace)
     app.router.add_get("/decode/flight", decode_flight)
     app.router.add_get("/decode/health", decode_health)
+    app.router.add_get("/decode/profile", decode_profile)
     app.router.add_post("/profiler/start", profiler_start)
     app.router.add_post("/profiler/stop", profiler_stop)

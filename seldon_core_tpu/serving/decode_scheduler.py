@@ -101,14 +101,25 @@ from seldon_core_tpu.core.message import Meta, SeldonMessage
 from seldon_core_tpu.engine.resilience import current_deadline
 from seldon_core_tpu.metrics import NullMetrics
 from seldon_core_tpu import telemetry
+from seldon_core_tpu.telemetry import profile as profile_mod
 from seldon_core_tpu.telemetry.flight import (
     F_CHUNK,
     F_COPY,
     F_DRAFT,
     F_STEP,
     F_VERIFY,
+    P_ACCEPT_WALK,
+    P_ADMIT,
+    P_ALLOC,
+    P_COMMIT,
+    P_EMIT_SLO,
+    P_PREFIX_MATCH,
+    P_SAMPLING,
+    P_SCATTER,
     FlightFrame,
     FlightRecorder,
+    PhaseTimer,
+    sync_timing_enabled,
 )
 from seldon_core_tpu.telemetry.flight import register as flight_register
 from seldon_core_tpu.models.decoder import (
@@ -892,6 +903,17 @@ class DecodeScheduler:
                 slo_itl_ms=float(slo_itl_ms),
             )
         )
+        # per-round host-phase timer (telemetry/flight.py PHASES): every
+        # host segment of the loop runs under `with self._phase(P_X):` so
+        # the frame's gap decomposes into admission / alloc / scatter /
+        # emission / accept-walk / sampling / commit — the measurement the
+        # pipelined-decode ROADMAP item is designed against. Rides the
+        # flight kill switch (disabled timer = shared no-op handles).
+        self._phases = PhaseTimer(enabled=self.flight.enabled)
+        # ENGINE_FLIGHT_SYNC_TIMING=on: block on every dispatch so the
+        # per-family flight columns are ground-truth device wall
+        # (calibration runs — throughput pays the pipeline stall)
+        self._sync_timing = sync_timing_enabled()
         self._round_reset()
 
     def _commit_kv(self, params, arrs):
@@ -1132,7 +1154,13 @@ class DecodeScheduler:
             self._task = asyncio.ensure_future(self._run())
 
     def _emit(self, seq: _Seq, tok: int) -> None:
-        """Record one generated token: stream it, time it."""
+        """Record one generated token: stream it, time it. Runs under the
+        emit/SLO phase — inside the accept/sampling walks the inner phase
+        wins, so emission cost reads apart from the walk around it."""
+        with self._phase(P_EMIT_SLO):
+            self._emit_inner(seq, tok)
+
+    def _emit_inner(self, seq: _Seq, tok: int) -> None:
         now = time.perf_counter()
         seq.tokens.append(tok)
         if len(seq.tokens) == 1:
@@ -1358,6 +1386,8 @@ class DecodeScheduler:
         """Reset the per-round flight accumulators (one set of plain int
         attrs — written on the hot path, read only at _commit_round)."""
         self._rb_busy = [0, 0, 0, 0, 0]  # ns per flight.FAMILIES entry
+        self._rb_rdb = [0, 0, 0, 0, 0]  # blocked-readback share of busy
+        self._rb_mark_ns = 0
         self._rb_t0 = t_ns if t_ns is not None else time.perf_counter_ns()
         self._rb_admitted = 0
         self._rb_retired = 0
@@ -1368,15 +1398,41 @@ class DecodeScheduler:
         self._rb_proposed = 0
         self._rb_depth = 0
         self._rb_active = 0
+        self._phases.reset()
+
+    def _phase(self, p: int):
+        """The round's host-phase ``with`` handle for a flight P_*
+        constant (telemetry/flight.PhaseTimer — innermost-phase
+        attribution, no-op under the flight kill switch). Never hold a
+        phase across a device dispatch: busy time is _timed_call's."""
+        return self._phases.phase(p)
+
+    def _mark_enqueued(self) -> None:
+        """Called by the _do_* dispatch closures at the enqueue->readback
+        boundary: after the fused program call returned its (async)
+        arrays, before the blocking np.asarray / host-transfer wait.
+        _timed_call splits the family's wall around this mark, so the
+        draft stops masquerading as free and the verify column stops
+        silently absorbing the whole round pair's wait. No mark = the
+        whole call counts as enqueue (the copy ladder reads nothing
+        back). Under ENGINE_FLIGHT_SYNC_TIMING the closures block on the
+        dispatch first, making the enqueue column ground-truth device
+        wall."""
+        self._rb_mark_ns = time.perf_counter_ns()
 
     async def _timed_call(self, family: int, fn):
         """_device_call with the dispatch's wall time attributed to one
-        fused program family in the current round's flight frame."""
+        fused program family in the current round's flight frame, split
+        enqueue vs blocked readback at the closure's _mark_enqueued()."""
         t0 = time.perf_counter_ns()
+        self._rb_mark_ns = 0
         try:
             return await self._device_call(fn)
         finally:
-            self._rb_busy[family] += time.perf_counter_ns() - t0
+            t2 = time.perf_counter_ns()
+            mark = self._rb_mark_ns or t2
+            self._rb_busy[family] += t2 - t0
+            self._rb_rdb[family] += t2 - mark
 
     def _commit_round(self, mode: str, *, step: bool) -> None:
         """THE single per-round commit point: round stats, prometheus round
@@ -1386,11 +1442,21 @@ class DecodeScheduler:
         marks rounds that ran a decode/verify dispatch; chunk-only rounds
         keep stat_steps' historical meaning (decode steps, not prefill
         rounds) but still record a frame."""
+        t_c0 = time.perf_counter_ns()
         active = self._rb_active if step else self.active
         if step:
             self.stat_steps += 1
             self.stat_occupancy_sum += active / self.n_slots
             self._metrics.decode_step(self._deployment, active, self.n_slots)
+        # freeze the phase array BEFORE the round clock stops so the
+        # commit phase (this function's own cost so far) stays inside the
+        # gap it is attributed to — sum(phase_ns) <= gap_ns by
+        # construction; the frame build below lands in the next round
+        phase_ns = (
+            self._phases.commit(P_COMMIT, t_c0)
+            if self.flight.enabled
+            else ()
+        )
         now_ns = time.perf_counter_ns()
         busy = sum(self._rb_busy)
         gap = max(now_ns - self._rb_t0 - busy, 0)
@@ -1408,7 +1474,7 @@ class DecodeScheduler:
                     self._rb_blocked, self._rb_tokens, self._rb_accepted,
                     self._rb_proposed, self._rb_depth, tuple(self._rb_busy),
                     gap, snap["free"], snap["live"], snap["prefix"],
-                    self._rb_cow,
+                    self._rb_cow, phase_ns, tuple(self._rb_rdb),
                 )
             )
         self._metrics.decode_round(self._deployment, busy / 1e9, gap / 1e9)
@@ -1453,7 +1519,8 @@ class DecodeScheduler:
             slot = self._free[-1]
             entry, reuse = None, 0
             if self.prefix_enabled:
-                entry, depth = self._prefix_index.match(seq.prompt)
+                with self._phase(P_PREFIX_MATCH):
+                    entry, depth = self._prefix_index.match(seq.prompt)
                 # always leave >= 1 suffix token: the last prompt
                 # position's logits are the first generated token's
                 # distribution
@@ -1470,9 +1537,11 @@ class DecodeScheduler:
                 alloc = self.pool.alloc
                 hint_end = alloc.pages_for(seq.cache_prefix) * alloc.page_size
                 extra = 1 if hint_end > self.seq_len else 0
-            if not self.pool.alloc.try_admit(
-                slot, entry.pages if entry is not None else (), reuse, extra
-            ):
+            with self._phase(P_ALLOC):
+                admitted = self.pool.alloc.try_admit(
+                    slot, entry.pages if entry is not None else (), reuse, extra
+                )
+            if not admitted:
                 self.stat_admit_blocked_rounds += 1
                 self._rb_blocked = "pages"
                 break
@@ -1559,39 +1628,41 @@ class DecodeScheduler:
         and transition to generating — decode steps for running slots
         interleave between rounds instead of stalling behind a monolithic
         wave prefill."""
-        counts = np.zeros(self.n_slots, np.int32)
-        need = 0
-        for i, seq in enumerate(self._slots):
-            if seq is None or not seq.prefilling:
-                continue
-            if seq.future.cancelled():
-                self._retire(i)
-                continue
-            rem = self.seq_len - seq.prefill_pos
-            counts[i] = min(rem, seq.chunk_cap or rem)
-            need = max(need, int(counts[i]))
-        if need == 0:
-            return
-        bucket = next(b for b in self.chunk_buckets if b >= need)
-        ids = np.zeros((self.n_slots, bucket), np.int32)
-        pos = np.zeros(self.n_slots, np.int32)
-        temps = np.zeros(self.n_slots, np.float32)
-        topks = np.zeros(self.n_slots, np.int32)
-        counts = np.minimum(counts, bucket)
-        copies: list[tuple[int, int]] = []
-        for i, seq in enumerate(self._slots):
-            if counts[i] == 0 or seq is None:
-                continue
-            ids[i, : counts[i]] = seq.prompt[seq.prefill_pos : seq.prefill_pos + counts[i]]
-            pos[i] = seq.prefill_pos
-            temps[i] = seq.temperature
-            topks[i] = seq.top_k
-            # page residency for this slot's write range: allocate fresh
-            # pages, copy-on-write the shared boundary page (the reader's
-            # first divergent write into a prefix-mapped page)
-            copies += self.pool.alloc.prepare_write(i, int(pos[i]), int(counts[i]))
+        with self._phase(P_ALLOC):
+            counts = np.zeros(self.n_slots, np.int32)
+            need = 0
+            for i, seq in enumerate(self._slots):
+                if seq is None or not seq.prefilling:
+                    continue
+                if seq.future.cancelled():
+                    self._retire(i)
+                    continue
+                rem = self.seq_len - seq.prefill_pos
+                counts[i] = min(rem, seq.chunk_cap or rem)
+                need = max(need, int(counts[i]))
+            if need == 0:
+                return
+            bucket = next(b for b in self.chunk_buckets if b >= need)
+            ids = np.zeros((self.n_slots, bucket), np.int32)
+            pos = np.zeros(self.n_slots, np.int32)
+            temps = np.zeros(self.n_slots, np.float32)
+            topks = np.zeros(self.n_slots, np.int32)
+            counts = np.minimum(counts, bucket)
+            copies: list[tuple[int, int]] = []
+            for i, seq in enumerate(self._slots):
+                if counts[i] == 0 or seq is None:
+                    continue
+                ids[i, : counts[i]] = seq.prompt[seq.prefill_pos : seq.prefill_pos + counts[i]]
+                pos[i] = seq.prefill_pos
+                temps[i] = seq.temperature
+                topks[i] = seq.top_k
+                # page residency for this slot's write range: allocate fresh
+                # pages, copy-on-write the shared boundary page (the reader's
+                # first divergent write into a prefix-mapped page)
+                copies += self.pool.alloc.prepare_write(i, int(pos[i]), int(counts[i]))
         await self._run_copies(copies)
-        bt = self.pool.block_tables()
+        with self._phase(P_ALLOC):
+            bt = self.pool.block_tables()
         tick = self._next_tick()
 
         def _do_chunk():
@@ -1599,6 +1670,9 @@ class DecodeScheduler:
                 self.params, self.pool.state, bt, ids, pos, counts, temps,
                 topks, self._seed, tick,
             )
+            if self._sync_timing:
+                jax.block_until_ready((toks, state))
+            self._mark_enqueued()
             return np.asarray(toks), state
 
         t0 = telemetry.now_ns()
@@ -1606,25 +1680,26 @@ class DecodeScheduler:
         t1 = telemetry.now_ns()
         self.stat_chunk_dispatches += 1
         finishing: list[tuple[_Seq, int]] = []
-        for i, seq in enumerate(list(self._slots)):
-            if seq is None or counts[i] == 0:
-                continue
-            seq.prefill_pos += int(counts[i])
-            for c in seq.trace_ctxs:
-                cs = c.buf.begin(
-                    "decode.prefill_chunk",
-                    c.span.span_id,
-                    {
-                        "slot": i, "chunk": seq.chunk_idx,
-                        "tokens": int(counts[i]), "bucket": bucket,
-                        "reused": seq.prefix_len,
-                    },
-                    start_ns=t0,
-                )
-                cs.end(t1)
-            seq.chunk_idx += 1
-            if seq.prefill_pos >= self.seq_len:
-                finishing.append((seq, i))
+        with self._phase(P_SCATTER):
+            for i, seq in enumerate(list(self._slots)):
+                if seq is None or counts[i] == 0:
+                    continue
+                seq.prefill_pos += int(counts[i])
+                for c in seq.trace_ctxs:
+                    cs = c.buf.begin(
+                        "decode.prefill_chunk",
+                        c.span.span_id,
+                        {
+                            "slot": i, "chunk": seq.chunk_idx,
+                            "tokens": int(counts[i]), "bucket": bucket,
+                            "reused": seq.prefix_len,
+                        },
+                        start_ns=t0,
+                    )
+                    cs.end(t1)
+                seq.chunk_idx += 1
+                if seq.prefill_pos >= self.seq_len:
+                    finishing.append((seq, i))
         if finishing and self.spec_enabled:
             td = time.perf_counter_ns()
             self._draft_admit([i for _, i in finishing])
@@ -1632,26 +1707,27 @@ class DecodeScheduler:
             # in the next dispatch's blocked readback
             self._rb_busy[F_DRAFT] += time.perf_counter_ns() - td
         t2 = telemetry.now_ns()
-        for seq, i in finishing:
-            seq.prefilling = False
-            seq.pos = self.seq_len
-            if self.prefix_enabled and seq.cache_prefix > 0:
-                # hinted capture at prefill completion — the hinted span's
-                # pages are pinned from this moment, so the very next
-                # admission can already map them
-                self._maybe_capture(seq, i, seq.cache_prefix)
-            for c in seq.trace_ctxs:
-                seq.gen_spans.append(
-                    c.buf.begin(
-                        "decode.generate",
-                        c.span.span_id,
-                        {"slot": i, **self._mesh_attrs},
-                        start_ns=t2,
+        with self._phase(P_SCATTER):
+            for seq, i in finishing:
+                seq.prefilling = False
+                seq.pos = self.seq_len
+                if self.prefix_enabled and seq.cache_prefix > 0:
+                    # hinted capture at prefill completion — the hinted
+                    # span's pages are pinned from this moment, so the very
+                    # next admission can already map them
+                    self._maybe_capture(seq, i, seq.cache_prefix)
+                for c in seq.trace_ctxs:
+                    seq.gen_spans.append(
+                        c.buf.begin(
+                            "decode.generate",
+                            c.span.span_id,
+                            {"slot": i, **self._mesh_attrs},
+                            start_ns=t2,
+                        )
                     )
-                )
-            self._emit(seq, int(toks[i]))
-            if self._finished(seq, int(toks[i])):
-                self._retire(i)
+                self._emit(seq, int(toks[i]))
+                if self._finished(seq, int(toks[i])):
+                    self._retire(i)
 
     async def _spec_round(self, bt, toks, pos, temps, topks, limits, wlimits, tick) -> None:
         """One speculative round: ONE draft dispatch proposes spec_k
@@ -1670,15 +1746,20 @@ class DecodeScheduler:
 
         def _do_spec():
             # the draft/verify wall split feeds the flight frame's per-
-            # family attribution: with async dispatch the draft segment is
-            # the host-side dispatch cost and the verify segment carries
-            # the blocked readback of the whole round pair
+            # family attribution, the verify side split again into enqueue
+            # vs blocked readback: with async dispatch the draft and
+            # verify-enqueue segments are host-side dispatch cost and the
+            # verify readback carries the blocked wait of the whole round
+            # pair. ENGINE_FLIGHT_SYNC_TIMING blocks after each program so
+            # both columns become ground-truth per-dispatch device wall.
             td0 = time.perf_counter_ns()
             if tree is not None:
                 node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
                     self.draft_params, self._dck, self._dcv, toks, pos, temps,
                     topks, self._seed, tick, tree,
                 )
+                if self._sync_timing:
+                    jax.block_until_ready(node_toks)
                 td1 = time.perf_counter_ns()
                 out_t, acc, state, dck, dcv = self._tree_verify_fn(
                     self.params, self.pool.state, bt, toks, node_toks, blogits,
@@ -1690,22 +1771,28 @@ class DecodeScheduler:
                     self.draft_params, self._dck, self._dcv, toks, pos, temps,
                     topks, self._seed, tick, self.spec_k,
                 )
+                if self._sync_timing:
+                    jax.block_until_ready(drafts)
                 td1 = time.perf_counter_ns()
                 out_t, acc, state = self._verify_fn(
                     self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
                     limits, temps, topks, self._seed, tick,
                 )
+            if self._sync_timing:
+                jax.block_until_ready(out_t)
+            tv = time.perf_counter_ns()
             out_t, acc = np.asarray(out_t), np.asarray(acc)
             td2 = time.perf_counter_ns()
-            return out_t, acc, state, dck, dcv, td1 - td0, td2 - td1
+            return out_t, acc, state, dck, dcv, td1 - td0, tv - td1, td2 - tv
 
         t0 = telemetry.now_ns()
-        out_t, acc, self.pool.state, self._dck, self._dcv, d_ns, v_ns = (
+        out_t, acc, self.pool.state, self._dck, self._dcv, d_ns, v_enq, v_rdb = (
             await self._device_call(_do_spec)
         )
         t1 = telemetry.now_ns()
         self._rb_busy[F_DRAFT] += d_ns
-        self._rb_busy[F_VERIFY] += v_ns
+        self._rb_busy[F_VERIFY] += v_enq + v_rdb
+        self._rb_rdb[F_VERIFY] += v_rdb
         self.stat_spec_dispatches += 1
         # dispatch-time occupancy, committed (with steps/metrics) at the
         # round's single _commit_round point
@@ -1718,50 +1805,51 @@ class DecodeScheduler:
         accepted = int(acc.sum())  # limit-0 and free slots contribute 0
         emitted = 0
         mode = "chain" if tree is None else "tree"
-        for i, seq in enumerate(list(self._slots)):
-            if seq is None or seq.prefilling:
-                # prefilling slots ride the round at limit 0 with their
-                # junk landing at their own prefill cursor — no emission
-                continue
-            # one decode.verify span per round on the sequence's own
-            # trace(s), the accept count as an event — per-round, not
-            # per-token, so a k=4 generation adds ~len/5 spans. Tree
-            # rounds carry the tree shape + this slot's allowed node
-            # budget so traces explain the per-round speedup.
-            riding = int(limits[i]) > 0
-            attrs = {"slot": i, "proposed": int(limits[i]), **self._mesh_attrs}
-            if tree is not None:
-                nodes = int(wlimits[i].sum())
-                attrs["tree"] = self._tree_text
-                attrs["tree_nodes"] = nodes
-                if riding:
-                    # limit-0 slots (opt-outs, budget edges) would record
-                    # structural nodes=0 samples and skew the histogram
-                    self._metrics.decode_spec_tree(
-                        self._deployment, nodes, int(acc[i])
-                    )
-            for c in seq.trace_ctxs:
-                vs = c.buf.begin(
-                    "decode.verify", c.span.span_id, attrs, start_ns=t0
-                )
-                ev = {"accepted": int(acc[i])}
+        with self._phase(P_ACCEPT_WALK):
+            for i, seq in enumerate(list(self._slots)):
+                if seq is None or seq.prefilling:
+                    # prefilling slots ride the round at limit 0 with their
+                    # junk landing at their own prefill cursor — no emission
+                    continue
+                # one decode.verify span per round on the sequence's own
+                # trace(s), the accept count as an event — per-round, not
+                # per-token, so a k=4 generation adds ~len/5 spans. Tree
+                # rounds carry the tree shape + this slot's allowed node
+                # budget so traces explain the per-round speedup.
+                riding = int(limits[i]) > 0
+                attrs = {"slot": i, "proposed": int(limits[i]), **self._mesh_attrs}
                 if tree is not None:
-                    ev["path_depth"] = int(acc[i])
-                vs.add_event("accept", ev)
-                vs.end(t1)
-            for j in range(int(acc[i]) + 1):
-                tok = int(out_t[i, j])
-                seq.pos += 1
-                self._emit(seq, tok)
-                emitted += 1
-                if riding:
-                    # only tokens from slots that actually speculated count
-                    # toward the per-ride amortization — a limit-0 slot's
-                    # plain-equivalent token would inflate emitted/rides
-                    self.stat_spec_ride_emitted += 1
-                if self._finished(seq, tok):
-                    self._retire(i)
-                    break
+                    nodes = int(wlimits[i].sum())
+                    attrs["tree"] = self._tree_text
+                    attrs["tree_nodes"] = nodes
+                    if riding:
+                        # limit-0 slots (opt-outs, budget edges) would record
+                        # structural nodes=0 samples and skew the histogram
+                        self._metrics.decode_spec_tree(
+                            self._deployment, nodes, int(acc[i])
+                        )
+                for c in seq.trace_ctxs:
+                    vs = c.buf.begin(
+                        "decode.verify", c.span.span_id, attrs, start_ns=t0
+                    )
+                    ev = {"accepted": int(acc[i])}
+                    if tree is not None:
+                        ev["path_depth"] = int(acc[i])
+                    vs.add_event("accept", ev)
+                    vs.end(t1)
+                for j in range(int(acc[i]) + 1):
+                    tok = int(out_t[i, j])
+                    seq.pos += 1
+                    self._emit(seq, tok)
+                    emitted += 1
+                    if riding:
+                        # only tokens from slots that actually speculated count
+                        # toward the per-ride amortization — a limit-0 slot's
+                        # plain-equivalent token would inflate emitted/rides
+                        self.stat_spec_ride_emitted += 1
+                    if self._finished(seq, tok):
+                        self._retire(i)
+                        break
         self.stat_spec_proposed += proposed
         self.stat_spec_accepted += accepted
         self.stat_spec_emitted += emitted
@@ -1776,12 +1864,20 @@ class DecodeScheduler:
 
     async def _run(self) -> None:
         try:
+            # register this loop's thread with the process-global sampling
+            # profiler (telemetry/profile.py — GET /decode/profile); a
+            # no-op under ENGINE_DECODE_PROFILE=off
+            profile_mod.watch_decode_thread()
             # the round clock starts when the LOOP does: everything between
             # __init__ and the first submit (warmup compiles, idle boot
             # time) is not decode bubble and must not land in frame 0's gap
             self._round_reset()
             while True:
-                await self._admit()
+                # _admit is async-shaped but never suspends (pure host
+                # work), so the phase handle held across the await times
+                # exactly the admission walk
+                with self._phase(P_ADMIT):
+                    await self._admit()
                 if self.active == 0:
                     if not self._waiting:
                         if self._closed:
@@ -1799,31 +1895,36 @@ class DecodeScheduler:
                 # admission wave prefills in one top-bucket dispatch)
                 await self._chunk_round()
 
-                toks = np.zeros(self.n_slots, np.int32)
-                pos = np.zeros(self.n_slots, np.int32)
-                temps = np.zeros(self.n_slots, np.float32)
-                topks = np.zeros(self.n_slots, np.int32)
-                n_gen = 0
-                for i, seq in enumerate(self._slots):
-                    if seq is None:
-                        continue
-                    if seq.future.cancelled():
-                        # client vanished mid-generation (stream closed):
-                        # free the slot instead of decoding its full budget
-                        self._retire(i)
-                        continue
-                    if seq.prefilling:
-                        # still mid-prefill: ride the step like a free slot
-                        # but park the junk write at the slot's own prefill
-                        # cursor, where the next chunk overwrites it before
-                        # any attention mask can reach it
-                        pos[i] = seq.prefill_pos
-                        continue
-                    toks[i] = seq.tokens[-1]
-                    pos[i] = seq.pos
-                    temps[i] = seq.temperature
-                    topks[i] = seq.top_k
-                    n_gen += 1
+                with self._phase(P_SAMPLING):
+                    # next-dispatch input build: the sampled-token /
+                    # position vectors every generating slot rides
+                    toks = np.zeros(self.n_slots, np.int32)
+                    pos = np.zeros(self.n_slots, np.int32)
+                    temps = np.zeros(self.n_slots, np.float32)
+                    topks = np.zeros(self.n_slots, np.int32)
+                    n_gen = 0
+                    for i, seq in enumerate(self._slots):
+                        if seq is None:
+                            continue
+                        if seq.future.cancelled():
+                            # client vanished mid-generation (stream
+                            # closed): free the slot instead of decoding
+                            # its full budget
+                            self._retire(i)
+                            continue
+                        if seq.prefilling:
+                            # still mid-prefill: ride the step like a free
+                            # slot but park the junk write at the slot's
+                            # own prefill cursor, where the next chunk
+                            # overwrites it before any attention mask can
+                            # reach it
+                            pos[i] = seq.prefill_pos
+                            continue
+                        toks[i] = seq.tokens[-1]
+                        pos[i] = seq.pos
+                        temps[i] = seq.temperature
+                        topks[i] = seq.top_k
+                        n_gen += 1
                 if self.active == 0:
                     # chunk round retired everyone (EOS at prompt end,
                     # cancellations): commit the round's frame without a
@@ -1893,15 +1994,17 @@ class DecodeScheduler:
                 # already-owned pages or the junk sink.
                 width = self.spec_k + 1 if spec_round else 1
                 copies: list[tuple[int, int]] = []
-                for i, seq in enumerate(self._slots):
-                    if seq is None or seq.prefilling:
-                        continue
-                    copies += self.pool.alloc.prepare_write(i, seq.pos, width)
+                with self._phase(P_ALLOC):
+                    for i, seq in enumerate(self._slots):
+                        if seq is None or seq.prefilling:
+                            continue
+                        copies += self.pool.alloc.prepare_write(i, seq.pos, width)
                 await self._run_copies(copies)
-                bt = self.pool.block_tables()
-                # per-round pool gauges: this round's prepare_write may
-                # have allocated/CoW'd pages with no admission in between
-                self._kv_gauges()
+                with self._phase(P_ALLOC):
+                    bt = self.pool.block_tables()
+                    # per-round pool gauges: this round's prepare_write may
+                    # have allocated/CoW'd pages with no admission between
+                    self._kv_gauges()
 
                 if spec_round:
                     await self._spec_round(
@@ -1919,18 +2022,24 @@ class DecodeScheduler:
                         self.params, self.pool.state, bt, toks, pos, temps,
                         topks, self._seed, tick,
                     )
+                    if self._sync_timing:
+                        jax.block_until_ready((nxt, state))
+                    self._mark_enqueued()
                     return np.asarray(nxt), state
 
                 nxt, self.pool.state = await self._timed_call(F_STEP, _do_step)
                 self._rb_active = self.active  # dispatch-time occupancy
-                for i, seq in enumerate(self._slots):
-                    if seq is None or seq.prefilling:
-                        continue
-                    tok = int(nxt[i])
-                    seq.pos += 1
-                    self._emit(seq, tok)
-                    if self._finished(seq, tok):
-                        self._retire(i)
+                with self._phase(P_SAMPLING):
+                    # sampled-token consumption: the readback array walked
+                    # into per-slot emissions/retirements
+                    for i, seq in enumerate(self._slots):
+                        if seq is None or seq.prefilling:
+                            continue
+                        tok = int(nxt[i])
+                        seq.pos += 1
+                        self._emit(seq, tok)
+                        if self._finished(seq, tok):
+                            self._retire(i)
                 self._commit_round("plain", step=True)
                 # yield between steps so admissions/ingress interleave with
                 # the decode loop instead of starving behind it

@@ -9,8 +9,11 @@ The pieces:
 - tracer.py   — request lifecycle + the process-global tracer
 - export.py   — optional OTLP-JSON file export
 - access_log.py — env-gated structured JSON access logs
-- flight.py   — decode-loop flight recorder (per-round ring, goodput/SLO
-                counters, /decode/flight + /decode/health registry)
+- flight.py   — decode-loop flight recorder (per-round ring, host-phase +
+                enqueue/readback attribution, goodput/SLO counters,
+                /decode/flight + /decode/health registry)
+- profile.py  — always-on low-rate decode-loop sampling profiler
+                (bounded folded-stack table, GET /decode/profile)
 
 Servers open an ingress root span per request (serving/service.py), the
 executor/batcher/decode-scheduler record spans through the contextvar, the

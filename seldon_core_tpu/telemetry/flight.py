@@ -17,9 +17,18 @@ to a bounded ring —
   adaptive controller chose;
 - per-dispatch wall time split **device-busy vs host-gap** ("bubble"), the
   busy side attributed per fused program family
-  (``chunk``/``step``/``draft``/``verify``/``copy``) — on async-dispatch
-  backends the draft column is the host-side dispatch cost and the verify
-  column carries the blocked readback of the whole round pair;
+  (``chunk``/``step``/``draft``/``verify``/``copy``) and split again into
+  **enqueue vs blocked readback** per family (``rdb_ns``), so on
+  async-dispatch backends the draft no longer masquerades as free and the
+  verify column no longer absorbs the whole round pair's wait;
+  ``ENGINE_FLIGHT_SYNC_TIMING=on`` forces per-dispatch completion for
+  ground-truth calibration runs;
+- the host gap attributed per **phase** (``PHASES`` / ``P_*``: admission
+  incl. prefix match and allocator reservation, chunk-result scatter, the
+  emission/SLO walk, the spec accept walk, the sampled-token walk, the
+  round commit itself) via the scheduler's ``with self._phase(P_X):``
+  blocks over a :class:`PhaseTimer` — the decomposition a pipelined
+  decode loop is designed against;
 - the page pool's free/live/prefix page counts and the round's CoW copies.
 
 Append is O(1) (one ``__slots__`` object + a ring store + a handful of
@@ -52,12 +61,44 @@ import os
 import time
 
 
-from seldon_core_tpu.utils.env import ENGINE_FLIGHT, ENGINE_FLIGHT_FRAMES
+from seldon_core_tpu.utils.env import (
+    ENGINE_FLIGHT,
+    ENGINE_FLIGHT_FRAMES,
+    ENGINE_FLIGHT_SYNC_TIMING,
+)
 
 # fused program families a round's device-busy time is attributed to; the
 # indices are the positions in FlightFrame.busy_ns
 FAMILIES = ("chunk", "step", "draft", "verify", "copy")
 F_CHUNK, F_STEP, F_DRAFT, F_VERIFY, F_COPY = range(5)
+
+# host phases a round's GAP is attributed to; the indices are the
+# positions in FlightFrame.phase_ns. The registry is held drift-free by
+# the PH001/PH002 lint rules (docs/linting.md): every timer site must
+# name one of these constants, and every constant must be instrumented.
+PHASES = (
+    "admit",  # admission walk: slot assignment, queue-timeout expiry
+    "prefix_match",  # PrefixIndex longest-common-prefix lookup
+    "alloc",  # PageAllocator reservation/prepare_write + block tables
+    "scatter",  # chunk-result scatter: prefill cursors, transitions
+    "emit_slo",  # _emit: streaming callback, TTFT/ITL + SLO judging
+    "accept_walk",  # spec accept/rollback walk over the verify readback
+    "sampling",  # plain-step sampled-token walk (readback consumption)
+    "commit",  # _commit_round itself: stats, metrics, frame build
+)
+(
+    P_ADMIT,
+    P_PREFIX_MATCH,
+    P_ALLOC,
+    P_SCATTER,
+    P_EMIT_SLO,
+    P_ACCEPT_WALK,
+    P_SAMPLING,
+    P_COMMIT,
+) = range(8)
+N_PHASES = len(PHASES)
+_ZERO_PHASES = (0,) * N_PHASES
+_ZERO_FAMILIES = (0,) * len(FAMILIES)
 
 _DEFAULT_CAPACITY = 2048
 # frames carried per auto-dump (span events are capped at
@@ -74,6 +115,18 @@ def flight_enabled(env: dict | None = None) -> bool:
     )
 
 
+def sync_timing_enabled(env: dict | None = None) -> bool:
+    """ENGINE_FLIGHT_SYNC_TIMING=on: force per-dispatch completion so each
+    family's flight column is ground-truth device wall (calibration runs;
+    default off — async dispatch stays pipelined)."""
+    env = env if env is not None else os.environ
+    return str(env.get(ENGINE_FLIGHT_SYNC_TIMING, "off")).strip().lower() in (
+        "on",
+        "1",
+        "true",
+    )
+
+
 def _env_capacity(env: dict | None = None) -> int:
     env = env if env is not None else os.environ
     try:
@@ -83,21 +136,126 @@ def _env_capacity(env: dict | None = None) -> int:
     return max(n, 16)
 
 
+class _PhaseCtx:
+    """Reusable ``with`` handle for one phase index (preallocated by the
+    timer — no per-entry allocation on the hot path)."""
+
+    __slots__ = ("timer", "p")
+
+    def __init__(self, timer: "PhaseTimer", p: int):
+        self.timer = timer
+        self.p = p
+
+    def __enter__(self):
+        t = self.timer
+        now = time.perf_counter_ns()
+        stack = t._stack
+        if stack:
+            t.ns[stack[-1]] += now - t._mark
+        stack.append(self.p)
+        t._mark = now
+        return self
+
+    def __exit__(self, *exc):
+        t = self.timer
+        now = time.perf_counter_ns()
+        if t._stack:
+            # a reset() issued while a phase is open (defensive: the
+            # scheduler never does) drops the span instead of raising
+            # into the decode loop
+            t.ns[t._stack.pop()] += now - t._mark
+        t._mark = now
+        return False
+
+
+class _NoopCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_CTX = _NoopCtx()
+
+
+class PhaseTimer:
+    """Per-round host-phase accumulator behind the scheduler's
+    ``with self._phase(P_X):`` blocks: a fixed ``ns`` array aligned with
+    PHASES, reset at ``_round_reset`` and frozen into each FlightFrame at
+    ``_commit_round``. Nested phases attribute to the INNERMOST phase
+    (self-time semantics — an ``_emit`` inside the accept walk counts as
+    ``emit_slo``, not twice), so phase sums stay <= the round's gap.
+    Disabled (the ENGINE_FLIGHT kill switch) every handle is a shared
+    no-op and the arrays stay zero."""
+
+    __slots__ = ("ns", "enabled", "_stack", "_mark", "_ctxs")
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self.ns = [0] * N_PHASES
+        self._stack: list[int] = []
+        self._mark = 0
+        self._ctxs = tuple(_PhaseCtx(self, p) for p in range(N_PHASES))
+
+    def phase(self, p: int):
+        """The ``with``-handle for phase ``p`` (a P_* constant)."""
+        if not self.enabled:
+            return _NOOP_CTX
+        return self._ctxs[p]
+
+    def reset(self) -> None:
+        self.ns = [0] * N_PHASES
+        self._stack.clear()
+
+    def commit(self, p: int, t0_ns: int) -> tuple:
+        """Attribute ``now - t0_ns`` to phase ``p`` (the commit point's own
+        cost) and return the frozen per-phase tuple for the frame (the
+        ~µs frame build/record after this call lands in the NEXT round's
+        gap unattributed — measured, documented, and far under the
+        recorder's own budget)."""
+        self.ns[p] += time.perf_counter_ns() - t0_ns
+        return tuple(self.ns)
+
+    @staticmethod
+    def measure_overhead(n: int = 2000, phases_per_round: int = 8) -> float:
+        """Measured per-round phase-timer cost in µs (``phases_per_round``
+        enter/exit pairs incl. one nested pair) — what PARITY.md documents
+        beside the frame-append cost and the tier-1 guard budgets."""
+        t = PhaseTimer(enabled=True)
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            for p in range(max(phases_per_round - 2, 1)):
+                with t.phase(p % N_PHASES):
+                    pass
+            with t.phase(P_ACCEPT_WALK):
+                with t.phase(P_EMIT_SLO):
+                    pass
+            t.reset()
+        return round((time.perf_counter_ns() - t0) / n / 1e3, 3)
+
+
 class FlightFrame:
     """One scheduler round, compact. ``busy_ns`` is a 5-tuple aligned with
-    FAMILIES; ``gap_ns`` the round's host bubble (wall - device busy)."""
+    FAMILIES (enqueue + blocked readback per family); ``rdb_ns`` the
+    blocked-readback share of each family (enqueue = busy - rdb);
+    ``phase_ns`` the host gap attributed per PHASES entry; ``gap_ns`` the
+    round's host bubble (wall - device busy)."""
 
     __slots__ = (
         "seq", "t_ns", "mode", "active", "prefilling", "queued",
         "admitted", "retired", "blocked", "tokens", "accepted", "proposed",
         "spec_depth", "busy_ns", "gap_ns", "kv_free", "kv_live",
-        "kv_prefix", "cow",
+        "kv_prefix", "cow", "phase_ns", "rdb_ns",
     )
 
     def __init__(
         self, seq, t_ns, mode, active, prefilling, queued, admitted,
         retired, blocked, tokens, accepted, proposed, spec_depth,
         busy_ns, gap_ns, kv_free, kv_live, kv_prefix, cow,
+        phase_ns=_ZERO_PHASES, rdb_ns=_ZERO_FAMILIES,
     ):
         self.seq = seq
         self.t_ns = t_ns
@@ -118,6 +276,8 @@ class FlightFrame:
         self.kv_live = kv_live
         self.kv_prefix = kv_prefix
         self.cow = cow
+        self.phase_ns = phase_ns
+        self.rdb_ns = rdb_ns
 
     def to_dict(self) -> dict:
         d: dict = {
@@ -136,6 +296,25 @@ class FlightFrame:
             "gap_us": round(self.gap_ns / 1e3, 1),
             "kv": [self.kv_free, self.kv_live, self.kv_prefix],
         }
+        if any(self.rdb_ns):
+            # enqueue/readback split per family: enq = busy - rdb; both
+            # emitted so a dump reads without arithmetic
+            d["enq_us"] = {
+                FAMILIES[i]: round((self.busy_ns[i] - ns) / 1e3, 1)
+                for i, ns in enumerate(self.rdb_ns)
+                if self.busy_ns[i]
+            }
+            d["rdb_us"] = {
+                FAMILIES[i]: round(ns / 1e3, 1)
+                for i, ns in enumerate(self.rdb_ns)
+                if ns
+            }
+        if any(self.phase_ns):
+            d["phase_us"] = {
+                PHASES[i]: round(ns / 1e3, 1)
+                for i, ns in enumerate(self.phase_ns)
+                if ns
+            }
         if self.admitted:
             d["admitted"] = self.admitted
         if self.retired:
@@ -182,6 +361,8 @@ class FlightRecorder:
         self._n = 0  # total frames ever recorded
         # O(1) running totals (the health read-out must not walk the ring)
         self.busy_ns_total = [0] * len(FAMILIES)
+        self.rdb_ns_total = [0] * len(FAMILIES)
+        self.phase_ns_total = [0] * N_PHASES
         self.gap_ns_total = 0
         self.tokens_total = 0
         self.occupancy_sum = 0.0
@@ -219,6 +400,12 @@ class FlightRecorder:
         busy = self.busy_ns_total
         for i, ns in enumerate(frame.busy_ns):
             busy[i] += ns
+        rdb = self.rdb_ns_total
+        for i, ns in enumerate(frame.rdb_ns):
+            rdb[i] += ns
+        ph = self.phase_ns_total
+        for i, ns in enumerate(frame.phase_ns):
+            ph[i] += ns
         self.gap_ns_total += frame.gap_ns
         self.tokens_total += frame.tokens
         self.occupancy_sum += frame.active / self.n_slots
@@ -290,6 +477,8 @@ class FlightRecorder:
         frames = self.snapshot(window)
         rounds = len(frames)
         busy = [0] * len(FAMILIES)
+        rdb = [0] * len(FAMILIES)
+        phase = [0] * N_PHASES
         gap = 0
         tokens = admitted = retired = accepted = proposed = 0
         occ = 0.0
@@ -299,6 +488,10 @@ class FlightRecorder:
         for f in frames:
             for i, ns in enumerate(f.busy_ns):
                 busy[i] += ns
+            for i, ns in enumerate(f.rdb_ns):
+                rdb[i] += ns
+            for i, ns in enumerate(f.phase_ns):
+                phase[i] += ns
             gap += f.gap_ns
             tokens += f.tokens
             admitted += f.admitted
@@ -323,6 +516,22 @@ class FlightRecorder:
             "busy_ms": {
                 FAMILIES[i]: round(ns / 1e6, 3) for i, ns in enumerate(busy) if ns
             },
+            # the enqueue/readback split of busy_ms: where each family's
+            # wall actually went on async-dispatch backends
+            "enqueue_ms": {
+                FAMILIES[i]: round((busy[i] - ns) / 1e6, 3)
+                for i, ns in enumerate(rdb)
+                if busy[i]
+            },
+            "readback_ms": {
+                FAMILIES[i]: round(ns / 1e6, 3) for i, ns in enumerate(rdb) if ns
+            },
+            # the host gap decomposed per phase — what a pipelined decode
+            # loop would overlap with the in-flight dispatch
+            "phase_ms": {
+                PHASES[i]: round(ns / 1e6, 3) for i, ns in enumerate(phase) if ns
+            },
+            "phase_of_gap": round(sum(phase) / gap, 4) if gap else 0.0,
             "gap_ms": round(gap / 1e6, 3),
             "bubble_fraction": round(gap / wall, 4) if wall else 0.0,
             "tokens": tokens,
@@ -345,6 +554,16 @@ class FlightRecorder:
         """Lifetime host-bubble fraction from the O(1) running totals."""
         wall = sum(self.busy_ns_total) + self.gap_ns_total
         return self.gap_ns_total / wall if wall else 0.0
+
+    def top_gap_phase(self) -> str:
+        """The phase carrying the most lifetime gap time (O(1) running
+        totals) — what /decode/health names as the bubble's top
+        contributor; '' before any phase was timed."""
+        total = sum(self.phase_ns_total)
+        if total == 0:
+            return ""
+        i = max(range(N_PHASES), key=lambda j: self.phase_ns_total[j])
+        return PHASES[i]
 
     def goodput(self) -> dict:
         """Goodput + SLO-attainment summary from the running counters."""
@@ -399,6 +618,14 @@ class FlightRecorder:
             "rounds": rounds,
             "occupancy_mean": round(self.occupancy_sum / rounds, 4) if rounds else 0.0,
             "bubble_fraction": round(self.bubble_fraction(), 4),
+            # the bubble's top contributor by lifetime phase totals, and
+            # how much of the gap the phase timers account for at all
+            "top_gap_phase": self.top_gap_phase(),
+            "phase_of_gap": (
+                round(sum(self.phase_ns_total) / self.gap_ns_total, 4)
+                if self.gap_ns_total
+                else 0.0
+            ),
             "tokens": self.tokens_total,
             "admitted": self.admitted_total,
             "retired": self.retired_total,
@@ -470,6 +697,8 @@ class FlightRecorder:
                 FlightFrame(
                     i, t0 + i, "plain", 7, 1, 3, 1, 1, "", 8, 4, 6, 3,
                     (0, 120_000, 40_000, 180_000, 0), 90_000, 5, 12, 4, 1,
+                    (12_000, 2_000, 8_000, 0, 30_000, 20_000, 0, 4_000),
+                    (0, 60_000, 0, 150_000, 0),
                 )
             )
         return round((time.perf_counter_ns() - t0) / n / 1e3, 3)

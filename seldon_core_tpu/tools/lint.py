@@ -48,7 +48,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m seldon_core_tpu.tools.lint",
         description="AST invariant linter: trace-safety, commit-point, "
-        "registry-drift, ladder-coverage (docs/linting.md)",
+        "registry-drift, phase-registry, ladder-coverage (docs/linting.md)",
     )
     ap.add_argument(
         "paths",

@@ -62,6 +62,7 @@ async def soak(
     prefix_share: float = 0.0,
     paged: bool = False,
     tp: int = 0,
+    profile_out: str = "",
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -366,9 +367,49 @@ async def soak(
             "occupancy_mean": fa["occupancy_mean"],
             "bubble_fraction": fa["bubble_fraction"],
             "busy_ms": fa["busy_ms"],
+            # the enqueue/readback split of busy_ms and the per-phase
+            # decomposition of gap_ms — the host-bubble attribution the
+            # pipelined-decode ROADMAP item spends, printed beside the
+            # aggregate exactly as GET /decode/flight serves it
+            "enqueue_ms": fa["enqueue_ms"],
+            "readback_ms": fa["readback_ms"],
+            "phase_ms": fa["phase_ms"],
+            "top_gap_phase": sched.flight.top_gap_phase(),
             "gap_ms": fa["gap_ms"],
             "blocked_rounds": fa["blocked_rounds"],
             "goodput": fa["goodput"],
+        }
+    profile_stats = None
+    if profile_out:
+        # --profile: the run must have exercised the decode loop AND the
+        # sampler must have caught it in the act at least once — a smoke
+        # gate that fails loudly instead of writing an empty file
+        from seldon_core_tpu.telemetry import profile as profile_mod
+
+        if not generative:
+            raise RuntimeError(
+                "soak --profile needs a generative leg (--spec-k/"
+                "--prefix-share/--paged/--tp) — the sampler targets the "
+                "decode loop's thread"
+            )
+        prof = profile_mod.get_profiler()
+        folded = prof.folded()
+        if prof.samples < 1 or not folded:
+            raise RuntimeError(
+                "soak --profile: the sampling profiler captured no decode-"
+                "loop stack (ENGINE_DECODE_PROFILE off? run shorter than "
+                f"one {prof.hz} Hz sampling tick?)"
+            )
+        with open(profile_out, "w") as f:
+            f.write("\n".join(folded) + "\n")
+        rep = prof.report(n=3)
+        profile_stats = {
+            "samples": rep["samples"],
+            "hz": rep["hz"],
+            "stacks": rep["table_entries"],
+            "truncated_samples": rep["truncated_samples"],
+            "folded_out": profile_out,
+            "top_self": [t["frame"] for t in rep["top"]],
         }
     prefix_stats = None
     if prefix_share > 0 and sched is not None:
@@ -411,6 +452,7 @@ async def soak(
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
         **({"flight": flight_stats} if flight_stats is not None else {}),
+        **({"profile": profile_stats} if profile_stats is not None else {}),
         **({"spec": spec_stats} if spec_stats is not None else {}),
         **({"prefix": prefix_stats} if prefix_stats is not None else {}),
         **({"paged": paged_stats} if paged_stats is not None else {}),
@@ -488,6 +530,15 @@ def main(argv=None) -> None:
         "implies --paged); the report gains the per-shard layout audit "
         "under 'tp' and the end-of-run allocator check runs as usual",
     )
+    ap.add_argument(
+        "--profile",
+        default="",
+        metavar="FILE",
+        help="after a generative run, dump the decode-loop sampling "
+        "profiler's folded stacks (flamegraph input) to FILE and FAIL if "
+        "no stack was captured — the `make profile-smoke` gate; the "
+        "report gains samples/hz/top frames under 'profile'",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -532,6 +583,7 @@ def main(argv=None) -> None:
                 prefix_share=args.prefix_share,
                 paged=args.paged,
                 tp=args.tp,
+                profile_out=args.profile,
             )
         )
 

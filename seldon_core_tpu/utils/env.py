@@ -61,6 +61,17 @@ ENGINE_ACCESS_LOG = "ENGINE_ACCESS_LOG"  # "json" enables; default off
 # cost is single-digit µs/round (PARITY.md "Flight recorder overhead").
 ENGINE_FLIGHT = "ENGINE_FLIGHT"  # "off" disables the recorder
 ENGINE_FLIGHT_FRAMES = "ENGINE_FLIGHT_FRAMES"  # ring capacity, default 2048
+# "on" forces per-dispatch completion (block_until_ready after every fused
+# program) so each family's flight column is ground-truth device wall —
+# calibration runs only; default off (async dispatch stays pipelined)
+ENGINE_FLIGHT_SYNC_TIMING = "ENGINE_FLIGHT_SYNC_TIMING"
+# decode-loop sampling profiler (telemetry/profile.py reads these):
+# always-on low-rate folded-stack sampler over the decode loop's thread,
+# served by GET /decode/profile. "off" disables; rate default 19 Hz;
+# folded-stack table bound default 512 entries (overflow counts, not grows)
+ENGINE_DECODE_PROFILE = "ENGINE_DECODE_PROFILE"
+ENGINE_DECODE_PROFILE_HZ = "ENGINE_DECODE_PROFILE_HZ"
+ENGINE_DECODE_PROFILE_TABLE = "ENGINE_DECODE_PROFILE_TABLE"
 
 
 def rest_timeouts(env: dict | None = None) -> tuple[float, float]:

@@ -131,6 +131,12 @@ def worst_case_full_record() -> dict:
                 "occupancy": 0.8911,
                 "blocked_rounds": 17,
                 "record_us": 4.812,
+                "phases": {
+                    "admit": 0.1324, "prefix_match": 0.0009,
+                    "alloc": 0.1127, "scatter": 0.0135,
+                    "emit_slo": 0.058, "accept_walk": 0.0411,
+                    "sampling": 0.0691, "commit": 0.0223,
+                },
             },
         },
         "spec": {
@@ -326,8 +332,10 @@ def test_compact_record_carries_every_headline():
         "recompiles": 0,
         "slots": 8,
         # flight-recorder sub-leg, packed to fit the byte budget:
-        # [bubble_fraction, occupancy, record_us]
+        # [bubble_fraction, occupancy, record_us] + the top-3 gap-phase
+        # fractions (host-bubble attribution; recorded, not gated)
         "loop": [0.313, 0.891, 4.8],
+        "loop_ph": {"admit": 0.132, "alloc": 0.113, "sampling": 0.069},
         "spec_tok_s": 2890.13,
         "accept_rate": 0.941,
         "tok_disp": 4.31,
@@ -335,15 +343,17 @@ def test_compact_record_carries_every_headline():
         "spec_k": 4,
         # prefix-cache sub-leg: cold/warm TTFT split, hit rate, prefill
         # tokens displaced, tokens/s + ITL with chunking off/on
-        "prefix_cold_ttft": 171.33,
-        "prefix_warm_ttft": 41.27,
+        # (short names since PR 11's byte-budget trim; full names in the
+        # detail record)
+        "prefix_cold": 171.33,
+        "prefix_warm": 41.27,
         "prefix_ttft_speedup": 4.15,
         "prefix_hit_rate": 0.958,
-        "prefix_saved_tok": 1288,
+        "prefix_saved": 1288,
         "prefix_tok_s": 1411.02,
         "prefix_tok_s_ck": 1389.77,
-        "prefix_itl_p99": 44.91,
-        "prefix_itl_p99_ck": 21.08,
+        "prefix_itl": 44.91,
+        "prefix_itl_ck": 21.08,
         # tree-speculation sub-leg, [tree, chain] pairs: tokens/s under
         # the dispatch-RTT floor and per-slot accepted+bonus per verify
         # dispatch at the same 2-dispatch round shape (identity contract
@@ -353,13 +363,13 @@ def test_compact_record_carries_every_headline():
         "tree_speedup": 1.08,
         # tensor-parallel sub-leg: tokens/s per width (width order), the
         # widest leg's speedup + identity contract, recompiles all-zero
-        "tp_widths": [1, 2, 4],
+        "tp_w": [1, 2, 4],
         "tp_tok_s": [1388.41, 1101.33, 905.87],
-        "tp_ttft_p50": [40.11, 51.72, 66.41],
-        "tp_itl_p99": [22.18, 28.05, 35.92],
+        "tp_ttft": [40.11, 51.72, 66.41],
+        "tp_itl": [22.18, 28.05, 35.92],
         "tp_speedup": 0.65,
-        "tp_identical": True,
-        "tp_recompiles": [0, 0, 0],
+        "tp_ident": True,
+        "tp_rc": [0, 0, 0],
     }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77

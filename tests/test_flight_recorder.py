@@ -1,5 +1,6 @@
 """Decode-loop flight recorder (telemetry/flight.py + the scheduler's
-per-round commit point) — ISSUE 9.
+per-round commit point) — ISSUE 9, extended by ISSUE 11's host-bubble
+microscope (phase attribution, enqueue/readback split, sampling profiler).
 
 The tier-1 guards this file pins:
 
@@ -13,14 +14,20 @@ The tier-1 guards this file pins:
 4. `bench.py --compare` exits nonzero on a synthetically regressed record
    and zero on an identical one;
 5. GET /decode/flight and GET /decode/health serve live recorder data,
-   and the profiler's ?duration_ms= auto-stop fires.
+   and the profiler's ?duration_ms= auto-stop fires;
+6. host-phase attribution: frames carry a per-phase gap split with
+   sum(phase) <= gap and readback <= busy per family, phases + profiler
+   ON still cost zero recompiles and stay within the overhead budget,
+   and the sampling profiler is bounded-memory with valid folded output.
 """
 
 import asyncio
 import importlib.util
 import json
 import os
+import re
 import sys
+import threading
 import time
 
 import numpy as np
@@ -29,7 +36,9 @@ import pytest
 from seldon_core_tpu.models.decoder import init_decoder
 from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
 from seldon_core_tpu.telemetry import flight as flight_mod
-from seldon_core_tpu.telemetry.flight import FlightFrame, FlightRecorder
+from seldon_core_tpu.telemetry import profile as profile_mod
+from seldon_core_tpu.telemetry.flight import FlightFrame, FlightRecorder, PhaseTimer
+from seldon_core_tpu.telemetry.profile import StackProfiler
 
 SEQ = 8
 MAX_NEW = 8
@@ -470,5 +479,277 @@ async def test_profiler_duration_ms_auto_stops(tmp_path):
         assert (await r.json())["dir"] == os.path.abspath(out_dir + "2")
         r = await client.post("/profiler/start?duration_ms=notanumber")
         assert r.status == 400
+    finally:
+        await client.close()
+
+
+# ------------------------------------------- phase timer + readback split
+
+
+def test_phase_timer_nesting_attributes_to_innermost():
+    t = PhaseTimer(enabled=True)
+    with t.phase(flight_mod.P_ACCEPT_WALK):
+        time.sleep(0.002)
+        with t.phase(flight_mod.P_EMIT_SLO):
+            time.sleep(0.002)
+        time.sleep(0.002)
+    assert t.ns[flight_mod.P_EMIT_SLO] >= 1_000_000
+    assert t.ns[flight_mod.P_ACCEPT_WALK] >= 2_000_000
+    # innermost wins: the outer phase does NOT double-count the inner span
+    total = sum(t.ns)
+    assert t.ns[flight_mod.P_ACCEPT_WALK] + t.ns[flight_mod.P_EMIT_SLO] == total
+    t.reset()
+    assert sum(t.ns) == 0 and t._stack == []
+    # disabled timer: shared no-op handles, arrays stay zero
+    off = PhaseTimer(enabled=False)
+    with off.phase(flight_mod.P_ADMIT):
+        pass
+    assert sum(off.ns) == 0
+
+
+def test_phase_timer_commit_freezes_round():
+    t = PhaseTimer(enabled=True)
+    with t.phase(flight_mod.P_ADMIT):
+        pass
+    t0 = time.perf_counter_ns()
+    frozen = t.commit(flight_mod.P_COMMIT, t0)
+    assert len(frozen) == flight_mod.N_PHASES
+    assert frozen[flight_mod.P_COMMIT] >= 0
+    assert isinstance(frozen, tuple)
+
+
+def test_overhead_budget_with_phases_and_profiler_on():
+    """Tier-1 guard: the frame append AND the phase timer stay within the
+    CI overhead budget with the sampling profiler running hot against
+    this very thread (the worst case the always-on path can present)."""
+    prof = StackProfiler(hz=500, max_entries=64, enabled=True)
+    prof.watch(threading.get_ident())
+    assert prof.start()
+    try:
+        frame_us = FlightRecorder.measure_overhead(2000)
+        phase_us = PhaseTimer.measure_overhead(2000)
+    finally:
+        prof.stop()
+    assert frame_us < OVERHEAD_BUDGET_US, f"frame append {frame_us} µs/round"
+    assert phase_us < OVERHEAD_BUDGET_US, f"phase timer {phase_us} µs/round"
+
+
+def test_frames_carry_phase_and_readback_split():
+    """Tier-1 guard (ISSUE 11): plain-path frames decompose the gap into
+    phases (sum(phase) <= gap), every family's readback share is within
+    its busy wall (enqueue + readback == busy by construction), and the
+    aggregate/health read-outs carry the new keys — all at zero
+    recompiles."""
+    s = DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=4)
+    s.warmup()
+    _run_requests(s, n=6)
+    assert s.recompiles_since_warmup() == 0
+    frames = s.flight.snapshot()
+    assert frames
+    for f in frames:
+        assert len(f.phase_ns) == flight_mod.N_PHASES
+        assert len(f.rdb_ns) == len(flight_mod.FAMILIES)
+        # phases are host gap: never more than the frame's gap (small
+        # tolerance for timer-boundary jitter)
+        assert sum(f.phase_ns) <= f.gap_ns + 50_000, (f.seq, f.phase_ns, f.gap_ns)
+        for i, rdb in enumerate(f.rdb_ns):
+            assert 0 <= rdb <= f.busy_ns[i]
+    step_frames = [f for f in frames if f.mode == "plain"]
+    assert any(sum(f.phase_ns) > 0 for f in step_frames)
+    # the step family actually reads tokens back -> nonzero readback split
+    assert any(f.rdb_ns[flight_mod.F_STEP] > 0 for f in step_frames)
+    d = step_frames[-1].to_dict()
+    assert set(d.get("phase_us", {})) <= set(flight_mod.PHASES)
+    if "rdb_us" in d:
+        assert set(d["rdb_us"]) <= set(flight_mod.FAMILIES)
+        assert set(d["enq_us"]) <= set(flight_mod.FAMILIES)
+    agg = s.flight.aggregate()
+    assert {"admit", "alloc", "sampling", "emit_slo", "commit"} <= set(
+        agg["phase_ms"]
+    )
+    assert 0.0 < agg["phase_of_gap"] <= 1.05
+    assert set(agg["readback_ms"]) <= set(flight_mod.FAMILIES)
+    assert set(agg["enqueue_ms"]) <= set(flight_mod.FAMILIES)
+    health = s.flight.health()
+    assert health["top_gap_phase"] in flight_mod.PHASES
+    assert 0.0 < health["phase_of_gap"] <= 1.05
+
+
+def test_spec_frames_attribute_accept_walk_and_verify_readback():
+    """Speculative rounds attribute their emission walk to accept_walk and
+    carry the verify family's blocked readback (the PR 9 caveat — 'draft
+    is free, verify absorbs the pair' — now split and visible)."""
+    draft = init_decoder(seed=3, vocab=VOCAB, hidden=32, layers=1, ffn=64,
+                         max_len=32, resid_scale=0.1)
+    s = DecodeScheduler(
+        _params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+        draft_params=draft, spec_k=3,
+    )
+    s.warmup()
+    _run_requests(s, n=4)
+    assert s.recompiles_since_warmup() == 0
+    chain = [f for f in s.flight.snapshot() if f.mode == "chain"]
+    assert chain
+    assert any(f.phase_ns[flight_mod.P_ACCEPT_WALK] > 0 for f in chain)
+    assert any(f.rdb_ns[flight_mod.F_VERIFY] > 0 for f in chain)
+    # the draft column is enqueue-only on the async pair (its wait lands
+    # in the verify readback) — never negative, never above busy
+    for f in chain:
+        assert f.rdb_ns[flight_mod.F_DRAFT] == 0
+        assert f.rdb_ns[flight_mod.F_VERIFY] <= f.busy_ns[flight_mod.F_VERIFY]
+
+
+def test_sync_timing_env_mode(monkeypatch):
+    """ENGINE_FLIGHT_SYNC_TIMING=on: per-dispatch completion is forced
+    (calibration ground truth) with the program set unchanged — zero
+    recompiles, frames still commit."""
+    assert not flight_mod.sync_timing_enabled(env={})
+    assert flight_mod.sync_timing_enabled(env={
+        flight_mod.ENGINE_FLIGHT_SYNC_TIMING: "on"
+    })
+    monkeypatch.setenv(flight_mod.ENGINE_FLIGHT_SYNC_TIMING, "on")
+    s = DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2)
+    assert s._sync_timing is True
+    s.warmup()
+    _run_requests(s, n=3)
+    assert s.recompiles_since_warmup() == 0
+    assert s.flight.rounds > 0
+    assert any(f.busy_ns[flight_mod.F_STEP] > 0 for f in s.flight.snapshot())
+
+
+# ------------------------------------------------------ sampling profiler
+
+
+def test_profiler_captures_stacks_with_folded_schema():
+    prof = StackProfiler(hz=200, max_entries=64, enabled=True)
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    prof.watch(t.ident)
+    assert prof.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while prof.samples < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        prof.stop()
+        stop.set()
+    assert prof.samples >= 3, "sampler never caught the busy thread"
+    folded = prof.folded()
+    assert folded
+    # flamegraph folded format: "frame;frame;frame count", leaf last
+    assert all(re.fullmatch(r"\S.*? \d+", line) for line in folded)
+    assert any("busy" in line.split(" ")[0].rsplit(";", 1)[-1] for line in folded)
+    rep = prof.report(n=5)
+    for key in ("enabled", "running", "hz", "samples", "missed",
+                "truncated_samples", "table_entries", "table_cap", "top",
+                "folded"):
+        assert key in rep, key
+    assert rep["top"] and rep["top"][0]["self_samples"] >= 1
+    assert 0.0 < rep["top"][0]["fraction"] <= 1.0
+
+
+def test_profiler_table_is_bounded():
+    prof = StackProfiler(hz=10, max_entries=16, enabled=True)
+    for i in range(100):
+        prof._ingest(f"a;b;frame{i}")
+    assert prof.samples == 100
+    assert len(prof._table) == 16  # fixed memory regardless of stack variety
+    assert prof.truncated == 100 - 16
+    assert prof.report(n=3)["truncated_samples"] == 84
+    # known stacks keep counting after the cap
+    prof._ingest("a;b;frame0")
+    assert prof._table["a;b;frame0"] == 2 and prof.truncated == 84
+
+
+def test_profiler_start_stop_and_kill_switch(monkeypatch):
+    prof = StackProfiler(hz=100, enabled=True)
+    prof.watch(threading.get_ident())
+    assert prof.start()
+    assert prof.start()  # idempotent
+    assert prof.running
+    prof.stop()
+    assert not prof.running
+    # env kill switch: start() is a refusal, not an error
+    monkeypatch.setenv(profile_mod.ENGINE_DECODE_PROFILE, "off")
+    off = StackProfiler()
+    assert off.enabled is False
+    assert off.start() is False and not off.running
+    monkeypatch.delenv(profile_mod.ENGINE_DECODE_PROFILE)
+    assert StackProfiler().enabled is True
+    # rate clamp
+    p = StackProfiler(hz=50, enabled=True)
+    assert p.set_hz(0.01) == 0.1
+    assert p.set_hz(10_000) == 1000.0
+
+
+def test_scheduler_registers_decode_thread_with_profiler():
+    """The decode loop registers its thread with the process profiler as
+    the loop task starts (always-on without operator action)."""
+    prof = profile_mod.get_profiler()
+    s = DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2)
+    s.warmup()
+    _run_requests(s, n=2)
+    assert prof._target_ident is not None
+    assert prof.enabled is False or prof.running
+
+
+# ------------------------------------------- endpoint query validation
+
+
+async def test_flight_and_profile_query_validation():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.operator.api import add_operator_routes
+    from seldon_core_tpu.operator.reconciler import DeploymentManager
+
+    rec = FlightRecorder(n_slots=2, name="qv", capacity=16, enabled=True)
+    flight_mod.register(rec)
+    rec.record(_frame(0))
+    app = web.Application()
+    add_operator_routes(app, DeploymentManager())
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        # malformed ?n/?window/?hz: 400 with a parseable error body, not a
+        # 500 and not a silent default
+        for url, param in (
+            ("/decode/flight?n=0", "n"),
+            ("/decode/flight?n=-3", "n"),
+            ("/decode/flight?n=abc", "n"),
+            ("/decode/flight?window=0", "window"),
+            ("/decode/flight?window=1.5", "window"),
+            ("/decode/profile?n=zero", "n"),
+            ("/decode/profile?hz=0", "hz"),
+            ("/decode/profile?hz=-5", "hz"),
+        ):
+            r = await client.get(url)
+            assert r.status == 400, url
+            body = await r.json()
+            assert body["param"] == param and "error" in body and "got" in body
+        # valid queries still serve
+        r = await client.get("/decode/flight?name=qv&n=1&window=1")
+        assert r.status == 200
+        assert len((await r.json())["recorders"]["qv"]["frames"]) == 1
+        r = await client.get("/decode/profile?n=5")
+        assert r.status == 200
+        body = await r.json()
+        for key in ("enabled", "running", "hz", "samples", "top", "folded"):
+            assert key in body, key
+        # ?hz= retunes the live sampler (clamped, validated); the GET's
+        # reach is capped at 200 Hz so a cached link cannot turn the
+        # always-on sampler hot
+        r = await client.get("/decode/profile?hz=42")
+        assert r.status == 200
+        assert (await r.json())["hz"] == 42.0
+        r = await client.get("/decode/profile?hz=10000")
+        assert r.status == 200
+        assert (await r.json())["hz"] == 200.0
     finally:
         await client.close()

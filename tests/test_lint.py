@@ -415,6 +415,103 @@ def validate(pred):
     assert [f.symbol for f in findings] == ["decode_slo"]
 
 
+# ------------------------------------------------------------- phase-registry
+PH_REGISTRY = """
+FAMILIES = ("chunk", "step")
+F_CHUNK, F_STEP = range(2)
+PHASES = ("admit", "commit")
+P_ADMIT, P_COMMIT = range(2)
+"""
+
+
+def test_phase_registry_raw_site_flagged():
+    # a raw index / arbitrary expression at a timer site mis-attributes
+    # the round silently — PH001 at the call
+    bad = """
+class Sched:
+    async def step(self):
+        await self._timed_call(1, lambda: None)
+        with self._phase("admit"):
+            pass
+"""
+    findings = lint_sources({"serving/sched.py": bad}, rules=["phase-registry"])
+    assert rules_of(findings) == {"PH001"}
+    assert len(findings) == 2
+    assert "registered F_*/P_* constant" in findings[0].message
+
+
+def test_phase_registry_constant_sites_clean():
+    ok = """
+from flight import F_STEP, P_ADMIT, P_COMMIT
+
+class Sched:
+    async def step(self):
+        await self._timed_call(F_STEP, lambda: None)
+        with self._phase(P_ADMIT):
+            pass
+        self._phases.commit(P_COMMIT, 0)
+"""
+    assert (
+        lint_sources({"serving/sched.py": ok}, rules=["phase-registry"]) == []
+    )
+    # attribute access on an imported module counts too
+    attr = """
+import flight
+
+class Sched:
+    async def step(self):
+        await self._timed_call(flight.F_STEP, lambda: None)
+"""
+    assert (
+        lint_sources({"serving/sched.py": attr}, rules=["phase-registry"])
+        == []
+    )
+
+
+def test_phase_registry_unused_constant_flagged():
+    # P_COMMIT/F_CHUNK registered but never consumed: permanently-zero
+    # columns that read as "free" instead of "not measured" — PH002 on
+    # the registry line
+    user = """
+from flight import F_STEP, P_ADMIT
+
+class Sched:
+    async def step(self):
+        await self._timed_call(F_STEP, lambda: None)
+        with self._phase(P_ADMIT):
+            pass
+"""
+    findings = lint_sources(
+        {"telemetry/flight.py": PH_REGISTRY, "serving/sched.py": user},
+        rules=["phase-registry"],
+    )
+    assert rules_of(findings) == {"PH002"}
+    assert sorted(f.symbol for f in findings) == ["F_CHUNK", "P_COMMIT"]
+    # consuming every constant clears the pass
+    full = user.replace(
+        "from flight import F_STEP, P_ADMIT",
+        "from flight import F_CHUNK, F_STEP, P_ADMIT, P_COMMIT",
+    ).replace(
+        "with self._phase(P_ADMIT):",
+        "await self._timed_call(F_CHUNK, lambda: None)\n"
+        "        self._phases.commit(P_COMMIT, 0)\n"
+        "        with self._phase(P_ADMIT):",
+    )
+    assert (
+        lint_sources(
+            {"telemetry/flight.py": PH_REGISTRY, "serving/sched.py": full},
+            rules=["phase-registry"],
+        )
+        == []
+    )
+    # without the registry module in the lint set PH002 cannot judge
+    # coverage and stays silent (PH001 still applies)
+    assert (
+        lint_sources({"serving/sched.py": user}, rules=["phase-registry"])
+        == []
+    )
+
+
 # --------------------------------------------------------------------- ladder
 LC_BAD = """
 class Sched:
@@ -503,7 +600,14 @@ def test_baseline_split_and_stale():
 
 def test_rules_filter_and_catalogue():
     cat = rule_catalogue()
-    assert set(cat) == {"trace-safety", "commit-point", "registry-drift", "ladder"}
+    assert set(cat) == {
+        "trace-safety",
+        "commit-point",
+        "registry-drift",
+        "phase-registry",
+        "ladder",
+    }
+    assert {"PH001", "PH002"} == set(cat["phase-registry"])
     assert {"TS001", "TS002", "TS003", "TS004", "TS005"} == set(
         cat["trace-safety"]
     )
