@@ -13,8 +13,8 @@ lint:  ## invariant linter (trace-safety / commit-point / registry-drift / phase
 test: lint profile-smoke  ## full suite on the 8-device virtual CPU mesh
 	$(PY) -m pytest tests/ -q
 
-profile-smoke:  ## short generative soak: the decode-loop sampling profiler must capture >=1 stack (folded output -> /tmp)
-	$(TEST_ENV) $(PY) -m seldon_core_tpu.tools.soak --duration 3 --users 4 --prefix-share 0.5 --profile /tmp/decode_profile.folded
+profile-smoke:  ## short generative soak: the sampling profiler must capture >=1 stack AND the pipelined loop must hide host work (overlap_of_gap > 0)
+	$(TEST_ENV) ENGINE_DECODE_PIPELINE=on $(PY) -m seldon_core_tpu.tools.soak --duration 3 --users 4 --prefix-share 0.5 --profile /tmp/decode_profile.folded
 
 test-fast: lint  ## skip the slow model/parallel tests
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_models_heavy.py --ignore=tests/test_parallel.py

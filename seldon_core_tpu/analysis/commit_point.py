@@ -15,6 +15,16 @@ point. These rules keep that invariant structural:
   Writes inside an ``async with self.<lock>`` block are exempt; loop
   bodies are walked linearly (no wrap-around), so a single write site
   inside a loop does not flag.
+- CP003: the pipelined scheduler's SHADOW round state (``self._pending*``
+  — admissions/input plans decided under an in-flight dispatch, PR 13)
+  gets the same single-writer discipline ``_commit_round`` state gets: in
+  a class that defines the reconcile funnel (``_apply_pending``) or a
+  pipeline builder (``_pipeline_*``), a ``_pending*`` attribute may be
+  mutated ONLY by the builders (``_pipeline_*``), the reconcile funnel
+  (``_apply_pending``), ``__init__``, and ``_round_reset``. A write from
+  anywhere else — including mutating calls like ``.append``/``.clear``,
+  which plain store analysis misses — re-opens the speculate-vs-commit
+  drift the shadow state exists to prevent.
 """
 
 from __future__ import annotations
@@ -26,6 +36,22 @@ from seldon_core_tpu.analysis.core import ParsedFile, Project
 from seldon_core_tpu.analysis.model import Finding
 
 _EXEMPT_METHODS = ("__init__", "_commit_round", "_round_reset")
+
+# CP003: sanctioned writers of self._pending* shadow state — the pipeline
+# builders by prefix, the reconcile funnel, and the init/reset funnels
+_PENDING_PREFIX = "_pending"
+_PENDING_WRITER_PREFIX = "_pipeline_"
+_PENDING_WRITERS = ("__init__", "_round_reset", "_apply_pending")
+# method calls that mutate their receiver (list/deque/dict/set mutators) —
+# a ``self._pending_x.append(...)`` is a shadow-state write even though no
+# ast.Assign exists
+_MUTATING_CALLS = frozenset(
+    (
+        "append", "appendleft", "extend", "extendleft", "insert", "remove",
+        "pop", "popleft", "popitem", "clear", "add", "discard", "update",
+        "setdefault", "sort", "reverse",
+    )
+)
 
 
 def _self_attr_writes(stmt: ast.stmt) -> list[tuple[str, ast.AST]]:
@@ -53,11 +79,36 @@ def _self_attr_writes(stmt: ast.stmt) -> list[tuple[str, ast.AST]]:
     return out
 
 
+def _pending_writes(fn: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(attr, node) for every mutation of a ``self._pending*`` attribute
+    inside ``fn``: plain/aug/ann stores (via _self_attr_writes) plus
+    mutating method calls (``self._pending_x.append(...)``)."""
+    out: list[tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt):
+            for attr, site in _self_attr_writes(node):
+                if attr.startswith(_PENDING_PREFIX):
+                    out.append((attr, site))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATING_CALLS
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+                and f.value.attr.startswith(_PENDING_PREFIX)
+            ):
+                out.append((f.value.attr, node))
+    return out
+
+
 class CommitPointPass:
     name = "commit-point"
     rules = {
         "CP001": "round-committed attribute mutated outside _commit_round/_round_reset",
         "CP002": "same self.* attribute written on both sides of an await without a lock",
+        "CP003": "shadow/pending round state mutated outside the pipeline builders and _apply_pending",
     }
 
     def run(self, project: Project) -> list[Finding]:
@@ -112,9 +163,60 @@ class CommitPointPass:
                                         symbol=f"{cls.name}.{m.name}",
                                     )
                                 )
+        self._check_pending(pf, cls, methods, findings)
         for m in methods:
             if isinstance(m, ast.AsyncFunctionDef):
                 self._check_async(pf, cls, m, findings)
+
+    # ------------------------------------------------------------ CP003
+    def _check_pending(
+        self,
+        pf: ParsedFile,
+        cls: ast.ClassDef,
+        methods: list,
+        findings: list[Finding],
+    ) -> None:
+        # the rule engages only on the pipelined-scheduler SHAPE: a class
+        # with the reconcile funnel or a pipeline builder. A class that
+        # happens to name an attribute `_pending_x` without that state
+        # machine is left alone.
+        if not any(
+            m.name == "_apply_pending"
+            or m.name.startswith(_PENDING_WRITER_PREFIX)
+            for m in methods
+        ):
+            return
+        for m in methods:
+            if (
+                m.name in _PENDING_WRITERS
+                or m.name.startswith(_PENDING_WRITER_PREFIX)
+            ):
+                continue
+            for attr, site in _pending_writes(m):
+                findings.append(
+                    Finding(
+                        rule="CP003",
+                        path=pf.path,
+                        line=site.lineno,
+                        col=site.col_offset,
+                        message=(
+                            f"`self.{attr}` is shadow/pending round state "
+                            f"but is mutated in `{cls.name}.{m.name}` — "
+                            "only the pipeline builders (`_pipeline_*`), "
+                            "`_apply_pending`, `__init__`, and "
+                            "`_round_reset` may write it (the speculate-"
+                            "vs-commit drift hazard)"
+                        ),
+                        hint=(
+                            "build the state in a `_pipeline_*` method and "
+                            "consume it through `_apply_pending` (or a "
+                            "`_pipeline_take_*` accessor), or rename the "
+                            "attribute out of the `_pending` namespace if "
+                            "it is not shadow state"
+                        ),
+                        symbol=f"{cls.name}.{m.name}",
+                    )
+                )
 
     # ------------------------------------------------------------ CP002
     def _check_async(

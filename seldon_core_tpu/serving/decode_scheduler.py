@@ -59,6 +59,26 @@ scheduling over a vLLM-style PAGED KV pool into the stack:
   tokens, so stale entries sit beyond every later attention mask until
   the next consumed token overwrites them.
 
+- Pipelined decode rounds (``ENGINE_DECODE_PIPELINE``, default on): the
+  host-bubble microscope measured the serial loop's per-round gap as
+  dominated by admission + allocator work that does NOT depend on the
+  in-flight dispatch's result — so the loop double-buffers: while round
+  N's fused step/verify dispatch is enqueued and awaiting readback, round
+  N+1's host phases run against SHADOW state (admission decisions into a
+  pending list via the same ``_admit_decide`` the serial walk uses, plus
+  the next chunk round's input build as a snapshot-keyed plan), then the
+  readback walks reconcile against the unchanged dispatch-time slot
+  table, ``_apply_pending`` installs the flight-decided admissions, and
+  the round commits through the single ``_commit_round`` funnel. The
+  speculative side is rollback-safe by construction: a reservation made
+  against the pre-retire pool is conservative (retirements only free
+  pages), ``alloc.retire(slot)`` fully undoes it, and a head the tight
+  pool cannot yet guarantee simply defers to the serial walk after the
+  reconcile. Stages are gated per-phase on their own measured cost
+  (``_PipelineGate`` — cheap phases are not worth moving across the
+  round boundary). ``ENGINE_DECODE_PIPELINE=off`` or
+  ``ENGINE_FLIGHT_SYNC_TIMING=on`` force the serial loop (ground-truth
+  timing), and greedy output is bit-identical either way.
 - Flight recorder (telemetry/flight.py): every scheduler round commits ONE
   compact frame — mode, slot/queue occupancy, admissions/retirements and
   the blocked cause, tokens/accepted/effective depth, device-busy split per
@@ -119,6 +139,7 @@ from seldon_core_tpu.telemetry.flight import (
     FlightFrame,
     FlightRecorder,
     PhaseTimer,
+    decode_pipeline_enabled,
     sync_timing_enabled,
 )
 from seldon_core_tpu.telemetry.flight import register as flight_register
@@ -331,6 +352,60 @@ class _SpecAdapt:
         return max(1, min(self.ceiling, int(np.ceil(frac * self.ceiling))))
 
 
+class _PipelineGate:
+    """Per-stage cost gate for the pipelined loop's overlap window: an
+    EWMA of each stage's measured host cost, with a floor below which the
+    stage stops riding the pipeline — moving a trivially cheap phase
+    across the round boundary buys nothing and costs shadow-state surface
+    (the measured-cost gating the ROADMAP item calls for). A skipped
+    stage still probes every ``probe_every``-th opportunity so a workload
+    whose host cost grows re-enables it. Optimistic start: an unmeasured
+    stage always runs, so the smoke geometries the pipeline is judged on
+    never pay a ramp-up."""
+
+    __slots__ = ("floor_ns", "alpha", "probe_every", "ewma", "skips")
+
+    def __init__(
+        self, floor_ns: float = 1_000.0, alpha: float = 0.2, probe_every: int = 32
+    ):
+        self.floor_ns = float(floor_ns)
+        self.alpha = float(alpha)
+        self.probe_every = int(probe_every)
+        self.ewma: dict[str, float] = {}
+        self.skips: dict[str, int] = {}
+
+    def allow(self, stage: str) -> bool:
+        mean = self.ewma.get(stage)
+        if mean is None or mean >= self.floor_ns:
+            return True
+        n = self.skips.get(stage, 0) + 1
+        self.skips[stage] = n
+        return self.probe_every > 0 and n % self.probe_every == 0
+
+    def note(self, stage: str, ns: int) -> None:
+        prev = self.ewma.get(stage)
+        self.ewma[stage] = (
+            float(ns) if prev is None else prev + self.alpha * (ns - prev)
+        )
+
+
+class _PendingAdmit:
+    """One flight-decided admission (shadow round state): the decision's
+    operands held UN-installed until ``_apply_pending`` — the reconcile
+    walks must see exactly the dispatch-time slot table. The allocator
+    reservation (``try_admit``) is the decision's only live footprint, so
+    ``alloc.retire(slot)`` is the complete rollback."""
+
+    __slots__ = ("seq", "slot", "entry", "reuse", "t0")
+
+    def __init__(self, seq: "_Seq", slot: int, entry, reuse: int, t0: int):
+        self.seq = seq
+        self.slot = slot
+        self.entry = entry
+        self.reuse = reuse
+        self.t0 = t0
+
+
 class _PrefixEntry:
     """One cached prefix: the token string it holds plus a REFERENCE to
     the pool pages carrying its K/V (a kv_pool pin id) — no private pool
@@ -455,7 +530,7 @@ class _Seq:
 
     __slots__ = (
         "prompt", "max_new", "temperature", "top_k", "spec_k", "tree_widths",
-        "on_token", "future",
+        "on_token", "future", "uid",
         "tokens", "slot", "pos", "t_enqueued", "t_first_token", "t_last_token",
         "deadline", "trace_ctxs", "gen_spans",
         "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
@@ -474,6 +549,10 @@ class _Seq:
         self.tree_widths: tuple[int, ...] = ()
         self.on_token = on_token
         self.future = future
+        # scheduler-assigned serial (submit order): the chunk-plan snapshot
+        # key needs slot occupancy disambiguated across slot reuse — id()
+        # can alias after a retire frees the object
+        self.uid = 0
         self.tokens: list[int] = []
         self.slot = -1
         self.pos = 0
@@ -914,6 +993,30 @@ class DecodeScheduler:
         # per-family flight columns are ground-truth device wall
         # (calibration runs — throughput pays the pipeline stall)
         self._sync_timing = sync_timing_enabled()
+        # pipelined decode rounds: while round N's step/verify dispatch is
+        # in flight, round N+1's host phases run against the SHADOW state
+        # below (pending admissions + a snapshot-keyed chunk-input plan),
+        # reconciled at readback through _apply_pending and committed at
+        # the single _commit_round funnel. ENGINE_DECODE_PIPELINE=off (or
+        # sync timing, whose ground truth needs the serial loop) forces
+        # the serial path; bench's A/B leg flips the attribute per run.
+        self.pipeline_enabled = decode_pipeline_enabled()
+        self._gate = _PipelineGate()
+        self._pending_admits: list[_PendingAdmit] = []
+        self._pending_chunk_plan: tuple | None = None
+        # whether the last overlap window ran the admission sundries
+        # (expiry sweep + gauges) — consumed by the serial walk's
+        # take-accessor; survives _round_reset (it crosses the commit
+        # boundary to the next round's walk)
+        self._pending_admit_sweep = False
+        self._seq_uid = 0
+        self.stat_pipelined_rounds = 0  # rounds that ran an overlap window
+        self.stat_pipeline_admits = 0  # admissions decided under a flight
+        # admissions the pre-retire pool deferred to the serial walk
+        self.stat_pipeline_deferred = 0
+        # pending admits rolled back at reconcile (caller vanished in flight)
+        self.stat_pipeline_rollbacks = 0
+        self.stat_pipeline_plans_used = 0  # overlap-built chunk plans consumed
         self._round_reset()
 
     def _commit_kv(self, params, arrs):
@@ -1100,6 +1203,8 @@ class DecodeScheduler:
         sk = self.spec_k if spec_k is None else max(0, min(int(spec_k), self.spec_k))
         loop = asyncio.get_running_loop()
         seq = _Seq(prompt, max_new, temp, k, sk, on_token, loop.create_future())
+        self._seq_uid += 1
+        seq.uid = self._seq_uid
         # goodput attribution: a request submitted under a deadline budget
         # (tpu.deadline_ms stamped into the DEADLINE contextvar by the
         # service) is judged against it at retirement — its tokens count
@@ -1398,6 +1503,16 @@ class DecodeScheduler:
         self._rb_proposed = 0
         self._rb_depth = 0
         self._rb_active = 0
+        self._rb_overlap = 0
+        # stale shadow admissions (a round error between the overlap
+        # window and the reconcile): the normal flow drains the list at
+        # _apply_pending before the round commits, so anything still here
+        # is error-path residue — roll the reservations back. (After a
+        # pool.reset the allocator is fresh and retire() no-ops.)
+        if self._pending_admits:
+            for p in self._pending_admits:
+                self.pool.alloc.retire(p.slot)
+            self._pending_admits.clear()
         self._phases.reset()
 
     def _phase(self, p: int):
@@ -1475,6 +1590,7 @@ class DecodeScheduler:
                     self._rb_proposed, self._rb_depth, tuple(self._rb_busy),
                     gap, snap["free"], snap["live"], snap["prefix"],
                     self._rb_cow, phase_ns, tuple(self._rb_rdb),
+                    self._rb_overlap,
                 )
             )
         self._metrics.decode_round(self._deployment, busy / 1e9, gap / 1e9)
@@ -1497,6 +1613,86 @@ class DecodeScheduler:
         self._rb_cow += len(copies)
         self._metrics.decode_kv_cow(self._deployment, len(copies))
 
+    def _admit_decide(self, seq: _Seq, slot: int) -> tuple:
+        """The admission DECISION for one waiting sequence into ``slot``:
+        longest-prefix match, the cache_prefix boundary-page reserve, and
+        the allocator's worst-case page reservation (``try_admit`` maps
+        shared pages into the slot's block table — refcount bumps, no
+        device work). Shared between the serial ``_admit`` walk and the
+        pipelined ``_pipeline_admit``, where it runs UNDER an in-flight
+        dispatch: the reservation is rollback-safe (``alloc.retire(slot)``
+        undoes it completely) and conservative (round N's retirements can
+        only free pages, never invalidate a reservation made against the
+        pre-retire pool). Returns ``(entry, reuse, admitted)``."""
+        entry, reuse = None, 0
+        if self.prefix_enabled:
+            with self._phase(P_PREFIX_MATCH):
+                entry, depth = self._prefix_index.match(seq.prompt)
+            # always leave >= 1 suffix token: the last prompt
+            # position's logits are the first generated token's
+            # distribution
+            reuse = min(depth, self.seq_len - 1)
+            if reuse <= 0:
+                entry = None
+        # a cache_prefix hint pins pages at prefill completion; if the
+        # hinted span's last page extends past seq_len, this slot's own
+        # GENERATION writes will copy-on-write it — reserve for exactly
+        # that case (page-aligned prompts need no extra, so a full
+        # hinted burst still reaches every slot on the auto budget)
+        extra = 0
+        if self.prefix_enabled and seq.cache_prefix > 0:
+            alloc = self.pool.alloc
+            hint_end = alloc.pages_for(seq.cache_prefix) * alloc.page_size
+            extra = 1 if hint_end > self.seq_len else 0
+        with self._phase(P_ALLOC):
+            admitted = self.pool.alloc.try_admit(
+                slot, entry.pages if entry is not None else (), reuse, extra
+            )
+        return entry, reuse, admitted
+
+    def _install_admit(self, seq: _Seq, slot: int, entry, reuse: int, t0: int) -> None:
+        """Install an admission decision into the LIVE slot table — the
+        part the pipelined loop defers to the reconcile so the readback
+        walks never see a mid-flight admission. Callers own the queue /
+        free-list bookkeeping (the serial walk pops, _apply_pending
+        removes by identity)."""
+        seq.slot = slot
+        seq.prefilling = True
+        self._slots[slot] = seq
+        self.stat_admitted += 1
+        self._rb_admitted += 1
+        shared_pages = self.pool.alloc.pages_for(reuse) if reuse else 0
+        if self.prefix_enabled:
+            if entry is not None:
+                self.pool.alloc.touch(entry.pin_id)
+                self.stat_prefix_hits += 1
+                self.stat_prefix_tokens_saved += reuse
+                self._metrics.decode_prefix(self._deployment, True, reuse)
+                self._metrics.decode_kv_shared(self._deployment, shared_pages)
+            else:
+                self.stat_prefix_misses += 1
+                self._metrics.decode_prefix(self._deployment, False, 0)
+        seq.prefill_pos = reuse
+        seq.prefix_len = reuse
+        for c in seq.trace_ctxs:
+            ms = c.buf.begin(
+                "decode.prefix_match" if self.prefix_enabled else "decode.admit",
+                c.span.span_id,
+                {"slot": slot, "hit": reuse > 0, **self._mesh_attrs},
+                start_ns=t0,
+            )
+            ms.add_event("reuse", {"tokens": reuse})
+            ms.add_event(
+                "kv_alloc",
+                {
+                    "shared_pages": shared_pages,
+                    "reserved_pages": int(self.pool.alloc._reserved[slot]),
+                    "free_pages": self.pool.alloc.free_pages,
+                },
+            )
+            ms.end()
+        self.stat_peak_active = max(self.stat_peak_active, self.active)
+
     async def _admit(self) -> None:
         """Move waiting sequences into free slots — pure host work now:
         slot assignment, the longest-prefix match, copy-free page mapping
@@ -1509,7 +1705,14 @@ class DecodeScheduler:
         pool can GUARANTEE its exclusive page need on top of every running
         slot's outstanding reservation (kv_pool's no-deadlock invariant).
         When the budget is tight the head of the queue waits for
-        retirements — FIFO, like slot contention."""
+        retirements — FIFO, like slot contention.
+
+        On the pipelined loop this is also the serial TAIL of admission:
+        flight-decided admissions were installed by ``_apply_pending``
+        before the previous round committed, and whatever still waits
+        (arrivals during the flight, heads the pre-retire pool deferred)
+        admits here against the post-retire pool — so the admitted set
+        per round is identical to the serial loop's."""
         while self._waiting and self._free:
             seq = self._waiting[0]
             if seq.future.cancelled():
@@ -1517,93 +1720,280 @@ class DecodeScheduler:
                 continue
             t0 = telemetry.now_ns()
             slot = self._free[-1]
-            entry, reuse = None, 0
-            if self.prefix_enabled:
-                with self._phase(P_PREFIX_MATCH):
-                    entry, depth = self._prefix_index.match(seq.prompt)
-                # always leave >= 1 suffix token: the last prompt
-                # position's logits are the first generated token's
-                # distribution
-                reuse = min(depth, self.seq_len - 1)
-                if reuse <= 0:
-                    entry = None
-            # a cache_prefix hint pins pages at prefill completion; if the
-            # hinted span's last page extends past seq_len, this slot's own
-            # GENERATION writes will copy-on-write it — reserve for exactly
-            # that case (page-aligned prompts need no extra, so a full
-            # hinted burst still reaches every slot on the auto budget)
-            extra = 0
-            if self.prefix_enabled and seq.cache_prefix > 0:
-                alloc = self.pool.alloc
-                hint_end = alloc.pages_for(seq.cache_prefix) * alloc.page_size
-                extra = 1 if hint_end > self.seq_len else 0
-            with self._phase(P_ALLOC):
-                admitted = self.pool.alloc.try_admit(
-                    slot, entry.pages if entry is not None else (), reuse, extra
-                )
+            entry, reuse, admitted = self._admit_decide(seq, slot)
             if not admitted:
                 self.stat_admit_blocked_rounds += 1
                 self._rb_blocked = "pages"
                 break
             self._waiting.popleft()
             self._free.pop()
-            seq.slot = slot
-            seq.prefilling = True
-            self._slots[slot] = seq
-            self.stat_admitted += 1
-            self._rb_admitted += 1
-            shared_pages = self.pool.alloc.pages_for(reuse) if reuse else 0
-            if self.prefix_enabled:
-                if entry is not None:
-                    self.pool.alloc.touch(entry.pin_id)
-                    self.stat_prefix_hits += 1
-                    self.stat_prefix_tokens_saved += reuse
-                    self._metrics.decode_prefix(self._deployment, True, reuse)
-                    self._metrics.decode_kv_shared(self._deployment, shared_pages)
-                else:
-                    self.stat_prefix_misses += 1
-                    self._metrics.decode_prefix(self._deployment, False, 0)
-            seq.prefill_pos = reuse
-            seq.prefix_len = reuse
-            for c in seq.trace_ctxs:
-                ms = c.buf.begin(
-                    "decode.prefix_match" if self.prefix_enabled else "decode.admit",
-                    c.span.span_id,
-                    {"slot": slot, "hit": reuse > 0, **self._mesh_attrs},
-                    start_ns=t0,
-                )
-                ms.add_event("reuse", {"tokens": reuse})
-                ms.add_event(
-                    "kv_alloc",
-                    {
-                        "shared_pages": shared_pages,
-                        "reserved_pages": int(self.pool.alloc._reserved[slot]),
-                        "free_pages": self.pool.alloc.free_pages,
-                    },
-                )
-                ms.end()
-        self._kv_gauges()
+            self._install_admit(seq, slot, entry, reuse, t0)
+        if not self._pipeline_take_admit_sweep():
+            # the admission sundries — pool gauges + the queue-deadline
+            # expiry sweep — unless the pipelined overlap window already
+            # ran them under the previous round's in-flight dispatch
+            self._kv_gauges()
+            self._expire_waiting()
         if self._waiting and not self._free and not self._rb_blocked:
             # queue behind fully-occupied slots (the page-budget cause is
             # recorded where try_admit refused above) — the flight frame's
             # blocked-admission attribution
             self._rb_blocked = "slots"
-        if self._waiting:
-            # whoever is STILL waiting after admission filled every free
-            # slot: expire those past the queue deadline (the
-            # micro-batcher's REQUEST_TIMEOUT contract; this runs every
-            # step while slots are contended)
-            now = time.perf_counter()
-            for seq in [s for s in self._waiting if s.deadline and s.deadline < now]:
-                self._waiting.remove(seq)
-                if not seq.future.done():
-                    seq.future.set_exception(
-                        APIException(
-                            ErrorCode.REQUEST_TIMEOUT,
-                            "request timed out waiting for a decode slot",
-                        )
+
+    def _expire_waiting(self) -> None:
+        """Expire waiting requests past the queue deadline (the
+        micro-batcher's REQUEST_TIMEOUT contract) — runs every round
+        while slots are contended, from the serial admission walk or
+        hoisted under the in-flight dispatch by ``_pipeline_sundries``
+        (expiry touches only un-admitted waiters, so mid-flight is
+        observably identical). A waiter the SAME window already
+        flight-decided is admitted, not waiting — the serial walk pops
+        admitted seqs before expiry ever sees them, and the pipelined
+        walk must match (expiring a decided admit would fail the caller
+        while _apply_pending installs the slot anyway)."""
+        if not self._waiting:
+            return
+        decided = {p.seq.uid for p in self._pending_admits}
+        now = time.perf_counter()
+        for seq in [
+            s
+            for s in self._waiting
+            if s.deadline and s.deadline < now and s.uid not in decided
+        ]:
+            self._waiting.remove(seq)
+            if not seq.future.done():
+                seq.future.set_exception(
+                    APIException(
+                        ErrorCode.REQUEST_TIMEOUT,
+                        "request timed out waiting for a decode slot",
                     )
-        self.stat_peak_active = max(self.stat_peak_active, self.active)
+                )
+
+    # ------------------------------------------------- pipelined round state
+    def _pipeline_on(self) -> bool:
+        """Whether this round may run the double-buffered path: the
+        ENGINE_DECODE_PIPELINE kill switch (captured at build into
+        ``pipeline_enabled`` — bench's A/B leg flips the attribute per
+        run) AND not ENGINE_FLIGHT_SYNC_TIMING, whose ground-truth
+        per-dispatch timing needs the serial loop."""
+        return self.pipeline_enabled and not self._sync_timing
+
+    def _overlap_window(self) -> None:
+        """Round N+1's host phases, run while round N's dispatch is in
+        flight (between the enqueue and the blocking readback). Each
+        stage is gated on its OWN measured cost (_PipelineGate): a phase
+        the microscope measures as trivially cheap is not worth moving
+        across the round boundary. Phase timers route to the frame's
+        ``overlap_ns`` here (PhaseTimer overlap mode) — this wall sits
+        inside the dispatch's busy window, so booking it into phase_ns
+        would break sum(phase) <= gap."""
+        t0 = time.perf_counter_ns()
+        self._phases.begin_overlap()
+        try:
+            if self._waiting and self._free and self._gate.allow("admit"):
+                g0 = time.perf_counter_ns()
+                with self._phase(P_ADMIT):
+                    self._pipeline_admit()
+                self._gate.note("admit", time.perf_counter_ns() - g0)
+            if (
+                self._pending_admits
+                or any(s is not None and s.prefilling for s in self._slots)
+            ) and self._gate.allow("build"):
+                g0 = time.perf_counter_ns()
+                with self._phase(P_ALLOC):
+                    self._pipeline_plan_chunk()
+                self._gate.note("build", time.perf_counter_ns() - g0)
+            # the per-round admission sundries ride EVERY window, ungated:
+            # guaranteed per-round work that the flight hides for free
+            self._pipeline_sundries()
+        finally:
+            self._phases.end_overlap()
+            self._rb_overlap += time.perf_counter_ns() - t0
+            self.stat_pipelined_rounds += 1
+
+    def _pipeline_admit(self) -> None:
+        """Round N+1's admission DECISIONS under round N's in-flight
+        dispatch, recorded into the shadow pending list — the sequence is
+        installed into the live slot table only at ``_apply_pending``
+        after the readback walks. Conservative by construction: slots
+        come from the CURRENT free list (never a predicted retirement)
+        and reservations run against the pre-retire pool, so a decision
+        made here is valid no matter how round N retires. A head the
+        tight pool cannot yet guarantee is NOT a failure: it defers to
+        the serial ``_admit`` after the reconcile, where round N's
+        retirements may have freed its pages (the deferred-admit path
+        ``stat_pipeline_deferred`` counts)."""
+        pending = self._pending_admits
+        taken = {p.slot for p in pending}
+        queued = {p.seq.uid for p in pending}
+        avail = [s for s in self._free if s not in taken]
+        for seq in self._waiting:
+            if seq.uid in queued:
+                continue
+            if seq.future.cancelled():
+                # the serial walk owns queue cleanup; skipping keeps this
+                # pass read-only on the waiting deque
+                continue
+            if not avail:
+                break
+            slot = avail[-1]
+            t0 = telemetry.now_ns()
+            entry, reuse, admitted = self._admit_decide(seq, slot)
+            if not admitted:
+                # FIFO: the head defers, everyone behind waits with it
+                self.stat_pipeline_deferred += 1
+                break
+            avail.pop()
+            pending.append(_PendingAdmit(seq, slot, entry, reuse, t0))
+
+    def _pipeline_sundries(self) -> None:
+        """The serial walk's per-round sundries, hoisted under the
+        flight: the queue-deadline expiry sweep (O(queue) every contended
+        round) and the pool gauges. Both touch only un-admitted waiters /
+        metrics, so running them mid-flight is observably identical — the
+        serial _admit skips them for one round via the take-accessor (a
+        retire refreshes the gauges on its own path regardless)."""
+        with self._phase(P_ADMIT):
+            self._expire_waiting()
+            self._kv_gauges()
+        self._pending_admit_sweep = True
+
+    def _pipeline_take_admit_sweep(self) -> bool:
+        """One-shot: whether the last overlap window already ran the
+        admission sundries (expiry sweep + gauges) for this round — the
+        serial walk consumes the marker so a serialized round (no window,
+        kill switch, sync timing) runs them itself."""
+        swept = self._pending_admit_sweep
+        self._pending_admit_sweep = False
+        return swept
+
+    def _pipeline_plan_chunk(self) -> None:
+        """Round N+1's chunk-round INPUT BUILD against the shadow state:
+        the prefilling slots' next chunk plus the pending admissions'
+        first, as the same bucketed arrays ``_chunk_round`` would build.
+        Pure array construction — page residency (prepare_write / CoW)
+        stays in the serial chunk round, because a CoW copy is not
+        rollback-safe while a numpy build is. The plan carries a snapshot
+        key; ``_pipeline_take_chunk_plan`` hands it out only when the
+        live state still matches, so any cancellation, extra admission,
+        or error-path reset in between silently invalidates it — discard
+        IS the rollback."""
+        rows: list[tuple[int, int, int, int, _Seq]] = []
+        for i, seq in enumerate(self._slots):
+            if seq is None or not seq.prefilling or seq.future.cancelled():
+                continue
+            rem = self.seq_len - seq.prefill_pos
+            c = min(rem, seq.chunk_cap or rem)
+            if c > 0:
+                rows.append((i, seq.uid, seq.prefill_pos, c, seq))
+        for p in self._pending_admits:
+            if p.seq.future.cancelled():
+                continue
+            rem = self.seq_len - p.reuse
+            c = min(rem, p.seq.chunk_cap or rem)
+            if c > 0:
+                rows.append((p.slot, p.seq.uid, p.reuse, c, p.seq))
+        if not rows:
+            self._pending_chunk_plan = None
+            return
+        rows.sort(key=lambda r: r[0])
+        key = tuple(r[:4] for r in rows)
+        self._pending_chunk_plan = (key,) + self._chunk_input_arrays(rows)
+
+    def _chunk_input_arrays(self, rows: list) -> tuple:
+        """The chunk round's bucketed input arrays from
+        ``(slot, uid, prefill_pos, count, seq)`` rows — ONE builder shared
+        by the serial chunk round and the overlap-window plan, so the
+        array layout cannot drift between the two paths (the plan's
+        snapshot key covers the rows, not the layout). Returns
+        ``(bucket, ids, pos, counts, temps, topks)``."""
+        need = max(r[3] for r in rows)
+        bucket = next(b for b in self.chunk_buckets if b >= need)
+        ids = np.zeros((self.n_slots, bucket), np.int32)
+        pos = np.zeros(self.n_slots, np.int32)
+        counts = np.zeros(self.n_slots, np.int32)
+        temps = np.zeros(self.n_slots, np.float32)
+        topks = np.zeros(self.n_slots, np.int32)
+        for slot, _uid, pp, c, seq in rows:
+            ids[slot, :c] = seq.prompt[pp : pp + c]
+            pos[slot] = pp
+            counts[slot] = c
+            temps[slot] = seq.temperature
+            topks[slot] = seq.top_k
+        return bucket, ids, pos, counts, temps, topks
+
+    def _pipeline_take_chunk_plan(self, key: tuple):
+        """Hand the overlap-built chunk plan to the chunk round iff the
+        live state still matches its snapshot key — one-shot either way
+        (taken or stale, the slot clears). Stale is normal, not an error:
+        it means the state the plan speculated against moved (a
+        cancellation, an admission the serial walk added, a reset) and
+        the serial build runs instead."""
+        plan = self._pending_chunk_plan
+        self._pending_chunk_plan = None
+        if plan is not None and plan[0] == key:
+            self.stat_pipeline_plans_used += 1
+            return plan
+        return None
+
+    def _apply_pending(self) -> None:
+        """THE reconcile funnel for the shadow admissions: install the
+        flight-decided entries into the live slot table — after the
+        readback walks (which must see exactly the dispatch-time slot
+        state) and before ``_commit_round`` (the admissions belong to
+        this round's frame, exactly like the serial walk's). A pending
+        entry whose caller vanished during the flight rolls back:
+        ``alloc.retire`` releases the reservation and refcounts, the
+        decision's only live footprint."""
+        if not self._pending_admits:
+            return
+        while self._pending_admits:
+            p = self._pending_admits.pop(0)
+            if p.seq.future.done():
+                # the caller vanished during the flight — cancelled, or
+                # failed by anything that settles futures (a decided admit
+                # cannot have a RESULT: only retirement resolves, and the
+                # seq was never installed). Installing would burn a slot
+                # generating for a request that already failed.
+                self.pool.alloc.retire(p.slot)
+                self.stat_pipeline_rollbacks += 1
+                try:
+                    self._waiting.remove(p.seq)
+                except ValueError:
+                    # defensive: the waiting deque never drops un-admitted
+                    # entries mid-flight (expiry skips decided admits)
+                    pass
+                continue
+            entry, reuse = p.entry, p.reuse
+            if self.prefix_enabled and reuse < self.seq_len - 1:
+                # the flight decision matched an index that predates this
+                # round's CAPTURES (a retire in the consume walk can
+                # capture the very prompt a flight-decided sharer carries
+                # — the serial walk, admitting after the walks, would see
+                # it). Re-match at reconcile and upgrade: host-only work,
+                # and it keeps warm-hit behavior identical to the serial
+                # loop instead of silently paying a full prefill.
+                with self._phase(P_PREFIX_MATCH):
+                    _, depth = self._prefix_index.match(p.seq.prompt, touch=False)
+                if min(depth, self.seq_len - 1) > reuse:
+                    self.pool.alloc.retire(p.slot)  # undo the shallow mapping
+                    entry, reuse, ok = self._admit_decide(p.seq, p.slot)
+                    if not ok:
+                        # post-retire + deeper reuse can only need FEWER
+                        # pages, so this is defensive: leave the head in
+                        # the queue for the serial walk (FIFO intact)
+                        self.stat_pipeline_deferred += 1
+                        continue
+            try:
+                self._waiting.remove(p.seq)
+            except ValueError:
+                # defensive: the waiting deque never drops un-admitted
+                # entries mid-flight (expiry skips decided admits)
+                pass
+            self._free.remove(p.slot)
+            self._install_admit(p.seq, p.slot, entry, reuse, p.t0)
+            self.stat_pipeline_admits += 1
+        self._kv_gauges()
 
     def _draft_admit(self, slot_ids: list[int]) -> None:
         """Draft-cache prompt prefill for slots finishing incremental
@@ -1642,23 +2032,31 @@ class DecodeScheduler:
                 need = max(need, int(counts[i]))
             if need == 0:
                 return
-            bucket = next(b for b in self.chunk_buckets if b >= need)
-            ids = np.zeros((self.n_slots, bucket), np.int32)
-            pos = np.zeros(self.n_slots, np.int32)
-            temps = np.zeros(self.n_slots, np.float32)
-            topks = np.zeros(self.n_slots, np.int32)
-            counts = np.minimum(counts, bucket)
+            # the pipelined loop may have prebuilt this round's input
+            # arrays under the previous round's dispatch — valid only if
+            # the live state still matches the plan's snapshot key
+            rows = [
+                (i, seq.uid, seq.prefill_pos, int(counts[i]), seq)
+                for i, seq in enumerate(self._slots)
+                if seq is not None and counts[i] > 0
+            ]
+            key = tuple(r[:4] for r in rows)
+            plan = self._pipeline_take_chunk_plan(key)
+            if plan is not None:
+                _, bucket, ids, pos, counts, temps, topks = plan
+            else:
+                bucket, ids, pos, counts, temps, topks = (
+                    self._chunk_input_arrays(rows)
+                )
             copies: list[tuple[int, int]] = []
             for i, seq in enumerate(self._slots):
                 if counts[i] == 0 or seq is None:
                     continue
-                ids[i, : counts[i]] = seq.prompt[seq.prefill_pos : seq.prefill_pos + counts[i]]
-                pos[i] = seq.prefill_pos
-                temps[i] = seq.temperature
-                topks[i] = seq.top_k
                 # page residency for this slot's write range: allocate fresh
                 # pages, copy-on-write the shared boundary page (the reader's
-                # first divergent write into a prefix-mapped page)
+                # first divergent write into a prefix-mapped page) — always
+                # serial: a CoW copy is not rollback-safe, so residency is
+                # never decided under an in-flight dispatch
                 copies += self.pool.alloc.prepare_write(i, int(pos[i]), int(counts[i]))
         await self._run_copies(copies)
         with self._phase(P_ALLOC):
@@ -1793,10 +2191,70 @@ class DecodeScheduler:
         self._rb_busy[F_DRAFT] += d_ns
         self._rb_busy[F_VERIFY] += v_enq + v_rdb
         self._rb_rdb[F_VERIFY] += v_rdb
-        self.stat_spec_dispatches += 1
         # dispatch-time occupancy, committed (with steps/metrics) at the
         # round's single _commit_round point
-        active = self._rb_active = self.active
+        self._rb_active = self.active
+        self._consume_spec(out_t, acc, limits, wlimits, t0, t1)
+
+    async def _spec_round_pipelined(
+        self, bt, toks, pos, temps, topks, limits, wlimits, tick
+    ) -> None:
+        """The double-buffered twin of ``_spec_round``: the round pair's
+        draft + widened-verify dispatches enqueue back-to-back, round
+        N+1's host phases run under the in-flight pair
+        (``_overlap_window``), and only then does the host block on the
+        verify readback. The verify family's busy column spans the whole
+        enqueue->readback window (the overlap work sits INSIDE the
+        device-busy wall — recorded apart as the frame's overlap_ns), and
+        rdb is the true post-overlap block. Sync-timing runs never come
+        here (_pipeline_on forces the serial twin)."""
+        tree = self.spec_tree
+        t0 = telemetry.now_ns()
+        td0 = time.perf_counter_ns()
+        if tree is not None:
+            node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
+                self.draft_params, self._dck, self._dcv, toks, pos, temps,
+                topks, self._seed, tick, tree,
+            )
+            td1 = time.perf_counter_ns()
+            out_dev, acc_dev, state, dck, dcv = self._tree_verify_fn(
+                self.params, self.pool.state, bt, toks, node_toks, blogits,
+                nk, nv, dck, dcv, pos, wlimits, temps, topks,
+                self._seed, tick, tree,
+            )
+        else:
+            drafts, dlogits, dck, dcv = self._draft_fn(
+                self.draft_params, self._dck, self._dcv, toks, pos, temps,
+                topks, self._seed, tick, self.spec_k,
+            )
+            td1 = time.perf_counter_ns()
+            out_dev, acc_dev, state = self._verify_fn(
+                self.params, self.pool.state, bt, toks, drafts, dlogits, pos,
+                limits, temps, topks, self._seed, tick,
+            )
+        self.pool.state = state
+        self._dck = dck
+        self._dcv = dcv
+        self._rb_active = self.active  # dispatch-time occupancy
+        self._overlap_window()
+        t2 = time.perf_counter_ns()
+        out_t, acc = await self._device_call(
+            lambda: (np.asarray(out_dev), np.asarray(acc_dev))
+        )
+        t3 = time.perf_counter_ns()
+        t1 = telemetry.now_ns()
+        self._rb_busy[F_DRAFT] += td1 - td0
+        self._rb_busy[F_VERIFY] += t3 - td1
+        self._rb_rdb[F_VERIFY] += t3 - t2
+        self._consume_spec(out_t, acc, limits, wlimits, t0, t1)
+
+    def _consume_spec(self, out_t, acc, limits, wlimits, t0: int, t1: int) -> None:
+        """The readback-dependent half of a speculative round, shared by
+        the serial and pipelined dispatch twins: the accept/emission walk
+        over the verify readback, retirements, speculation attribution,
+        and the adaptive controller's update."""
+        tree = self.spec_tree
+        self.stat_spec_dispatches += 1
         # ``proposed`` is the round's ACCEPTANCE OPPORTUNITY — depth
         # positions a path could advance through — for both modes, so
         # accept rate means the same thing on chain and tree deployments
@@ -1861,6 +2319,30 @@ class DecodeScheduler:
         self._metrics.decode_spec(
             self._deployment, proposed, accepted, emitted, mode=mode
         )
+
+    async def _step_round_pipelined(self, bt, toks, pos, temps, topks, tick):
+        """The double-buffered plain round: enqueue the fused step, run
+        round N+1's host phases under the in-flight dispatch
+        (``_overlap_window``), then block on the token readback. The step
+        family's busy column spans the whole enqueue->readback window
+        (the overlap work sits INSIDE the device-busy wall — recorded
+        apart as the frame's overlap_ns); rdb is the true post-overlap
+        block. Sync-timing runs never come here (_pipeline_on forces the
+        serial path)."""
+        t0 = time.perf_counter_ns()
+        nxt_dev, state = self._step_fn(
+            self.params, self.pool.state, bt, toks, pos, temps, topks,
+            self._seed, tick,
+        )
+        self.pool.state = state
+        self._rb_active = self.active  # dispatch-time occupancy
+        self._overlap_window()
+        t2 = time.perf_counter_ns()
+        nxt = await self._device_call(lambda: np.asarray(nxt_dev))
+        t3 = time.perf_counter_ns()
+        self._rb_busy[F_STEP] += t3 - t0
+        self._rb_rdb[F_STEP] += t3 - t2
+        return nxt
 
     async def _run(self) -> None:
         try:
@@ -2000,16 +2482,31 @@ class DecodeScheduler:
                             continue
                         copies += self.pool.alloc.prepare_write(i, seq.pos, width)
                 await self._run_copies(copies)
+                pipelined = self._pipeline_on()
                 with self._phase(P_ALLOC):
                     bt = self.pool.block_tables()
-                    # per-round pool gauges: this round's prepare_write may
-                    # have allocated/CoW'd pages with no admission between
-                    self._kv_gauges()
+                    if not pipelined:
+                        # per-round pool gauges: this round's prepare_write
+                        # may have allocated/CoW'd pages with no admission
+                        # between. The pipelined loop refreshes them inside
+                        # every overlap window (_pipeline_sundries) — at
+                        # most one round stale, hidden under the flight.
+                        self._kv_gauges()
 
                 if spec_round:
-                    await self._spec_round(
-                        bt, toks, pos, temps, topks, limits, wlimits, tick
-                    )
+                    if pipelined:
+                        await self._spec_round_pipelined(
+                            bt, toks, pos, temps, topks, limits, wlimits, tick
+                        )
+                    else:
+                        await self._spec_round(
+                            bt, toks, pos, temps, topks, limits, wlimits, tick
+                        )
+                    # reconcile the shadow admissions decided under the
+                    # round pair's flight BEFORE the frame commits (they
+                    # belong to this round, like the serial walk's)
+                    with self._phase(P_ADMIT):
+                        self._apply_pending()
                     self._commit_round(
                         "tree" if self.spec_tree is not None else "chain",
                         step=True,
@@ -2017,18 +2514,26 @@ class DecodeScheduler:
                     await asyncio.sleep(0)
                     continue
 
-                def _do_step():
-                    nxt, state = self._step_fn(
-                        self.params, self.pool.state, bt, toks, pos, temps,
-                        topks, self._seed, tick,
+                if pipelined:
+                    nxt = await self._step_round_pipelined(
+                        bt, toks, pos, temps, topks, tick
                     )
-                    if self._sync_timing:
-                        jax.block_until_ready((nxt, state))
-                    self._mark_enqueued()
-                    return np.asarray(nxt), state
+                else:
 
-                nxt, self.pool.state = await self._timed_call(F_STEP, _do_step)
-                self._rb_active = self.active  # dispatch-time occupancy
+                    def _do_step():
+                        nxt, state = self._step_fn(
+                            self.params, self.pool.state, bt, toks, pos, temps,
+                            topks, self._seed, tick,
+                        )
+                        if self._sync_timing:
+                            jax.block_until_ready((nxt, state))
+                        self._mark_enqueued()
+                        return np.asarray(nxt), state
+
+                    nxt, self.pool.state = await self._timed_call(
+                        F_STEP, _do_step
+                    )
+                    self._rb_active = self.active  # dispatch-time occupancy
                 with self._phase(P_SAMPLING):
                     # sampled-token consumption: the readback array walked
                     # into per-slot emissions/retirements
@@ -2040,6 +2545,9 @@ class DecodeScheduler:
                         self._emit(seq, tok)
                         if self._finished(seq, tok):
                             self._retire(i)
+                # reconcile the shadow admissions decided under the flight
+                with self._phase(P_ADMIT):
+                    self._apply_pending()
                 self._commit_round("plain", step=True)
                 # yield between steps so admissions/ingress interleave with
                 # the decode loop instead of starving behind it

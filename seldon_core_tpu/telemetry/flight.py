@@ -27,8 +27,14 @@ to a bounded ring —
   incl. prefix match and allocator reservation, chunk-result scatter, the
   emission/SLO walk, the spec accept walk, the sampled-token walk, the
   round commit itself) via the scheduler's ``with self._phase(P_X):``
-  blocks over a :class:`PhaseTimer` — the decomposition a pipelined
-  decode loop is designed against;
+  blocks over a :class:`PhaseTimer` — the decomposition the pipelined
+  decode loop was designed against;
+- ``overlap_ns``: host work the PIPELINED round loop ran INSIDE a
+  dispatch's busy window (round N+1's admission decisions under round N's
+  in-flight step — serving/decode_scheduler.py). Overlapped work sits in
+  busy, not gap, so pipelining genuinely shrinks ``bubble_fraction``; the
+  aggregate's ``overlap_of_gap`` / ``bubble_residual`` split the would-be
+  serial gap into hidden vs still-exposed;
 - the page pool's free/live/prefix page counts and the round's CoW copies.
 
 Append is O(1) (one ``__slots__`` object + a ring store + a handful of
@@ -62,6 +68,7 @@ import time
 
 
 from seldon_core_tpu.utils.env import (
+    ENGINE_DECODE_PIPELINE,
     ENGINE_FLIGHT,
     ENGINE_FLIGHT_FRAMES,
     ENGINE_FLIGHT_SYNC_TIMING,
@@ -127,6 +134,20 @@ def sync_timing_enabled(env: dict | None = None) -> bool:
     )
 
 
+def decode_pipeline_enabled(env: dict | None = None) -> bool:
+    """ENGINE_DECODE_PIPELINE=off: force the scheduler's SERIAL round loop
+    (round N+1's host phases wait for round N's readback). Default on.
+    Independent of — but composed with — sync timing: the scheduler also
+    forces serial under ENGINE_FLIGHT_SYNC_TIMING, since ground-truth
+    per-dispatch timing needs the unpipelined loop."""
+    env = env if env is not None else os.environ
+    return str(env.get(ENGINE_DECODE_PIPELINE, "on")).strip().lower() not in (
+        "off",
+        "0",
+        "false",
+    )
+
+
 def _env_capacity(env: dict | None = None) -> int:
     env = env if env is not None else os.environ
     try:
@@ -151,7 +172,7 @@ class _PhaseCtx:
         now = time.perf_counter_ns()
         stack = t._stack
         if stack:
-            t.ns[stack[-1]] += now - t._mark
+            t._acct(stack[-1], now - t._mark)
         stack.append(self.p)
         t._mark = now
         return self
@@ -163,7 +184,7 @@ class _PhaseCtx:
             # a reset() issued while a phase is open (defensive: the
             # scheduler never does) drops the span instead of raising
             # into the decode loop
-            t.ns[t._stack.pop()] += now - t._mark
+            t._acct(t._stack.pop(), now - t._mark)
         t._mark = now
         return False
 
@@ -191,14 +212,33 @@ class PhaseTimer:
     Disabled (the ENGINE_FLIGHT kill switch) every handle is a shared
     no-op and the arrays stay zero."""
 
-    __slots__ = ("ns", "enabled", "_stack", "_mark", "_ctxs")
+    __slots__ = ("ns", "enabled", "overlap_ns", "_overlap", "_stack", "_mark", "_ctxs")
 
     def __init__(self, enabled: bool = True):
         self.enabled = bool(enabled)
         self.ns = [0] * N_PHASES
+        # overlap mode (begin_overlap/end_overlap): phase segments timed
+        # while the pipelined loop runs host work UNDER an in-flight
+        # dispatch accrue here instead of the per-phase array — that wall
+        # sits inside the round's device-busy window, so booking it into
+        # ``ns`` would break sum(phase) <= gap
+        self.overlap_ns = 0
+        self._overlap = False
         self._stack: list[int] = []
         self._mark = 0
         self._ctxs = tuple(_PhaseCtx(self, p) for p in range(N_PHASES))
+
+    def _acct(self, p: int, dt: int) -> None:
+        if self._overlap:
+            self.overlap_ns += dt
+        else:
+            self.ns[p] += dt
+
+    def begin_overlap(self) -> None:
+        self._overlap = True
+
+    def end_overlap(self) -> None:
+        self._overlap = False
 
     def phase(self, p: int):
         """The ``with``-handle for phase ``p`` (a P_* constant)."""
@@ -208,6 +248,8 @@ class PhaseTimer:
 
     def reset(self) -> None:
         self.ns = [0] * N_PHASES
+        self.overlap_ns = 0
+        self._overlap = False
         self._stack.clear()
 
     def commit(self, p: int, t0_ns: int) -> tuple:
@@ -242,20 +284,23 @@ class FlightFrame:
     FAMILIES (enqueue + blocked readback per family); ``rdb_ns`` the
     blocked-readback share of each family (enqueue = busy - rdb);
     ``phase_ns`` the host gap attributed per PHASES entry; ``gap_ns`` the
-    round's host bubble (wall - device busy)."""
+    round's host bubble (wall - device busy); ``overlap_ns`` the host work
+    the PIPELINED loop ran inside a dispatch's busy window (hidden under
+    the in-flight dispatch — inside busy, NOT part of the gap, which is
+    exactly why pipelining shrinks bubble_fraction)."""
 
     __slots__ = (
         "seq", "t_ns", "mode", "active", "prefilling", "queued",
         "admitted", "retired", "blocked", "tokens", "accepted", "proposed",
         "spec_depth", "busy_ns", "gap_ns", "kv_free", "kv_live",
-        "kv_prefix", "cow", "phase_ns", "rdb_ns",
+        "kv_prefix", "cow", "phase_ns", "rdb_ns", "overlap_ns",
     )
 
     def __init__(
         self, seq, t_ns, mode, active, prefilling, queued, admitted,
         retired, blocked, tokens, accepted, proposed, spec_depth,
         busy_ns, gap_ns, kv_free, kv_live, kv_prefix, cow,
-        phase_ns=_ZERO_PHASES, rdb_ns=_ZERO_FAMILIES,
+        phase_ns=_ZERO_PHASES, rdb_ns=_ZERO_FAMILIES, overlap_ns=0,
     ):
         self.seq = seq
         self.t_ns = t_ns
@@ -278,6 +323,7 @@ class FlightFrame:
         self.cow = cow
         self.phase_ns = phase_ns
         self.rdb_ns = rdb_ns
+        self.overlap_ns = overlap_ns
 
     def to_dict(self) -> dict:
         d: dict = {
@@ -315,6 +361,8 @@ class FlightFrame:
                 for i, ns in enumerate(self.phase_ns)
                 if ns
             }
+        if self.overlap_ns:
+            d["overlap_us"] = round(self.overlap_ns / 1e3, 1)
         if self.admitted:
             d["admitted"] = self.admitted
         if self.retired:
@@ -364,6 +412,7 @@ class FlightRecorder:
         self.rdb_ns_total = [0] * len(FAMILIES)
         self.phase_ns_total = [0] * N_PHASES
         self.gap_ns_total = 0
+        self.overlap_ns_total = 0
         self.tokens_total = 0
         self.occupancy_sum = 0.0
         self.admitted_total = 0
@@ -407,6 +456,7 @@ class FlightRecorder:
         for i, ns in enumerate(frame.phase_ns):
             ph[i] += ns
         self.gap_ns_total += frame.gap_ns
+        self.overlap_ns_total += frame.overlap_ns
         self.tokens_total += frame.tokens
         self.occupancy_sum += frame.active / self.n_slots
         self.admitted_total += frame.admitted
@@ -480,6 +530,7 @@ class FlightRecorder:
         rdb = [0] * len(FAMILIES)
         phase = [0] * N_PHASES
         gap = 0
+        overlap = 0
         tokens = admitted = retired = accepted = proposed = 0
         occ = 0.0
         modes: dict[str, int] = {}
@@ -493,6 +544,7 @@ class FlightRecorder:
             for i, ns in enumerate(f.phase_ns):
                 phase[i] += ns
             gap += f.gap_ns
+            overlap += f.overlap_ns
             tokens += f.tokens
             admitted += f.admitted
             retired += f.retired
@@ -534,6 +586,18 @@ class FlightRecorder:
             "phase_of_gap": round(sum(phase) / gap, 4) if gap else 0.0,
             "gap_ms": round(gap / 1e6, 3),
             "bubble_fraction": round(gap / wall, 4) if wall else 0.0,
+            # host work hidden under in-flight dispatches (the pipelined
+            # loop's win): overlap_of_gap is the share of the would-be
+            # serial gap (gap + overlap) that pipelining hid, and
+            # bubble_residual the share still exposed as bubble — the two
+            # sum to 1 whenever any host work was timed at all
+            "overlap_ms": round(overlap / 1e6, 3),
+            "overlap_of_gap": (
+                round(overlap / (gap + overlap), 4) if (gap + overlap) else 0.0
+            ),
+            "bubble_residual": (
+                round(gap / (gap + overlap), 4) if (gap + overlap) else 0.0
+            ),
             "tokens": tokens,
             "tokens_per_s": round(tokens / (wall / 1e9), 1) if wall else 0.0,
             "admitted": admitted,
@@ -618,6 +682,17 @@ class FlightRecorder:
             "rounds": rounds,
             "occupancy_mean": round(self.occupancy_sum / rounds, 4) if rounds else 0.0,
             "bubble_fraction": round(self.bubble_fraction(), 4),
+            # lifetime share of the would-be serial gap that the pipelined
+            # loop hid under in-flight dispatches (0.0 on the serial loop)
+            "overlap_of_gap": (
+                round(
+                    self.overlap_ns_total
+                    / (self.gap_ns_total + self.overlap_ns_total),
+                    4,
+                )
+                if (self.gap_ns_total + self.overlap_ns_total)
+                else 0.0
+            ),
             # the bubble's top contributor by lifetime phase totals, and
             # how much of the gap the phase timers account for at all
             "top_gap_phase": self.top_gap_phase(),
@@ -698,7 +773,7 @@ class FlightRecorder:
                     i, t0 + i, "plain", 7, 1, 3, 1, 1, "", 8, 4, 6, 3,
                     (0, 120_000, 40_000, 180_000, 0), 90_000, 5, 12, 4, 1,
                     (12_000, 2_000, 8_000, 0, 30_000, 20_000, 0, 4_000),
-                    (0, 60_000, 0, 150_000, 0),
+                    (0, 60_000, 0, 150_000, 0), 25_000,
                 )
             )
         return round((time.perf_counter_ns() - t0) / n / 1e3, 3)

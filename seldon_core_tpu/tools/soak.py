@@ -366,11 +366,18 @@ async def soak(
             "modes": fa["modes"],
             "occupancy_mean": fa["occupancy_mean"],
             "bubble_fraction": fa["bubble_fraction"],
+            # the pipelined loop's win: host work hidden under in-flight
+            # dispatches, and the share of the would-be serial gap it
+            # covered vs the residual still exposed as bubble
+            "overlap_ms": fa["overlap_ms"],
+            "overlap_of_gap": fa["overlap_of_gap"],
+            "bubble_residual": fa["bubble_residual"],
+            "pipelined_rounds": sched.stat_pipelined_rounds,
             "busy_ms": fa["busy_ms"],
             # the enqueue/readback split of busy_ms and the per-phase
             # decomposition of gap_ms — the host-bubble attribution the
-            # pipelined-decode ROADMAP item spends, printed beside the
-            # aggregate exactly as GET /decode/flight serves it
+            # pipelined decode loop spends, printed beside the aggregate
+            # exactly as GET /decode/flight serves it
             "enqueue_ms": fa["enqueue_ms"],
             "readback_ms": fa["readback_ms"],
             "phase_ms": fa["phase_ms"],
@@ -400,6 +407,28 @@ async def soak(
                 "loop stack (ENGINE_DECODE_PROFILE off? run shorter than "
                 f"one {prof.hz} Hz sampling tick?)"
             )
+        # the profile-smoke leg doubles as the pipelined-round gate: the
+        # generative smoke must actually hide host work under in-flight
+        # dispatches — a silently-serialized decode loop (pipeline flag
+        # dropped, overlap window skipped, overlap accounting broken)
+        # fails CI here instead of shipping as a quiet perf regression
+        if (
+            sched is not None
+            and sched._pipeline_on()
+            and flight_stats is not None
+            and flight_stats.get("rounds")
+        ):
+            # overlap_of_gap comes from flight frames — with the recorder
+            # killed (ENGINE_FLIGHT=off) or no frames recorded there is
+            # nothing to judge, and failing would blame the pipeline for
+            # a telemetry kill switch
+            ov = flight_stats.get("overlap_of_gap", 0.0)
+            if not ov > 0.0:
+                raise RuntimeError(
+                    "soak --profile: the decode pipeline is on but "
+                    "overlap_of_gap is 0 — no host work was hidden under "
+                    "an in-flight dispatch (silently-serialized loop?)"
+                )
         with open(profile_out, "w") as f:
             f.write("\n".join(folded) + "\n")
         rep = prof.report(n=3)

@@ -128,6 +128,8 @@ def worst_case_full_record() -> dict:
             "loop": {
                 "frames": 1234,
                 "bubble_fraction": 0.3127,
+                "overlap_of_gap": 0.232,
+                "bubble_residual": 0.768,
                 "occupancy": 0.8911,
                 "blocked_rounds": 17,
                 "record_us": 4.812,
@@ -138,6 +140,24 @@ def worst_case_full_record() -> dict:
                     "sampling": 0.0691, "commit": 0.0223,
                 },
             },
+        },
+        "serial_loop": {
+            "tokens_per_sec": 1573.1,
+            "ttft_p50_ms": 655.02,
+            "recompiles_after_warmup": 0,
+            "loop": {
+                "frames": 1221, "bubble_fraction": 0.3127,
+                "overlap_of_gap": 0.0, "bubble_residual": 1.0,
+                "occupancy": 0.888, "blocked_rounds": 19, "record_us": 4.7,
+            },
+        },
+        "pipeline": {
+            "outputs_identical": True,
+            "tokens_per_sec_pipelined": 1690.42,
+            "tokens_per_sec_serial": 1573.1,
+            "bubble_fraction_pipelined": 0.2471,
+            "bubble_fraction_serial": 0.3127,
+            "overlap_of_gap": 0.232,
         },
         "spec": {
             "tokens_per_sec": 2890.13,
@@ -335,11 +355,16 @@ def test_compact_record_carries_every_headline():
         # [bubble_fraction, occupancy, record_us] + the top-3 gap-phase
         # fractions (host-bubble attribution; recorded, not gated)
         "loop": [0.313, 0.891, 4.8],
-        "loop_ph": {"admit": 0.132, "alloc": 0.113, "sampling": 0.069},
+        "loop_ph": {"admit": 0.132, "alloc": 0.113},
+        # pipelined-vs-serial A/B, packed [tok_s_serial, bubble_serial,
+        # overlap_of_gap] — the pipelined side IS gen.tok_s/gen.loop[0];
+        # position 2 is --compare-gated (identity contract in the full
+        # record)
+        "pipe": [1573.1, 0.313, 0.232],
         "spec_tok_s": 2890.13,
         "accept_rate": 0.941,
         "tok_disp": 4.31,
-        "spec_speedup": 1.71,
+        "spec_spd": 1.71,
         "spec_k": 4,
         # prefix-cache sub-leg: cold/warm TTFT split, hit rate, prefill
         # tokens displaced, tokens/s + ITL with chunking off/on
@@ -347,8 +372,8 @@ def test_compact_record_carries_every_headline():
         # detail record)
         "prefix_cold": 171.33,
         "prefix_warm": 41.27,
-        "prefix_ttft_speedup": 4.15,
-        "prefix_hit_rate": 0.958,
+        "prefix_spd": 4.15,
+        "prefix_hit": 0.958,
         "prefix_saved": 1288,
         "prefix_tok_s": 1411.02,
         "prefix_tok_s_ck": 1389.77,
@@ -360,7 +385,7 @@ def test_compact_record_carries_every_headline():
         # + distilled-draft delta live in the full record / PARITY.md)
         "tree_tok_s": [63.4, 58.8],
         "tree_ride": [3.21, 2.37],
-        "tree_speedup": 1.08,
+        "tree_spd": 1.08,
         # tensor-parallel sub-leg: tokens/s per width (width order), the
         # widest leg's speedup + identity contract, recompiles all-zero
         "tp_w": [1, 2, 4],

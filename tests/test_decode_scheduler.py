@@ -638,6 +638,266 @@ async def test_spec_requires_draft_and_serving_wiring():
         await sched.close()
 
 
+# ---------------------------------------------------------- pipelined rounds
+#
+# The double-buffered round loop (ENGINE_DECODE_PIPELINE, on by default):
+# round N+1's host phases run under round N's in-flight dispatch against
+# shadow pending state, reconciled at readback. The contract these tests
+# pin: bit-identical greedy output vs the serial loop (and the oracle) for
+# every round shape, zero recompiles, and a rollback-safe deferred-admit
+# path under tight page budgets.
+
+
+def _serial(s: DecodeScheduler) -> DecodeScheduler:
+    """Force the serial loop on one scheduler instance (the per-run
+    equivalent of the ENGINE_DECODE_PIPELINE=off kill switch — what
+    bench's A/B leg flips)."""
+    s.pipeline_enabled = False
+    return s
+
+
+async def _staggered(sched, ids, budgets=None, stagger=0.002):
+    async def one(i):
+        await asyncio.sleep(i * stagger)
+        kw = {} if budgets is None else {"max_new_tokens": int(budgets[i])}
+        return await sched.submit(ids[i], **kw)
+
+    return await asyncio.gather(*(one(i) for i in range(len(ids))))
+
+
+async def test_pipelined_greedy_bit_identical_midstream():
+    """The tentpole contract: pipelined greedy output is bit-identical to
+    the serial loop's (and the oracle's) under mixed mid-stream admits and
+    retirements — identical round composition by construction
+    (flight-decided admissions install before the next round's serial
+    walk; deferred heads retry there against the post-retire pool)."""
+    params = _params()
+    ids = _prompts(6, seed=31)
+    budgets = [3, MAX_NEW, 5, 2, MAX_NEW, 4]
+    oracle = _oracle(params, ids)
+    serial = _serial(_scheduler(params, n_slots=2))
+    serial_outs = await _staggered(serial, ids, budgets)
+    await serial.close()
+    assert serial.stat_pipelined_rounds == 0
+
+    piped = _scheduler(params, n_slots=2)
+    assert piped._pipeline_on()
+    outs = await _staggered(piped, ids, budgets)
+    for i, (a, b) in enumerate(zip(serial_outs, outs)):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(b, oracle[i][: SEQ + budgets[i]])
+    assert piped.stat_pipelined_rounds > 0
+    # host work was genuinely hidden under in-flight dispatches, and the
+    # phase accounting survived (overlapped work never lands in phase_ns,
+    # so sum(phase) <= gap still holds)
+    agg = piped.flight.aggregate()
+    assert agg["overlap_of_gap"] > 0.0
+    assert agg["overlap_of_gap"] + agg["bubble_residual"] == pytest.approx(
+        1.0, abs=2e-4
+    )
+    assert agg["phase_of_gap"] <= 1.0
+    await piped.close()
+
+
+@pytest.mark.parametrize("shape", ["chain", "tree"])
+async def test_pipelined_spec_rounds_bit_identical(shape):
+    """Speculative rounds through the pipelined dispatch twin: the round
+    pair (draft + widened verify) enqueues, the overlap window runs, and
+    the verify readback reconciles — chain and tree modes both stay
+    bit-identical to the serial loop and the oracle."""
+    params, draft = _draft_pair()
+    ids = _prompts(4, seed=17)
+    kw = {"spec_tree": "2,2,1"} if shape == "tree" else {}
+    oracle = _oracle(params, ids)
+    serial = _serial(_spec_scheduler(params, draft, n_slots=2, spec_k=3, **kw))
+    serial_outs = await _staggered(serial, ids)
+    await serial.close()
+
+    piped = _spec_scheduler(params, draft, n_slots=2, spec_k=3, **kw)
+    outs = await _staggered(piped, ids)
+    for i, (a, b) in enumerate(zip(serial_outs, outs)):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(b, oracle[i])
+    assert piped.stat_spec_dispatches > 0
+    assert piped.stat_pipelined_rounds > 0
+    assert piped.recompiles_since_warmup() == 0
+    await piped.close()
+
+
+async def test_pipelined_prefix_warm_admissions():
+    """Prefix-warm admissions under the pipeline: the seed request's
+    retirement captures its prompt, concurrent sharers then admit against
+    the warm index (some decided mid-flight) — outputs identical to the
+    serial loop, hits register the same."""
+    params = _params()
+    shared = _prompts(1, seed=8)[0]
+    distinct = _prompts(1, seed=9)[0]
+    ids = np.stack([shared, shared, shared, distinct])
+
+    def _mk(pipe: bool) -> DecodeScheduler:
+        s = DecodeScheduler(
+            params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            prefix_slots=4,
+        )
+        s.warmup()
+        return s if pipe else _serial(s)
+
+    serial = _mk(False)
+    serial_outs = [await serial.submit(ids[0])]  # capture seeds the index
+    serial_outs += await _staggered(serial, ids[1:])
+    await serial.close()
+
+    piped = _mk(True)
+    outs = [await piped.submit(ids[0])]
+    outs += await _staggered(piped, ids[1:])
+    for a, b in zip(serial_outs, outs):
+        np.testing.assert_array_equal(a, b)
+    assert piped.stat_prefix_hits == serial.stat_prefix_hits >= 1
+    assert piped.stat_pipelined_rounds > 0
+    assert piped.recompiles_since_warmup() == 0
+    await piped.close()
+
+
+async def test_pipelined_tight_pages_deferred_admit_rollback():
+    """The deferred-admit path: a page budget that fits ONE slot's
+    worst case forces the mid-flight admission attempt to refuse (the
+    pre-retire pool cannot guarantee the reservation) — the head defers
+    to the serial walk after the reconcile and admits once the retirement
+    frees its pages. Outputs stay oracle-identical, the allocator audit
+    stays clean, and the deferral is counted."""
+    params = _params()
+    ids = _prompts(3, seed=23)
+    oracle = _oracle(params, ids)
+    sched = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+        kv_page_size=4, kv_pages=7,  # pages_per_slot=5: one full slot + slack
+    )
+    sched.warmup()
+    assert sched._pipeline_on()
+    outs = await _staggered(sched, ids, stagger=0.001)
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(out, oracle[i])
+    # the tight budget actually serialized occupancy through the pool...
+    assert sched.stat_admit_blocked_rounds > 0
+    # ...and at least one admission attempt was made (and refused) under
+    # an in-flight dispatch — the deferred path
+    assert sched.stat_pipeline_deferred > 0
+    sched.pool.alloc.check()
+    await sched.close()
+
+
+async def test_pipeline_expiry_never_fails_a_decided_admit_and_failed_futures_roll_back():
+    """Two reconcile edges of the shadow admissions: (a) the overlap
+    window's expiry sweep must NOT time out a waiter the same window
+    already flight-decided (the serial walk pops admitted seqs before
+    expiry sees them; failing the caller while installing the slot would
+    burn the whole budget for a dead request), and (b) a pending admit
+    whose future settled during the flight — cancelled OR failed — rolls
+    its reservation back instead of installing."""
+    import time as _time
+
+    from seldon_core_tpu.core.errors import APIException, ErrorCode
+    from seldon_core_tpu.serving.decode_scheduler import _Seq
+
+    params = _params()
+    sched = _scheduler(params, n_slots=2)
+    loop = asyncio.get_running_loop()
+
+    # (a) decided-then-expired: deadline already past when the sweep runs
+    seq = _Seq(_prompts(1, seed=41)[0], 4, 0.0, 0, 0, None, loop.create_future())
+    seq.uid = 10_001
+    seq.deadline = _time.perf_counter() - 1.0
+    sched._waiting.append(seq)
+    sched._overlap_window()  # decides the admission, then runs the sweep
+    assert len(sched._pending_admits) == 1
+    assert not seq.future.done(), "sweep expired a flight-decided admit"
+    sched._apply_pending()
+    assert sched._slots[seq.slot] is seq and seq.prefilling
+
+    # (b) failed-in-flight: the reconcile rolls the reservation back
+    seq2 = _Seq(_prompts(1, seed=43)[0], 4, 0.0, 0, 0, None, loop.create_future())
+    seq2.uid = 10_002
+    sched._waiting.append(seq2)
+    sched._pipeline_admit()
+    assert len(sched._pending_admits) == 1
+    seq2.future.set_exception(
+        APIException(ErrorCode.REQUEST_TIMEOUT, "expired mid-flight")
+    )
+    free_before = len(sched._free)
+    sched._apply_pending()
+    assert sched.stat_pipeline_rollbacks == 1
+    assert len(sched._free) == free_before  # the slot never left the pool
+    assert all(s is None or s is seq for s in sched._slots)
+    sched.pool.alloc.check()
+    seq.future.cancel()
+    await sched.close()
+
+
+async def test_pipeline_reconcile_upgrades_to_post_capture_prefix_hit():
+    """A flight-decided admission can predate a capture the same round's
+    consume walk performs (a retiring tenant captures the very prompt the
+    decided sharer carries). The reconcile re-matches against the
+    post-capture index and upgrades the install to the warm mapping — the
+    hit the serial loop would have served — instead of silently paying
+    the full prefill the stale mid-flight index implied."""
+    from seldon_core_tpu.serving.decode_scheduler import _PendingAdmit, _Seq
+
+    params = _params()
+    sched = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2, prefix_slots=4
+    )
+    sched.warmup()
+    shared = _prompts(1, seed=51)[0]
+    # a completed tenant captures the prompt at retirement (the real path)
+    await sched.submit(shared)
+    assert sched._prefix_index.entries, "retirement capture did not land"
+    hits_before = sched.stat_prefix_hits
+    # a pending admit decided BEFORE that capture: reuse 0, no entry, the
+    # worst-case reservation already made (what _pipeline_admit records)
+    loop = asyncio.get_running_loop()
+    seq = _Seq(shared, 4, 0.0, 0, 0, None, loop.create_future())
+    seq.uid = 20_001
+    slot = sched._free[-1]
+    assert sched.pool.alloc.try_admit(slot, (), 0, 0)
+    sched._waiting.append(seq)
+    sched._pending_admits.append(_PendingAdmit(seq, slot, None, 0, 0))
+    sched._apply_pending()
+    assert sched._slots[slot] is seq
+    assert seq.prefix_len > 0, "reconcile kept the stale cold decision"
+    assert sched.stat_prefix_hits == hits_before + 1
+    sched.pool.alloc.check()
+    seq.future.cancel()
+    await sched.close()
+
+
+async def test_pipelined_zero_recompiles_and_kill_switch():
+    """Zero-recompile guard with the pipeline on (the enqueue/overlap/
+    readback split presents exactly the warmed signatures), and the kill
+    switch semantics: sync-timing forces the serial loop even when the
+    pipeline flag is on."""
+    params = _params()
+    ids = _prompts(5, seed=37)
+    sched = _scheduler(params, n_slots=3)
+    outs = await asyncio.gather(
+        *(
+            sched.submit(row, max_new_tokens=2 + i, temperature=0.5 * (i % 2), top_k=i)
+            for i, row in enumerate(ids)
+        )
+    )
+    assert all(len(o) > SEQ for o in outs)
+    assert sched.stat_pipelined_rounds > 0
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+    forced = _scheduler(params, n_slots=2)
+    forced._sync_timing = True  # ENGINE_FLIGHT_SYNC_TIMING=on equivalent
+    assert not forced._pipeline_on()
+    out = await forced.submit(ids[0])
+    np.testing.assert_array_equal(out, _oracle(params, ids[:1])[0])
+    assert forced.stat_pipelined_rounds == 0
+    await forced.close()
+
+
 @pytest.mark.slow
 async def test_staggered_arrival_soak():
     """Soak-adjacent: dozens of staggered arrivals with mixed budgets and

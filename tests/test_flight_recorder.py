@@ -357,6 +357,45 @@ def test_compare_fails_on_synthetic_regressions(tmp_path):
     assert bench.run_compare(str(base), partial) == 0
 
 
+def test_compare_gates_pipe_pack_and_prerename_baselines(tmp_path):
+    """The PR 13 compare surface: the packed gen.pipe A/B gates the
+    overlap share (the pipelined tokens/s + bubble gate through the
+    existing gen.tok_s / gen.loop keys), and a PRE-rename baseline
+    (spec_speedup / prefix_hit_rate spellings) still gates against a
+    post-rename record through the fallback reads — the renames must not
+    open a one-round gateless window."""
+    bench = _load_bench()
+    rec = _record()
+    rec["gen"]["pipe"] = [1550.0, 0.31, 0.23]
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(rec))
+    assert bench.run_compare(str(base), rec) == 0
+    # silently-serialized regression: the overlap collapses (the bubble
+    # rise shows through the existing gen.loop_bubble gate)
+    bad = _record()
+    bad["gen"]["pipe"] = [1550.0, 0.31, 0.0]
+    assert bench.run_compare(str(base), bad) == 1
+    bad = _record()
+    bad["gen"]["pipe"] = [1550.0, 0.31, 0.23]
+    bad["gen"]["loop"][0] = 0.9  # pipelined bubble gates via gen.loop
+    assert bench.run_compare(str(base), bad) == 1
+    # pre-rename baseline vs post-rename record: the old spellings map to
+    # the new gate keys, so a real regression still fails
+    old = _record()
+    old["gen"]["spec_speedup"] = 1.7
+    old["gen"]["prefix_hit_rate"] = 0.95
+    old_base = tmp_path / "old.json"
+    old_base.write_text(json.dumps(old))
+    new = _record()
+    new["gen"]["spec_spd"] = 1.7
+    new["gen"]["prefix_hit"] = 0.95
+    assert bench.run_compare(str(old_base), new) == 0
+    regressed = _record()
+    regressed["gen"]["spec_spd"] = 0.8
+    regressed["gen"]["prefix_hit"] = 0.95
+    assert bench.run_compare(str(old_base), regressed) == 1
+
+
 def test_compare_reads_driver_wrapper(tmp_path):
     """load_record unwraps the driver's BENCH_rNN.json shape and rejects a
     truncated (parsed: null) round instead of comparing garbage."""
@@ -516,6 +555,88 @@ def test_phase_timer_commit_freezes_round():
     assert len(frozen) == flight_mod.N_PHASES
     assert frozen[flight_mod.P_COMMIT] >= 0
     assert isinstance(frozen, tuple)
+
+
+def test_phase_timer_overlap_mode_keeps_phase_sums_clean():
+    """Overlap mode (the pipelined loop's window): phase segments timed
+    between begin_overlap/end_overlap accrue to the single overlap_ns
+    counter, NOT the per-phase array — overlapped host work sits inside
+    the round's device-busy window, so booking it into ns would break
+    sum(phase) <= gap."""
+    t = PhaseTimer(enabled=True)
+    with t.phase(flight_mod.P_SAMPLING):
+        time.sleep(0.001)
+    t.begin_overlap()
+    with t.phase(flight_mod.P_ADMIT):
+        time.sleep(0.002)
+        with t.phase(flight_mod.P_ALLOC):
+            time.sleep(0.001)
+    t.end_overlap()
+    with t.phase(flight_mod.P_COMMIT):
+        time.sleep(0.001)
+    # the overlapped spans landed in overlap_ns only
+    assert t.overlap_ns >= 2_000_000
+    assert t.ns[flight_mod.P_ADMIT] == 0
+    assert t.ns[flight_mod.P_ALLOC] == 0
+    # normal-mode spans on either side still attribute per phase
+    assert t.ns[flight_mod.P_SAMPLING] >= 500_000
+    assert t.ns[flight_mod.P_COMMIT] >= 500_000
+    t.reset()
+    assert t.overlap_ns == 0 and not t._overlap
+
+
+def test_overlap_accounting_in_frames_aggregate_and_health():
+    """The overlap columns (ISSUE 13): per-frame overlap_ns flows to
+    to_dict/aggregate/health, overlap_of_gap + bubble_residual split the
+    would-be serial gap, and a serial recorder reads 0.0/1.0-free (no
+    overlap keys invented)."""
+    rec = FlightRecorder(n_slots=4, name="ov", capacity=64, enabled=True)
+    rec.record(_frame(0, busy_ns=(0, 4000, 0, 0, 0), gap_ns=1000, overlap_ns=3000))
+    rec.record(_frame(1, busy_ns=(0, 4000, 0, 0, 0), gap_ns=2000, overlap_ns=0))
+    agg = rec.aggregate()
+    # gap 3000, overlap 3000: half the would-be serial gap was hidden
+    assert agg["overlap_of_gap"] == pytest.approx(0.5, abs=1e-4)
+    assert agg["bubble_residual"] == pytest.approx(0.5, abs=1e-4)
+    assert agg["overlap_ms"] == pytest.approx(0.003, abs=1e-6)
+    # bubble_fraction counts only the still-exposed gap: 3000/11000
+    assert agg["bubble_fraction"] == pytest.approx(3000 / 11000, abs=1e-4)
+    assert rec.health()["overlap_of_gap"] == pytest.approx(0.5, abs=1e-4)
+    d = rec.snapshot(2)[0].to_dict()
+    assert d["overlap_us"] == 3.0
+    assert "overlap_us" not in rec.snapshot(2)[1].to_dict()
+    # a recorder that never saw overlap (the serial loop): 0.0, residual 1.0
+    ser = FlightRecorder(n_slots=4, name="ser", capacity=16, enabled=True)
+    ser.record(_frame(0, gap_ns=1000))
+    assert ser.aggregate()["overlap_of_gap"] == 0.0
+    assert ser.aggregate()["bubble_residual"] == 1.0
+    assert ser.health()["overlap_of_gap"] == 0.0
+
+
+def test_pipelined_scheduler_frames_carry_overlap():
+    """Scheduler e2e with the pipeline on (the default): step frames carry
+    nonzero overlap_ns, sum(phase) <= gap survives, and the aggregate's
+    overlap_of_gap is positive — the soak/profile-smoke gate's signal."""
+    s = DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=4)
+    s.warmup()
+    assert s._pipeline_on()
+    _run_requests(s, n=6)
+    assert s.recompiles_since_warmup() == 0
+    frames = s.flight.snapshot()
+    assert any(f.overlap_ns > 0 for f in frames)
+    for f in frames:
+        assert sum(f.phase_ns) <= f.gap_ns + 50_000, (f.seq, f.phase_ns, f.gap_ns)
+    agg = s.flight.aggregate()
+    assert agg["overlap_of_gap"] > 0.0
+    assert s.stat_pipelined_rounds > 0
+
+
+def test_decode_pipeline_env_kill_switch(monkeypatch):
+    monkeypatch.setenv(flight_mod.ENGINE_DECODE_PIPELINE, "off")
+    assert not flight_mod.decode_pipeline_enabled()
+    s = DecodeScheduler(_params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2)
+    assert not s.pipeline_enabled and not s._pipeline_on()
+    monkeypatch.setenv(flight_mod.ENGINE_DECODE_PIPELINE, "on")
+    assert flight_mod.decode_pipeline_enabled()
 
 
 def test_overhead_budget_with_phases_and_profiler_on():

@@ -312,6 +312,106 @@ class S:
     assert rules_of(lint_sources({"m.py": src})) == {"CP002"}
 
 
+# the PIPELINED scheduler's state machine, as a pinned fixture: shadow
+# pending state built by `_pipeline_*` under the in-flight dispatch,
+# reconciled by `_apply_pending`, reset by `_round_reset` — the exact
+# writer set CP003 sanctions (decode_scheduler.py's shape)
+CP_PIPELINE_CLEAN = """
+class Sched:
+    def __init__(self):
+        self._pending_admits = []
+        self._pending_chunk_plan = None
+
+    def _round_reset(self):
+        self._pending_admits.clear()
+
+    def _pipeline_admit(self):
+        self._pending_admits.append(object())
+
+    def _pipeline_plan_chunk(self):
+        self._pending_chunk_plan = ("key", [1, 2])
+
+    def _pipeline_take_chunk_plan(self, key):
+        plan = self._pending_chunk_plan
+        self._pending_chunk_plan = None
+        return plan
+
+    def _apply_pending(self):
+        while self._pending_admits:
+            self._pending_admits.pop(0)
+
+    def _commit_round(self):
+        self.stat_steps += 1
+"""
+
+CP_PIPELINE_DRIFT = """
+class Sched:
+    def __init__(self):
+        self._pending_admits = []
+
+    def _pipeline_admit(self):
+        self._pending_admits.append(object())
+
+    def _apply_pending(self):
+        self._pending_admits.clear()
+
+    def _retire(self, slot):
+        # a second writer outside the builder/reconcile funnel: the
+        # speculate-vs-commit drift CP003 exists to catch — and it is a
+        # MUTATING CALL, invisible to plain store analysis
+        self._pending_admits.append(slot)
+
+    async def _run(self):
+        await self.dispatch()
+        self._pending_chunk_plan = None  # plain store, same hazard
+"""
+
+
+def test_commit_point_pipeline_state_machine_clean():
+    assert lint_sources({"m.py": CP_PIPELINE_CLEAN}) == []
+
+
+def test_commit_point_pending_state_second_writer():
+    findings = lint_sources({"m.py": CP_PIPELINE_DRIFT})
+    assert rules_of(findings) == {"CP003"}
+    assert {f.symbol for f in findings} == {"Sched._retire", "Sched._run"}
+    # both the mutating-call write and the plain store are caught
+    assert any("_pending_admits" in f.message for f in findings)
+    assert any("_pending_chunk_plan" in f.message for f in findings)
+
+
+def test_commit_point_pending_rule_needs_pipeline_shape():
+    # a class with a `_pending_x` attribute but no pipeline state machine
+    # (no _apply_pending / _pipeline_*) is not subject to CP003
+    src = """
+class Batcher:
+    def __init__(self):
+        self._pending_items = []
+
+    def add(self, item):
+        self._pending_items.append(item)
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_commit_point_catches_seeded_pipeline_drift():
+    # the acceptance-criteria scenario for the shadow state: a second
+    # _pending_admits writer seeded into the REAL scheduler source is
+    # caught, and the unseeded source is clean
+    with open(os.path.join(PKG, "serving", "decode_scheduler.py")) as f:
+        src = f.read()
+    marker = "        self.stat_retired += 1"
+    assert marker in src
+    seeded = src.replace(
+        marker, marker + "\n        self._pending_admits.clear()", 1
+    )
+    findings = lint_sources(
+        {"serving/decode_scheduler.py": seeded}, rules=["commit-point"]
+    )
+    assert rules_of(findings) == {"CP003"}
+    assert "_pending_admits" in findings[0].message
+
+
 def test_commit_point_catches_seeded_scheduler_drift():
     # the acceptance-criteria scenario: a second stat_occupancy_sum
     # mutation site seeded into the REAL scheduler source is caught
