@@ -892,13 +892,23 @@ def _gen_tree_leg(
       The accelerator regime sits between, nearer the floor twin (a
       widened decode dispatch is memory-bandwidth-bound on chip).
 
-    Greedy outputs are asserted bit-identical across plain/chain/tree —
-    the tokens/s columns price the SAME tokens."""
+    A FOURTH mode, ``ftree``, runs the SAME tree shape with the
+    EAGLE-style feature draft (models/decoder.init_feature_draft,
+    distilled in-leg with the feature recipe — KL + feature regression +
+    drift-noise augmentation): the head conditions on the target's last
+    hidden state instead of re-embedded tokens, which is pure accept-rate
+    headroom at the identical 2-dispatch round shape. The headline
+    feature-vs-token comparison is ``tokens_per_ride`` (accepted + bonus
+    per verify dispatch, per riding slot).
+
+    Greedy outputs are asserted bit-identical across
+    plain/chain/tree/ftree — the tokens/s columns price the SAME
+    tokens."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    from seldon_core_tpu.models.decoder import init_decoder
+    from seldon_core_tpu.models.decoder import init_decoder, init_feature_draft
     from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
     from seldon_core_tpu.training.distill_draft import (
         distill, load_draft_checkpoint,
@@ -907,12 +917,29 @@ def _gen_tree_leg(
     seq, max_new, vocab, hidden, ffn, layers = 32, 32, 256, 64, 256, 2
     max_len = seq + max_new
     spec_k, spec_tree = 4, "2,2,1,1"
+    # the feature head rides a FRONT-LOADED shape fit to its accept
+    # profile (depth 1 conditions on the TRUE target feature, deeper
+    # nodes on autoregressed ones — exactly the shape-vs-accept-profile
+    # matching the auto-tuner automates): 4+12+24+24 = 64 nodes, the
+    # verify-width cap, at the SAME 2-dispatch round cost
+    ftree_shape = "4,3,2,1"
     with tempfile.TemporaryDirectory() as td:
         ckpt = os.path.join(td, "draft_distilled.npz")
         distill_report = distill(
             seed=0, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
             max_len=max_len, resid_scale=1.0, draft_layers=1,
             seq=8, horizon=24, batch=16, steps=150, log_every=0, out=ckpt,
+        )
+        # the feature head trains longer (still ~30 s on this geometry)
+        # with a heavier regression weight: anchoring the feature
+        # autoregression is what holds deep-node accept up (measured:
+        # feat_weight 0.3 @300 steps rides 2.4, 0.5 @800 rides 3.3+)
+        fckpt = os.path.join(td, "draft_feat_distilled.npz")
+        fdistill_report = distill(
+            seed=0, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
+            max_len=max_len, resid_scale=1.0, features=True,
+            seq=8, horizon=24, batch=16, steps=800, lr=3e-3,
+            feat_weight=0.5, log_every=0, out=fckpt,
         )
         target = init_decoder(
             0, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
@@ -923,6 +950,12 @@ def _gen_tree_leg(
             init_decoder(
                 0, vocab=vocab, hidden=hidden, layers=1, ffn=ffn,
                 max_len=max_len, resid_scale=1.0,
+            ),
+        )
+        fdraft = load_draft_checkpoint(
+            fckpt,
+            init_feature_draft(
+                0, vocab=vocab, hidden=hidden, ffn=ffn, max_len=max_len
             ),
         )
 
@@ -982,6 +1015,7 @@ def _gen_tree_leg(
             ("plain", {}),
             ("chain", {"draft_params": draft, "spec_k": spec_k}),
             ("tree", {"draft_params": draft, "spec_tree": spec_tree}),
+            ("ftree", {"draft_params": fdraft, "spec_tree": ftree_shape}),
         ):
             raw, outs = await run(False, **kw)
             rtt, outs2 = await run(True, **kw)
@@ -1006,10 +1040,15 @@ def _gen_tree_leg(
             "model": f"hidden {hidden} x {layers}L, vocab {vocab}",
             "draft": "1L, KL-distilled in-leg (150 steps, resid_scale=1.0)",
             "spec_k": spec_k, "spec_tree": spec_tree,
+            "ftree_shape": ftree_shape,
             "rtt_floor_ms": rtt_floor_ms,
         },
         "distill": {
             k: distill_report[k]
+            for k in ("accept_proxy_before", "accept_proxy_after", "final_kl")
+        },
+        "fdistill": {
+            k: fdistill_report[k]
             for k in ("accept_proxy_before", "accept_proxy_after", "final_kl")
         },
         **legs,
@@ -1021,6 +1060,18 @@ def _gen_tree_leg(
         "rtt_speedup_vs_chain": round(
             legs["tree"]["tokens_per_sec_rtt"]
             / max(legs["chain"]["tokens_per_sec_rtt"], 1e-9),
+            2,
+        ),
+        # the feature-draft headline: accepted+bonus per verify dispatch
+        # vs the TOKEN tree draft at the identical round shape
+        "ftree_ride_vs_tree": round(
+            legs["ftree"]["tokens_per_ride"]
+            / max(legs["tree"]["tokens_per_ride"], 1e-9),
+            2,
+        ),
+        "ftree_rtt_speedup_vs_tree": round(
+            legs["ftree"]["tokens_per_sec_rtt"]
+            / max(legs["tree"]["tokens_per_sec_rtt"], 1e-9),
             2,
         ),
     }
@@ -2142,7 +2193,8 @@ def compact_record(full: dict) -> dict:
             "scan_p50": gn.get("ttft_p50_ms"),
             "occ": gs.get("slot_occupancy_mean"),
             "recompiles": gs.get("recompiles_after_warmup"),
-            "slots": (gen.get("scenario") or {}).get("n_slots"),
+            # (the scenario's n_slots left the compact record with PR 14's
+            # byte-budget trim — config, not a metric; detail record keeps it)
         }
         lp = gs.get("loop") or {}
         if lp:
@@ -2160,14 +2212,15 @@ def compact_record(full: dict) -> dict:
             ]
             ph = lp.get("phases") or {}
             if ph:
-                # top-2 gap-phase fractions (full table in the detail
-                # record; was top-3 until the gen.pipe pack needed the
-                # bytes) — recorded for the host-bubble attribution
-                # story, NOT gated by --compare (same precedent as
-                # record_us: wall-noise attribution, not a contract)
+                # TOP gap-phase fraction (full table in the detail
+                # record; was top-3, then top-2 for gen.pipe, now top-1
+                # for the gen.ftree_* pack) — recorded for the
+                # host-bubble attribution story, NOT gated by --compare
+                # (same precedent as record_us: wall-noise attribution,
+                # not a contract)
                 c["gen"]["loop_ph"] = {
                     k: _r(v, 3)
-                    for k, v in sorted(ph.items(), key=lambda kv: -kv[1])[:2]
+                    for k, v in sorted(ph.items(), key=lambda kv: -kv[1])[:1]
                 }
         pl = gen.get("pipeline") or {}
         if pl:
@@ -2197,7 +2250,7 @@ def compact_record(full: dict) -> dict:
             c["gen"]["accept_rate"] = gp.get("accept_rate")
             c["gen"]["tok_disp"] = gp.get("tokens_per_dispatch")
             c["gen"]["spec_spd"] = gen.get("spec_tokens_per_sec_speedup")
-            c["gen"]["spec_k"] = (gen.get("scenario") or {}).get("spec_k")
+            # (spec_k left with PR 14's byte-budget trim — config field)
         gt_tree = gen.get("tree") or {}
         if gt_tree:
             # tree-speculation sub-leg: same 2-dispatch round at proposal
@@ -2217,6 +2270,14 @@ def compact_record(full: dict) -> dict:
                 ttree.get("tokens_per_ride"), tchain.get("tokens_per_ride"),
             ]
             c["gen"]["tree_spd"] = gt_tree.get("rtt_speedup_vs_chain")
+            tft = gt_tree.get("ftree") or {}
+            if tft:
+                # feature-draft twin at the identical tree shape: RTT-floor
+                # tokens/s, per-slot accepted+bonus per dispatch, and the
+                # (non-probe) accept rate — the accept-rate headroom story
+                c["gen"]["ftree_tok_s"] = tft.get("tokens_per_sec_rtt")
+                c["gen"]["ftree_ride"] = tft.get("tokens_per_ride")
+                c["gen"]["ftree_acc"] = tft.get("accept_rate")
         gx = gen.get("prefix") or {}
         if gx:
             # prefix-cache sub-leg: cold-vs-warm TTFT, hit rate, prefill
@@ -2234,7 +2295,8 @@ def compact_record(full: dict) -> dict:
             c["gen"]["prefix_warm"] = gm.get("ttft_warm_p50_ms")
             c["gen"]["prefix_spd"] = gx.get("warm_ttft_speedup")
             c["gen"]["prefix_hit"] = gm.get("hit_rate")
-            c["gen"]["prefix_saved"] = gm.get("prefill_tokens_saved")
+            # (prefix_saved — prefill tokens displaced — left with PR 14's
+            # byte-budget trim; the gated hit_rate carries the contract)
             c["gen"]["prefix_tok_s"] = gm.get("tokens_per_sec")
             c["gen"]["prefix_tok_s_ck"] = gc.get("tokens_per_sec")
             c["gen"]["prefix_itl"] = gm.get("inter_token_p99_ms")
@@ -2243,7 +2305,8 @@ def compact_record(full: dict) -> dict:
         if gpp:
             gf = gpp.get("fp") or {}
             g8 = gpp.get("int8") or {}
-            c["gen"]["paged_budget"] = gf.get("page_budget")
+            # (paged_budget — the CONFIGURED page budget — left with
+            # PR 14's byte-budget trim; detail record keeps it)
             c["gen"]["paged_peak"] = gf.get("peak_slots")
             c["gen"]["paged_flat"] = gf.get("flat_equiv_slots")
             c["gen"]["paged_vs_flat"] = gf.get("slots_vs_flat")
@@ -2361,6 +2424,7 @@ def _compare_pairs(rec: dict) -> dict:
         ("occ", "+"), ("prefix_tok_s", "+"), ("prefix_spd", "+"),
         ("prefix_hit", "+"), ("paged_tok_s", "+"),
         ("paged_vs_flat", "+"), ("tree_spd", "+"),
+        ("ftree_tok_s", "+"), ("ftree_ride", "+"),
         ("tp_speedup", "+"), ("recompiles", "0"),
     ):
         put(f"gen.{k}", gen.get(k), d)

@@ -331,7 +331,7 @@ def write_prefill(
     return cache_k, cache_v
 
 
-def _layer_step_slots(p, x, cache_k, cache_v, positions, h, counts=None):
+def _layer_step_slots(p, x, cache_k, cache_v, positions, h, counts=None, starts=None):
     """_layer_step generalized to PER-SLOT positions and m queries per
     slot. x: [n, m, d]; cache [n, h, max_ctx, hd]; positions: [n] — slot
     i's query j sits at positions[i] + j, writes its K/V there, and
@@ -344,7 +344,16 @@ def _layer_step_slots(p, x, cache_k, cache_v, positions, h, counts=None):
     rest of its cache byte-identical (a select against the current block,
     so a counts-0 slot riding the static-shape dispatch mutates nothing).
     None keeps the unconditional m-wide write (decode/verify paths, where
-    junk beyond a slot's limit lands ahead of its cursor by design)."""
+    junk beyond a slot's limit lands ahead of its cursor by design).
+
+    ``starts`` (optional, [n]): per-slot attention LOWER bound — cache
+    entries before starts[i] are masked out. The feature draft uses this
+    on warm (prefix-reuse) admissions: positions the target mapped from
+    the prefix pool have no draft-side K/V (the draft cache is populated
+    by the chunk rounds, which only compute the uncovered suffix), so the
+    draft's window opens at the suffix instead of attending to zeroed
+    rows. None keeps the full [0, pos] window (target paths — the pool
+    is always complete there)."""
     normed = _ln(p["ln1"], x)
     qkv = normed @ p["qkv"]["w"].astype(x.dtype) + p["qkv"]["b"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -376,6 +385,10 @@ def _layer_step_slots(p, x, cache_k, cache_v, positions, h, counts=None):
     m = x.shape[1]
     q_pos = positions[:, None] + jnp.arange(m)[None, :]  # [n, m]
     valid = jnp.arange(cache_k.shape[2])[None, None, :] <= q_pos[:, :, None]
+    if starts is not None:
+        valid = valid & (
+            jnp.arange(cache_k.shape[2])[None, None, :] >= starts[:, None, None]
+        )
     s = jnp.where(valid[:, None, :, :], s, -1e30)
     p_attn = jax.nn.softmax(s, axis=-1)
     ctx = jnp.einsum("nhqk,nhkd->nhqd", p_attn, cache_v.astype(jnp.float32))
@@ -709,7 +722,11 @@ def _layer_step_paged(p, x, kv, bt, positions, h, counts=None):
 def _paged_forward(params, pool, bt, tokens, positions, counts=None):
     """Shared body of the paged decode/verify/chunk programs: tokens[n, m]
     with slot i's query j at positions[i] + j; returns (logits[n, m, vocab],
-    new pool state). Junk queries clip the position table like the flat
+    hidden[n, m, d], new pool state) — ``hidden`` is the final layer's
+    residual-stream output (pre-``ln_f``), the per-position FEATURE an
+    EAGLE-style draft head conditions on (data-only: same static shapes,
+    and XLA dead-code-eliminates the extra output inside fused programs
+    that drop it). Junk queries clip the position table like the flat
     verify/chunk paths — their logits are never read and their writes are
     junk-redirected."""
     heads = _heads(params)
@@ -725,26 +742,30 @@ def _paged_forward(params, pool, bt, tokens, positions, counts=None):
         for acc, a in zip(per_comp, layer_kv):
             acc.append(a)
     logits = _logits(params, x)  # [n, m, vocab]
-    return logits, tuple(jnp.stack(acc) for acc in per_comp)
+    return logits, x, tuple(jnp.stack(acc) for acc in per_comp)
 
 
 def paged_decode_step(params, pool, bt, tokens, positions):
     """decode_step over the page pool: consume tokens[n] at positions[n],
-    return (logits[n, vocab], pool) — K/V written through block tables."""
-    logits, pool = _paged_forward(params, pool, bt, tokens[:, None], positions)
-    return logits[:, 0, :], pool
+    return (logits[n, vocab], hidden[n, d], pool) — K/V written through
+    block tables; ``hidden`` is the consumed position's final-layer
+    feature (what a feature-level draft conditions the next round on)."""
+    logits, hidden, pool = _paged_forward(params, pool, bt, tokens[:, None], positions)
+    return logits[:, 0, :], hidden[:, 0, :], pool
 
 
 def paged_verify_step(params, pool, bt, tokens, positions):
     """verify_step over the page pool: m queries per slot, logits[i, j]
-    scored AFTER consuming query j — the widened speculative verify."""
+    scored AFTER consuming query j — the widened speculative verify.
+    Returns (logits, hidden[n, m, d], pool)."""
     return _paged_forward(params, pool, bt, tokens, positions)
 
 
 def paged_chunk_prefill(params, pool, bt, tokens, positions, counts):
     """chunk_prefill over the page pool: persist only the first counts[i]
     K/V entries per slot (counts-0 slots ride the static-shape dispatch
-    with their writes junk-redirected, touching no live page)."""
+    with their writes junk-redirected, touching no live page). Returns
+    (logits, hidden[n, c, d], pool)."""
     return _paged_forward(params, pool, bt, tokens, positions, counts)
 
 
@@ -873,20 +894,30 @@ def speculative_accept(
 # junk-redirected. The pool never holds speculative garbage.
 
 
-def sequence_logits(params: dict, ids: jax.Array) -> jax.Array:
-    """Teacher-forced logits at every position: ids[b, s] -> [b, s, vocab]
-    (position j's row is the next-token distribution after consuming
-    tokens 0..j). One causal pass — the signal both sides of the draft
-    KL-distillation recipe (training/distill_draft.py) train on."""
+def sequence_hidden(params: dict, ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Teacher-forced (logits, hidden) at every position: ids[b, s] ->
+    ([b, s, vocab], [b, s, d]). ``hidden`` is the final layer's
+    residual-stream output (pre-``ln_f``) — the same FEATURE definition
+    the paged serving programs thread out, so the feature-conditioned
+    distillation recipe (training/distill_draft.py) trains on exactly
+    what the serving draft head will be fed."""
     ids = ids.astype(jnp.int32)
     heads = _heads(params)
     x = _embed(params, ids)
     for lp in params["layers"]:
         x, _, _ = _layer_prefill(lp, x, heads)
-    return _logits(params, x)
+    return _logits(params, x), x
 
 
-def _layer_tree_flat(p, x, cache_k, cache_v, positions, h, ek, ev, sub_mask):
+def sequence_logits(params: dict, ids: jax.Array) -> jax.Array:
+    """Teacher-forced logits at every position: ids[b, s] -> [b, s, vocab]
+    (position j's row is the next-token distribution after consuming
+    tokens 0..j). One causal pass — the signal both sides of the draft
+    KL-distillation recipe (training/distill_draft.py) train on."""
+    return sequence_hidden(params, ids)[0]
+
+
+def _layer_tree_flat(p, x, cache_k, cache_v, positions, h, ek, ev, sub_mask, starts=None):
     """One layer of a draft tree-expansion step over the FLAT draft cache:
     x [n, c, d] carries one depth's nodes; attention reads the cache at
     entries <= positions[i] (prompt + committed tokens + the root's fresh
@@ -908,6 +939,13 @@ def _layer_tree_flat(p, x, cache_k, cache_v, positions, h, ek, ev, sub_mask):
     qf = q.astype(jnp.float32)
     s_cache = jnp.einsum("nhqd,nhkd->nhqk", qf, cache_k.astype(jnp.float32)) * scale
     valid = jnp.arange(cache_k.shape[2])[None, None, None, :] <= positions[:, None, None, None]
+    if starts is not None:
+        # per-slot attention lower bound (see _layer_step_slots): the
+        # feature draft's warm-admit window opens at the computed suffix
+        valid = valid & (
+            jnp.arange(cache_k.shape[2])[None, None, None, :]
+            >= starts[:, None, None, None]
+        )
     s_cache = jnp.where(valid, s_cache, -1e30)
     s_ext = jnp.einsum("nhqd,nhkd->nhqk", qf, ek.astype(jnp.float32)) * scale
     s_ext = jnp.where(sub_mask[None, None, :, :], s_ext, -1e30)
@@ -925,6 +963,36 @@ def _layer_tree_flat(p, x, cache_k, cache_v, positions, h, ek, ev, sub_mask):
     )
     x = x + hdn @ p["mlp_out"]["w"].astype(x.dtype) + p["mlp_out"]["b"].astype(x.dtype)
     return x, ek, ev
+
+
+def _tree_candidates(parent_logits, temperature, top_k, key, d: int, b: int):
+    """One depth's candidate tokens [n, c_prev * b] in parent-major block
+    order, from the parents' logits [n, c_prev, V] — THE candidate rule
+    both tree drafts share (token-level ``draft_propose_tree`` and the
+    feature head ``draft_propose_features``; extracting it is what keeps
+    their RNG streams and block layouts identical by construction).
+    Greedy rows take the top-b DISTINCT tokens (branch 0 is the chain's
+    argmax proposal); sampled rows draw b i.i.d. tokens from the
+    transformed distribution ``sample_tokens`` serves — i.i.d. candidates
+    are what make the per-depth recursive rejection resampling in
+    ``speculative_accept_tree`` exact."""
+    n, c_prev, _ = parent_logits.shape
+    _, top_idx = lax.top_k(parent_logits, b)  # [n, c_prev, b]
+    flat_parent = parent_logits.reshape(n * c_prev, -1)
+    scaled = _transform_logits(
+        flat_parent, jnp.repeat(temperature, c_prev), jnp.repeat(top_k, c_prev)
+    )
+    samp = [
+        jax.random.categorical(
+            jax.random.fold_in(jax.random.fold_in(key, d), bi), scaled, axis=-1
+        ).astype(jnp.int32)
+        for bi in range(b)
+    ]
+    sampled = jnp.stack(samp, axis=-1).reshape(n, c_prev, b)
+    cand = jnp.where(
+        (temperature > 0)[:, None, None], sampled, top_idx.astype(jnp.int32)
+    )
+    return cand.reshape(n, c_prev * b)  # parent-major: the block layout
 
 
 def draft_propose_tree(
@@ -968,24 +1036,8 @@ def draft_propose_tree(
     mask_np = tree.ancestor_mask
     for d in range(1, tree.depth + 1):
         b = tree.branching[d - 1]
-        c_prev = parent_logits.shape[1]
         c_d = tree.level_counts[d - 1]
-        _, top_idx = lax.top_k(parent_logits, b)  # [n, c_prev, b]
-        flat_parent = parent_logits.reshape(n * c_prev, -1)
-        scaled = _transform_logits(
-            flat_parent, jnp.repeat(temperature, c_prev), jnp.repeat(top_k, c_prev)
-        )
-        samp = [
-            jax.random.categorical(
-                jax.random.fold_in(jax.random.fold_in(key, d), bi), scaled, axis=-1
-            ).astype(jnp.int32)
-            for bi in range(b)
-        ]
-        sampled = jnp.stack(samp, axis=-1).reshape(n, c_prev, b)
-        cand = jnp.where(
-            (temperature > 0)[:, None, None], sampled, top_idx.astype(jnp.int32)
-        )
-        toks_d = cand.reshape(n, c_d)  # parent-major: matches the block layout
+        toks_d = _tree_candidates(parent_logits, temperature, top_k, key, d, b)
         node_tokens.append(toks_d)
         x = jnp.asarray(params["tok_emb"])[toks_d]
         pidx = jnp.clip(positions + d, 0, max_len - 1)
@@ -1076,8 +1128,11 @@ def paged_tree_verify(
     next-token distribution AFTER consuming block j's token along its
     path — exactly what sequential decoding down that path would produce,
     which is what keeps greedy path acceptance bit-exact. Returns
-    (logits [n, width, V], new_k [L, n, h, width, hd], new_v); the pool
-    is untouched — ``paged_tree_commit`` writes the accepted path."""
+    (logits [n, width, V], hidden [n, width, d], new_k
+    [L, n, h, width, hd], new_v); ``hidden`` is each block's final-layer
+    feature — the accepted path's last entry seeds the NEXT round's
+    feature-draft root. The pool is untouched — ``paged_tree_commit``
+    writes the accepted path."""
     heads = _heads(params)
     max_len = params["pos_emb"].shape[0]
     x = jnp.asarray(params["tok_emb"])[tokens]  # [n, width, d]
@@ -1093,7 +1148,7 @@ def paged_tree_verify(
         nk.append(k)
         nv.append(v)
     logits = _logits(params, x)  # [n, width, V]
-    return logits, jnp.stack(nk), jnp.stack(nv)
+    return logits, x, jnp.stack(nk), jnp.stack(nv)
 
 
 def paged_tree_commit(
@@ -1262,6 +1317,218 @@ def speculative_accept_tree(
     out = jnp.concatenate([out, jnp.zeros((n, 1), jnp.int32)], axis=1)
     out = out.at[rows, n_acc].set(bonus)
     return out, n_acc.astype(jnp.int32), path_idx
+
+
+# ------------------------------------------------------ feature-level draft
+# EAGLE-style feature drafting (Li et al., EAGLE): instead of a truncated-
+# layer decoder re-embedding TOKENS, the draft head conditions on the
+# TARGET's last hidden state — the final layer's residual-stream output,
+# which the paged programs above already compute per committed position
+# and thread out as ``hidden``. The head is ONE transformer layer plus a
+# weight-tied LM head; its input at position j is
+# ``fc([target_feature_{j-1} ; tok_emb(token_j)])`` (position 0 pads the
+# feature with zeros), and during tree expansion the head autoregresses in
+# FEATURE space: a depth-d node's input feature is its parent node's own
+# output hidden (the draft's approximation of the target feature the
+# target would have produced there). The target feature summarizes the
+# whole prefix through the target's own stack, so acceptance beats any
+# token-only draft of the same depth — the accept-rate headroom PR 8
+# noted.
+#
+# Cache discipline is the tree draft's, unchanged: the head keeps a flat
+# per-slot K/V cache ([1, n_slots, h, ctx, hd] — ``init_slot_cache`` on
+# the head's one layer), the root step's write is never speculative,
+# expansion K/V stays in-register, and only the accepted path commits
+# (``draft_tree_commit`` with L=1). On warm (prefix-reuse) admissions the
+# reused span has no draft K/V; ``starts`` opens the head's attention
+# window at the computed suffix instead of reading zeroed rows.
+
+
+def is_feature_draft(params) -> bool:
+    """Whether a draft param tree is the feature-head layout (the ``fc``
+    feature+embedding fuse marks it — a truncated-layer decoder has none)."""
+    return isinstance(params, dict) and "fc" in params
+
+
+def init_feature_draft(
+    seed: int = 0, vocab: int = 512, hidden: int = 128, ffn: int = 256,
+    max_len: int = 128,
+) -> dict:
+    """Feature-draft head params: the ``fc`` [2*hidden -> hidden] fuse, one
+    decoder layer (same block structure as the target's, so every slot/tree
+    building block above applies verbatim with L=1), own position table and
+    a weight-tied LM head. ``hidden`` MUST equal the target's — the fuse
+    consumes the target's feature vector directly.
+
+    The rng draws follow ``init_decoder``'s positional order (tok_emb,
+    pos_emb, the layer's qkv/attn_out/mlp_in/mlp_out; ``fc`` drawn LAST):
+    built with the target's seed/vocab/hidden/ffn the head starts with
+    the target's embeddings, weight-tied LM head, AND leading layer
+    verbatim — the same stream-sharing trick the truncation draft rides,
+    so distillation only has to learn the feature path, not re-derive the
+    output geometry from scratch."""
+    heads = _heads_for(hidden)
+    if hidden % heads:
+        raise ValueError(
+            f"hidden={hidden} not divisible by its derived head count {heads}"
+        )
+    rng = np.random.default_rng(seed)
+    return {
+        "tok_emb": (rng.standard_normal((vocab, hidden)) * 0.02).astype(np.float32),
+        "pos_emb": (rng.standard_normal((max_len, hidden)) * 0.02).astype(np.float32),
+        "layers": [
+            {
+                "ln1": _ln_init(hidden),
+                "qkv": _dense(rng, hidden, 3 * hidden),
+                "attn_out": _dense(rng, hidden, hidden),
+                "ln2": _ln_init(hidden),
+                "mlp_in": _dense(rng, hidden, ffn),
+                "mlp_out": _dense(rng, ffn, hidden),
+            }
+        ],
+        "ln_f": _ln_init(hidden),
+        "fc": _dense(rng, 2 * hidden, hidden),
+    }
+
+
+def _feature_fuse(params: dict, feats, tokens, pidx) -> jax.Array:
+    """The head's input embedding: ``fc([feature ; tok_emb(token)])`` plus
+    the position embedding. feats [n, m, d] aligned with tokens [n, m];
+    pidx broadcastable position indices (already clipped)."""
+    emb = jnp.asarray(params["tok_emb"])[tokens]  # [n, m, d]
+    z = jnp.concatenate([feats.astype(emb.dtype), emb], axis=-1)
+    x = z @ params["fc"]["w"].astype(emb.dtype) + params["fc"]["b"].astype(emb.dtype)
+    return x + jnp.asarray(params["pos_emb"])[pidx]
+
+
+def feature_sequence_logits(
+    params: dict, ids: jax.Array, feats: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Teacher-forced head forward for distillation: ids[b, s] with the
+    TARGET's aligned features feats[b, s, d] (``sequence_hidden``'s second
+    output) -> (logits[b, s, vocab], head_hidden[b, s, d]). Input at
+    position j fuses feature j-1 with token j (feature -1 = zeros), so
+    logits[j] predicts token j+1 and head_hidden[j] is the head's
+    approximation of feature j — the KL and feature-regression targets of
+    the distillation recipe, and exactly the serving root step's
+    conditioning (the root consumes the TRUE previous feature)."""
+    ids = ids.astype(jnp.int32)
+    heads = _heads(params)
+    s = ids.shape[1]
+    fin = jnp.concatenate(
+        [jnp.zeros_like(feats[:, :1]), feats[:, :-1]], axis=1
+    )
+    x = _feature_fuse(params, fin, ids, jnp.arange(s)[None, :])
+    for lp in params["layers"]:
+        x, _, _ = _layer_prefill(lp, x, heads)
+    return _logits(params, x), x
+
+
+def feature_chunk_prefill(
+    params: dict, cache_k, cache_v, tokens, target_hidden, prev_feat,
+    positions, counts, starts,
+) -> tuple[jax.Array, jax.Array]:
+    """Teacher-forced head-side chunk prefill, fused into the target's
+    chunk round: tokens[n, c] (the chunk's prompt ids), the target's fresh
+    per-position hidden for the SAME chunk, and ``prev_feat[n, d]`` — the
+    carried feature at position positions[i]-1 (the previous chunk's last
+    hidden; zeroed when positions == starts, i.e. the slot's first chunk,
+    matching the recipe's zero pad at position 0). Writes the head's K/V
+    under the same ``counts`` mask the target chunk uses (counts-0 slots
+    mutate nothing) with the ``starts`` attention window."""
+    m = tokens.shape[1]
+    heads = _heads(params)
+    max_len = params["pos_emb"].shape[0]
+    fin = jnp.concatenate([prev_feat[:, None, :], target_hidden[:, :-1, :]], axis=1)
+    first = positions == starts
+    fin = fin.at[:, 0, :].set(
+        jnp.where(first[:, None], jnp.zeros_like(prev_feat), fin[:, 0, :])
+    )
+    pidx = jnp.clip(positions[:, None] + jnp.arange(m)[None, :], 0, max_len - 1)
+    x = _feature_fuse(params, fin, tokens, pidx)
+    new_k, new_v = [], []
+    for li, lp in enumerate(params["layers"]):
+        x, ck, cv = _layer_step_slots(
+            lp, x, cache_k[li], cache_v[li], positions, heads,
+            counts=counts, starts=starts,
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+    return jnp.stack(new_k), jnp.stack(new_v)
+
+
+def draft_propose_features(
+    params: dict,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    feats: jax.Array,
+    tokens: jax.Array,
+    positions: jax.Array,
+    starts: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    key: jax.Array,
+    tree,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``draft_propose_tree`` with the feature head: the root step fuses
+    the slot's carried TARGET feature (``feats[n, d]`` — position
+    ``pos - 1``'s final-layer hidden, threaded out of the previous verify
+    / plain step / chunk round) with the last emitted token; each
+    expansion depth fuses the PARENT NODE's own head hidden with the
+    candidate token — autoregression in feature space, per EAGLE. Same
+    candidate rule, RNG stream, block layout, in-register node K/V, and
+    return shape as the token tree draft, so the scheduler's verify /
+    accept / commit round half is shared unchanged."""
+    heads = _heads(params)
+    max_len = params["pos_emb"].shape[0]
+    n = tokens.shape[0]
+    # root step: consume the last emitted token at ``pos`` (its write is
+    # never speculative) conditioned on the carried target feature
+    pidx0 = jnp.clip(positions, 0, max_len - 1)[:, None]
+    x = _feature_fuse(params, feats[:, None, :], tokens[:, None], pidx0)
+    new_k, new_v = [], []
+    for li, lp in enumerate(params["layers"]):
+        x, ck, cv = _layer_step_slots(
+            lp, x, cache_k[li], cache_v[li], positions, heads, starts=starts
+        )
+        new_k.append(ck)
+        new_v.append(cv)
+    cache_k, cache_v = jnp.stack(new_k), jnp.stack(new_v)
+    logits0 = _logits(params, x)[:, 0, :]
+    block_logits = [logits0[:, None, :]]
+    node_tokens = []
+    ek: list = [None] * len(params["layers"])
+    ev: list = [None] * len(params["layers"])
+    parent_logits = logits0[:, None, :]  # [n, 1, V]
+    parent_feats = x  # [n, 1, d] — the head's own hidden, root block
+    mask_np = tree.ancestor_mask
+    for d in range(1, tree.depth + 1):
+        b = tree.branching[d - 1]
+        c_d = tree.level_counts[d - 1]
+        toks_d = _tree_candidates(parent_logits, temperature, top_k, key, d, b)
+        pf = jnp.repeat(parent_feats, b, axis=1)  # [n, c_d, d] parent-major
+        pidx = jnp.clip(positions + d, 0, max_len - 1)[:, None]
+        x = _feature_fuse(params, pf, toks_d, pidx)
+        node_tokens.append(toks_d)
+        start = tree.level_starts[d - 1]
+        sub_mask = jnp.asarray(mask_np[start : start + c_d, 1 : start + c_d])
+        for li, lp in enumerate(params["layers"]):
+            x, ek[li], ev[li] = _layer_tree_flat(
+                lp, x, cache_k[li], cache_v[li], positions, heads,
+                ek[li], ev[li], sub_mask, starts=starts,
+            )
+        depth_logits = _logits(params, x)  # [n, c_d, V]
+        block_logits.append(depth_logits)
+        parent_logits = depth_logits
+        parent_feats = x
+    return (
+        jnp.concatenate(node_tokens, axis=1),
+        jnp.concatenate(block_logits, axis=1),
+        jnp.stack(ek),
+        jnp.stack(ev),
+        cache_k,
+        cache_v,
+    )
 
 
 def reference_generate(params: dict, ids: np.ndarray, max_new_tokens: int) -> np.ndarray:

@@ -179,6 +179,24 @@ class SpecTree:
             nxt[p] += 1
         return table
 
+    def nodes_for_widths(self, widths) -> int:
+        """Flattened node count of the SUB-TREE a per-depth width mask
+        induces (``sum_d prod_{e<=d} min(widths[e], branching[e])``; a 0
+        width truncates the depths below it) — the effective tree the
+        acceptance walk can actually traverse. The verify dispatch still
+        scores the full static layout (widths are data, not shape); this
+        is the observability number: how much of the scored width the
+        auto-tuner's current mask keeps reachable
+        (``/decode/health`` ``spec.nodes``)."""
+        total, level = 0, 1
+        for d, b in enumerate(self.branching):
+            w = min(int(widths[d]), b) if d < len(widths) else 0
+            if w <= 0:
+                break
+            level *= w
+            total += level
+        return total
+
     def tighten(self, widths) -> tuple[int, ...]:
         """Element-wise clamp of a per-request branching request against
         this (deployment) tree: per depth ``min(req, deployment)``, depths

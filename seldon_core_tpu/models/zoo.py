@@ -434,6 +434,7 @@ def build_draft(
     seq: int = 32,
     max_new_tokens: int = 16,
     distilled: str = "",
+    features: int = 0,
     **_,
 ) -> ModelSpec:
     """Draft decoder for speculative decoding (tpu.decode_draft_model):
@@ -458,7 +459,35 @@ def build_draft(
     conditionals instead of seed-shared layer truncation alone. The
     checkpoint must match this build's geometry exactly (the loader
     asserts every leaf's shape), so the URI still carries the full
-    architecture and ``distilled`` only swaps the values."""
+    architecture and ``distilled`` only swaps the values.
+
+    ``features=1`` builds the EAGLE-style feature-draft HEAD instead
+    (models/decoder.init_feature_draft): one transformer layer whose
+    input fuses the TARGET's last hidden state with the token embedding.
+    ``hidden`` must equal the target's (the decode scheduler injects it
+    from the target automatically); ``layers``/``resid_scale`` do not
+    apply. A feature head is not a standalone decoder — it serves ONLY
+    through ``tpu.decode_draft_model``, and its apply raises to say so.
+    Distill it with ``python -m seldon_core_tpu.training.distill_draft
+    --features`` and load via
+    ``zoo://draft?features=1&distilled=/path.npz``."""
+    if features:
+        from seldon_core_tpu.models.decoder import init_feature_draft
+
+        params = init_feature_draft(
+            seed, vocab=vocab, hidden=hidden, ffn=ffn, max_len=max_len
+        )
+        if distilled:
+            from seldon_core_tpu.training.distill_draft import load_draft_checkpoint
+
+            params = load_draft_checkpoint(str(distilled), params)
+        return ModelSpec(
+            _feature_draft_apply,
+            params,
+            (seq,),
+            (),
+            int_inputs="ids",
+        )
     ms = build_tiny_gpt(
         seed=seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
         max_len=max_len, seq=seq, max_new_tokens=max_new_tokens,
@@ -469,6 +498,14 @@ def build_draft(
 
         ms.params = load_draft_checkpoint(str(distilled), ms.params)
     return ms
+
+
+def _feature_draft_apply(p, x):
+    raise ValueError(
+        "a feature-draft head (zoo://draft?features=1) conditions on the "
+        "target's hidden states and cannot serve standalone — point "
+        "tpu.decode_draft_model at it instead"
+    )
 
 
 def _apply_tiny_gpt(p, x, *, max_new_tokens: int):

@@ -154,12 +154,19 @@ def decoder_param_pspecs(params: dict, axis: str):
             "mlp_out": {"w": P(axis, None), "b": P()},
         }
 
-    return {
+    out = {
         "tok_emb": P(),
         "pos_emb": P(),
         "layers": [_layer(lp) for lp in params["layers"]],
         "ln_f": _ln(params["ln_f"]),
     }
+    if "fc" in params:
+        # feature-draft head (models/decoder.init_feature_draft): the
+        # [2*hidden -> hidden] feature+embedding fuse replicates — its
+        # input is the replicated feat buffer + embedding, and its output
+        # feeds the head's qkv which is replicated too
+        out["fc"] = {"w": P(), "b": P()}
+    return out
 
 
 def decoder_param_shardings(params: dict, mesh: Mesh, axis: str):

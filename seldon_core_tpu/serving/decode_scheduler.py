@@ -58,6 +58,23 @@ scheduling over a vLLM-style PAGED KV pool into the stack:
   writes need no copy-rollback — positions only advance over accepted
   tokens, so stale entries sit beyond every later attention mask until
   the next consumed token overwrites them.
+- Feature-level drafting (``zoo://draft?features=1`` — EAGLE-style): the
+  draft is a one-layer HEAD conditioned on the TARGET's final-layer
+  hidden state, which the fused step/verify/chunk programs thread out
+  per committed position. The scheduler carries a per-slot feature
+  buffer round-tripped through feature-carrying program twins
+  (``step_f``/``chunk_f``/``draft_feat``/``ftree_verify``); the chunk
+  dispatch also teacher-forces the head's prompt K/V (no separate
+  draft-admit ladder), warm prefix admissions open the head's attention
+  window at the computed suffix, and feature mode always rides the tree
+  round programs (a chain config promotes to the branching-1 tree).
+  Accepted tokens/dispatch beats the truncated-layer draft because the
+  target's own feature summarizes the whole prefix; greedy stays
+  bit-identical to plain for ANY head. An accept-driven auto-tuner
+  (``_TreeAutoTuner``, same ``decode_spec_accept_floor`` knob) also
+  reshapes the per-depth tree width masks from the accepted-path-length
+  reach EWMA — data-only, never wider than the configured tree, probe
+  rounds tagged in the flight frames.
 
 - Pipelined decode rounds (``ENGINE_DECODE_PIPELINE``, default on): the
   host-bubble microscope measured the serial loop's per-round gap as
@@ -146,9 +163,12 @@ from seldon_core_tpu.telemetry.flight import register as flight_register
 from seldon_core_tpu.models.decoder import (
     decoder_dims,
     draft_propose,
+    draft_propose_features,
     draft_propose_tree,
     draft_tree_commit,
+    feature_chunk_prefill,
     init_slot_cache,
+    is_feature_draft,
     paged_chunk_prefill,
     paged_decode_step,
     paged_tree_commit,
@@ -182,7 +202,7 @@ def _fused_step(params, pool, bt, tokens, positions, temps, topks, seed, tick):
     (matters doubly when each dispatch is a network RTT on the tunnel
     harness). ``tick`` is a traced scalar, so the per-step RNG key needs
     no host-side split and the program never recompiles."""
-    logits, pool = paged_decode_step(params, pool, bt, tokens, positions)
+    logits, _hidden, pool = paged_decode_step(params, pool, bt, tokens, positions)
     key = jax.random.fold_in(jax.random.key(seed), tick)
     return sample_tokens(logits, temps, topks, key), pool
 
@@ -219,7 +239,7 @@ def _fused_chunk(params, pool, bt, ids, positions, counts, temps, topks, seed, t
     the first generated token). With the monolithic admit path gone, this
     IS admission's prompt compute — a whole wave prefills in one dispatch
     at the top bucket, or spread over rounds when chunking is on."""
-    logits, pool = paged_chunk_prefill(params, pool, bt, ids, positions, counts)
+    logits, _hidden, pool = paged_chunk_prefill(params, pool, bt, ids, positions, counts)
     c = ids.shape[1]
     idx = jnp.clip(counts - 1, 0, c - 1)
     last = logits[jnp.arange(ids.shape[0]), idx]  # [n, vocab]
@@ -258,7 +278,7 @@ def _fused_verify(
     (out_tokens [n, k+1], n_accepted [n]). The draft's proposals and raw
     logits stay on device between the two dispatches."""
     queries = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [n, k+1]
-    logits, pool = paged_verify_step(params, pool, bt, queries, positions)
+    logits, _hidden, pool = paged_verify_step(params, pool, bt, queries, positions)
     key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
     out, acc = speculative_accept(
         logits, drafts, draft_logits, limits, temps, topks, key
@@ -293,7 +313,9 @@ def _fused_tree_verify(
     draft K/V into the flat draft cache. Readback is (out_tokens
     [n, depth+1], n_accepted [n]); everything else stays on device."""
     queries = jnp.concatenate([tokens[:, None], node_tokens], axis=1)  # [n, width]
-    logits, new_k, new_v = paged_tree_verify(params, pool, bt, queries, positions, tree)
+    logits, _hidden, new_k, new_v = paged_tree_verify(
+        params, pool, bt, queries, positions, tree
+    )
     key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
     out, acc, path_idx = speculative_accept_tree(
         logits, queries, block_logits, width_limits, temps, topks, key, tree
@@ -303,53 +325,219 @@ def _fused_tree_verify(
     return out, acc, pool, dck, dcv
 
 
-class _SpecAdapt:
-    """Rolling per-deployment accept-rate estimate driving the EFFECTIVE
-    speculation depth between a configured floor and the deployment's
-    ceiling (the chain's spec_k, or the tree's configured depth — the
-    per-depth branchings themselves are the width ceiling and are never
-    exceeded). Adaptation changes only DATA (per-slot accept limits /
-    per-depth width masks), never program shapes, so it costs zero
-    recompiles by construction.
+def _fused_step_feat(
+    params, pool, bt, tokens, positions, feats, fmask, temps, topks, seed, tick
+):
+    """``_fused_step`` for feature-draft deployments: the same fused
+    decode+sample dispatch, additionally round-tripping the per-slot
+    FEATURE buffer — the consumed position's final-layer hidden replaces
+    the slot's carried feature wherever ``fmask`` (generating,
+    non-prefilling slots) holds, so a degraded/mixed plain round keeps
+    the next speculative round's draft root correctly conditioned."""
+    logits, hidden, pool = paged_decode_step(params, pool, bt, tokens, positions)
+    key = jax.random.fold_in(jax.random.key(seed), tick)
+    new_feats = jnp.where(fmask[:, None], hidden, feats)
+    return sample_tokens(logits, temps, topks, key), new_feats, pool
 
-    Policy: an EWMA of per-round ``accepted / allowed`` path fractions.
-    Below ``floor`` the scheduler degrades to PLAIN decode (a cold or
-    adversarial workload stops paying draft + widened-verify cost for
-    tokens it won't accept), with a cheap depth-1 probe round every
-    ``probe_every`` rounds so the estimate can recover when the workload
-    turns draftable again. At/above the floor the depth scales linearly
-    up to the ceiling. ``floor <= 0`` disables adaptation (fixed shape)."""
+
+def _fused_chunk_feat(
+    params, fparams, pool, bt, dck, dcv, ids, positions, counts, feats,
+    starts, temps, topks, seed, tick,
+):
+    """``_fused_chunk`` for feature-draft deployments: the target chunk
+    prefill PLUS the head's teacher-forced prefill over the same chunk
+    (models/decoder.feature_chunk_prefill — the head's K/V is written
+    under the same counts mask, so the separate draft-admit program is
+    gone in feature mode), and the per-slot feature carry: slots that
+    consumed prompt tokens this round update their feature to the chunk's
+    last computed hidden; everyone else keeps theirs."""
+    logits, hidden, pool = paged_chunk_prefill(params, pool, bt, ids, positions, counts)
+    c = ids.shape[1]
+    rows = jnp.arange(ids.shape[0])
+    idx = jnp.clip(counts - 1, 0, c - 1)
+    last = logits[rows, idx]  # [n, vocab]
+    dck, dcv = feature_chunk_prefill(
+        fparams, dck, dcv, ids, hidden, feats, positions, counts, starts
+    )
+    new_feats = jnp.where((counts > 0)[:, None], hidden[rows, idx], feats)
+    key = jax.random.fold_in(jax.random.key(seed), tick)
+    return sample_tokens(last, temps, topks, key), new_feats, pool, dck, dcv
+
+
+def _fused_draft_feat(
+    fparams, dck, dcv, feats, tokens, positions, starts, temps, topks, seed, tick, tree
+):
+    """One device program per FEATURE speculation round, draft side: the
+    head's root step (fusing the slot's carried target feature with the
+    last emitted token) + ``tree.depth`` unrolled feature-autoregressive
+    expansions (models/decoder.draft_propose_features). Same RNG stream
+    and return layout as the token tree draft."""
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 1)
+    return draft_propose_features(
+        fparams, dck, dcv, feats, tokens, positions, starts, temps, topks, key, tree
+    )
+
+
+def _fused_ftree_verify(
+    params, pool, bt, tokens, node_tokens, block_logits, node_k, node_v,
+    dck, dcv, feats, fmask, positions, width_limits, temps, topks, seed,
+    tick, tree,
+):
+    """``_fused_tree_verify`` for feature-draft deployments: identical
+    widened verify + longest-accepted-path walk + both commits, plus the
+    FEATURE carry the head needs for the next round's root — the target's
+    final-layer hidden at the accepted path's LAST block (root when
+    nothing accepted), selected on device so the readback stays
+    (out_tokens, n_accepted)."""
+    queries = jnp.concatenate([tokens[:, None], node_tokens], axis=1)  # [n, width]
+    logits, hidden, new_k, new_v = paged_tree_verify(
+        params, pool, bt, queries, positions, tree
+    )
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), tick), 2)
+    out, acc, path_idx = speculative_accept_tree(
+        logits, queries, block_logits, width_limits, temps, topks, key, tree
+    )
+    pool = paged_tree_commit(pool, bt, new_k, new_v, path_idx, positions, acc)
+    dck, dcv = draft_tree_commit(dck, dcv, node_k, node_v, path_idx, positions, acc)
+    rows = jnp.arange(tokens.shape[0])
+    last_blk = jnp.take_along_axis(path_idx, acc[:, None], axis=1)[:, 0]
+    new_feats = jnp.where(fmask[:, None], hidden[rows, last_blk], feats)
+    return out, acc, pool, dck, dcv, new_feats
+
+
+class _TreeAutoTuner:
+    """Accept-driven speculation controller: the depth-only ``_SpecAdapt``
+    EWMA policy (plain-decode degrade below ``floor``, periodic depth-1
+    probe, linear depth ramp to the ceiling) EXTENDED with per-depth tree
+    reshaping from the accepted-path-length signal the
+    ``spec_tree_{nodes,accepted_path_len}`` histograms record. Adaptation
+    changes only DATA (per-slot accept limits / per-depth width masks),
+    never program shapes — zero recompiles by construction — and NEVER
+    widens past the configured tree (per depth ``min`` with the
+    deployment branching).
+
+    Width policy: ``reach[d]`` is an EWMA of the probability that a
+    riding slot's accepted path REACHES depth d+1 (i.e. accepted >= d
+    tokens, estimated only over slots whose limit allowed it). A depth
+    that paths rarely reach holds nodes that are almost never on the
+    accepted path — pure verify-width waste — so its width scales down
+    proportionally (``reach / reach_hi``, floor 1) and is cut entirely
+    below ``reach_lo``. While any depth is narrowed, every
+    ``probe_every``-th speculative round runs the FULL configured shape
+    (``probe=True``) so ``reach`` can recover when the workload turns —
+    the same explore/exploit escape the depth controller's plain-probe
+    uses. ``floor <= 0`` disables ALL adaptation (fixed shape), the
+    documented ``decode_spec_accept_floor`` contract."""
 
     def __init__(
-        self, floor: float, ceiling: int, alpha: float = 0.2, probe_every: int = 16
+        self,
+        floor: float,
+        ceiling: int,
+        tree: SpecTree | None = None,
+        alpha: float = 0.2,
+        probe_every: int = 16,
+        reach_hi: float = 0.5,
+        reach_lo: float = 0.05,
     ):
         self.floor = float(floor)
         self.ceiling = int(ceiling)
+        self.tree = tree
         self.alpha = float(alpha)
         self.probe_every = int(probe_every)
+        self.reach_hi = float(reach_hi)
+        self.reach_lo = float(reach_lo)
         # optimistic start: the first rounds run the full configured shape
         # so a warm workload never pays a ramp-up
         self.rate = 1.0
+        self.reach = [1.0] * (tree.depth if tree is not None else 0)
         self.plain_rounds = 0
+        self.spec_rounds = 0
         self.probes = 0
+        self.probing = False  # the LAST decide() returned a probe round
 
-    def update(self, accepted: int, allowed: int) -> None:
+    def update(self, accepted: int, allowed: int, paths=None) -> None:
+        """Per-round observation: total accepted/allowed (the depth
+        controller's EWMA) and optionally the per-slot ``(accepted,
+        limit)`` pairs of riding slots (the reach estimate). Probe rounds
+        feed both — that is their whole point."""
         if allowed > 0:
             self.rate += self.alpha * (accepted / allowed - self.rate)
+        if not paths or self.tree is None:
+            return
+        # reach[0] stays pinned at 1.0 — depth-1 nodes are reachable by
+        # construction (the walk always considers the root's children),
+        # so only deeper levels carry an estimate
+        for d in range(1, len(self.reach)):
+            samples = [1.0 if a >= d else 0.0 for a, lim in paths if lim >= d + 1]
+            if samples:
+                mean = sum(samples) / len(samples)
+                self.reach[d] += self.alpha * (mean - self.reach[d])
 
     def depth(self) -> int:
-        """Effective speculation depth for the NEXT round (0 = plain)."""
+        """Effective speculation depth for the NEXT round (0 = plain).
+        Mutates the probe counters — call once per round (``decide``)."""
         if self.floor <= 0.0:
             return self.ceiling
         if self.rate < self.floor:
             self.plain_rounds += 1
             if self.probe_every and self.plain_rounds % self.probe_every == 0:
                 self.probes += 1
+                self.probing = True
                 return 1
             return 0
         self.plain_rounds = 0
         frac = (self.rate - self.floor) / max(1.0 - self.floor, 1e-6)
         return max(1, min(self.ceiling, int(np.ceil(frac * self.ceiling))))
+
+    def widths(self) -> tuple[int, ...] | None:
+        """Tuned per-depth width ceiling for the NEXT round (None = no
+        tree / adaptation off — use the configured shape). Never exceeds
+        the configured branching; depth 1 always keeps its configured
+        width (its nodes are reachable by construction — reach has
+        nothing to say about them; a round with no depth-1 node is a
+        plain round, which the depth controller owns)."""
+        if self.tree is None or self.floor <= 0.0:
+            return None
+        base = self.tree.branching
+        out = []
+        for d, b in enumerate(base):
+            if d == 0:
+                out.append(b)
+                continue
+            r = self.reach[d]
+            if r < self.reach_lo:
+                out.append(0)
+                continue
+            if r >= self.reach_hi:
+                out.append(b)
+            else:
+                out.append(max(1, int(np.ceil(b * r / self.reach_hi))))
+        if self.probing or tuple(out) == base:
+            return base if self.probing else tuple(out)
+        # narrowed: periodic full-shape probe so reach can recover
+        self.spec_rounds += 1
+        if self.probe_every and self.spec_rounds % self.probe_every == 0:
+            self.probes += 1
+            self.probing = True
+            return base
+        return tuple(out)
+
+    def decide(self) -> tuple[int, tuple[int, ...] | None, bool]:
+        """One call per round: (effective depth, tuned width ceiling or
+        None, probe flag). Probe rounds — the depth controller's depth-1
+        recovery probe and the width tuner's full-shape probe — are
+        flagged so the flight frame can tag them (aggregates must not
+        read deliberate exploration as genuine accept degradation).
+        Depth 0 skips the width tuner entirely: a plain round runs no
+        speculative dispatch, so scheduling (and counting) a width probe
+        there would burn the probe cadence on rounds that cannot
+        observe anything."""
+        self.probing = False
+        d = self.depth()
+        if d == 0:
+            return 0, None, False
+        w = self.widths()
+        return d, w, self.probing
 
 
 class _PipelineGate:
@@ -685,6 +873,26 @@ class DecodeScheduler:
         self.spec_enabled = draft_params is not None and (
             spec_k >= 1 or self.spec_tree is not None
         )
+        # feature-level drafting (EAGLE-style): the draft is the one-layer
+        # feature HEAD (models/decoder.init_feature_draft — the ``fc``
+        # fuse marks the layout) conditioned on the target's final-layer
+        # hidden instead of re-embedded tokens. Feature mode always rides
+        # the TREE round programs: a chain-only config (decode_spec_k
+        # without decode_spec_tree) is promoted to the degenerate
+        # branching-1 tree, which IS the chain.
+        self.feature_draft = self.spec_enabled and is_feature_draft(draft_params)
+        if self.feature_draft and self.spec_tree is None:
+            if int(spec_k) > MAX_TREE_NODES:
+                # the promoted branching-1 tree rides the same widened
+                # dispatch — enforce the verify-width headroom HERE, since
+                # the chain-shaped check below only runs when no tree
+                # exists (it would be bypassed by the promotion)
+                raise ValueError(
+                    f"decode_spec_k={int(spec_k)} exceeds the widened-verify "
+                    f"headroom ({MAX_TREE_NODES} proposed tokens per dispatch)"
+                )
+            self.spec_tree = SpecTree.chain(max(1, int(spec_k)))
+            self._tree_text = ",".join(str(b) for b in self.spec_tree.branching)
         self.spec_k = (
             self.spec_tree.depth
             if self.spec_tree is not None
@@ -699,12 +907,16 @@ class DecodeScheduler:
                 f"headroom ({MAX_TREE_NODES} proposed tokens per dispatch)"
             )
         self.draft_params = draft_params if self.spec_enabled else None
-        # accept-rate-adaptive speculation depth: EWMA of accepted/allowed
-        # drives the EFFECTIVE depth between plain decode (rate < floor)
-        # and the configured ceiling — data-only adaptation, zero
+        # accept-driven speculation controller: the EWMA of
+        # accepted/allowed drives the EFFECTIVE depth between plain decode
+        # (rate < floor) and the configured ceiling, and — on tree
+        # deployments — the per-depth reach estimate reshapes the width
+        # masks within the configured tree. Data-only adaptation, zero
         # recompiles. floor <= 0 pins the configured shape.
         self._adapt = (
-            _SpecAdapt(spec_accept_floor, self.spec_k) if self.spec_enabled else None
+            _TreeAutoTuner(spec_accept_floor, self.spec_k, self.spec_tree)
+            if self.spec_enabled
+            else None
         )
 
         # prefix cache: the radix index over pool-page references.
@@ -751,6 +963,12 @@ class DecodeScheduler:
                 raise ValueError(
                     f"draft position table ({ddims['max_len']}) is smaller "
                     f"than seq_len + max_new_tokens ({self.max_ctx})"
+                )
+            if self.feature_draft and ddims["hidden"] != dims["hidden"]:
+                raise ValueError(
+                    f"feature draft hidden {ddims['hidden']} != target "
+                    f"hidden {dims['hidden']} — the head's fc fuse consumes "
+                    "the target's feature vector directly"
                 )
 
         # tensor-parallel decode mesh (parallel/tp.py): params (target AND
@@ -828,6 +1046,18 @@ class DecodeScheduler:
             self._dck, self._dcv = self._commit_kv(
                 draft_params, init_slot_cache(draft_params, n_slots, self._draft_ctx, dtype)
             )
+        if self.feature_draft:
+            # per-slot carried target feature f_{pos-1} (device-resident,
+            # round-tripped through every fused program that can move a
+            # slot's position — step/chunk/verify — so the next round's
+            # draft root is always conditioned on the LAST consumed
+            # position's hidden) and the per-slot draft attention window
+            # start (host data: the computed suffix boundary on warm
+            # prefix-reuse admissions)
+            self._feat = self._commit_kv(
+                params, (jnp.zeros((n_slots, dims["hidden"]), dtype),)
+            )[0]
+            self._draft_start = np.zeros(n_slots, np.int32)
         # compiled programs — the pool state tuple is donated so page
         # updates are in-place in HBM. The step program is ONE executable;
         # the chunk ladder compiles one per bucket; the pool's CoW copy
@@ -868,13 +1098,49 @@ class DecodeScheduler:
             tree_verify_kw = (
                 {"out_shardings": (rep, rep, pool_sh) + dc_sh} if dc_sh else {}
             )
+            # feature-draft twins: the feat buffer [n_slots, hidden] is
+            # replicated (it feeds the fc fuse on every device)
+            step_f_kw = {"out_shardings": (rep, rep, pool_sh)}
+            chunk_f_kw = (
+                {"out_shardings": (rep, rep, pool_sh) + dc_sh} if dc_sh else {}
+            )
+            ftree_verify_kw = (
+                {"out_shardings": (rep, rep, pool_sh) + dc_sh + (rep,)}
+                if dc_sh
+                else {}
+            )
         else:
             step_kw = verify_kw = draft_kw = draft_admit_kw = {}
             draft_tree_kw = tree_verify_kw = {}
-        self._step_fn = jax.jit(_fused_step, donate_argnums=(1,), **step_kw)
-        self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,), **step_kw)
+            step_f_kw = chunk_f_kw = ftree_verify_kw = {}
+        if self.feature_draft:
+            # feature mode swaps the step/chunk pair for feature-carrying
+            # twins (the chunk one also teacher-forces the head's prompt
+            # K/V, so the separate draft-admit ladder is gone)
+            self._step_f_fn = jax.jit(
+                _fused_step_feat, donate_argnums=(1, 5), **step_f_kw
+            )
+            self._chunk_f_fn = jax.jit(
+                _fused_chunk_feat, donate_argnums=(2, 4, 5, 9), **chunk_f_kw
+            )
+        else:
+            self._step_fn = jax.jit(_fused_step, donate_argnums=(1,), **step_kw)
+            self._chunk_fn = jax.jit(_fused_chunk, donate_argnums=(1,), **step_kw)
         if self.spec_enabled:
-            if self.spec_tree is not None:
+            if self.feature_draft:
+                self._draft_feat_fn = jax.jit(
+                    _fused_draft_feat,
+                    donate_argnums=(1, 2),
+                    static_argnums=(11,),
+                    **draft_tree_kw,
+                )
+                self._ftree_verify_fn = jax.jit(
+                    _fused_ftree_verify,
+                    donate_argnums=(1, 8, 9, 10),
+                    static_argnums=(18,),
+                    **ftree_verify_kw,
+                )
+            elif self.spec_tree is not None:
                 # tree mode subsumes the chain (a branching-1 tree IS the
                 # chain), so the chain draft/verify pair is not compiled —
                 # per-request chain/plain tightening rides the SAME tree
@@ -898,18 +1164,20 @@ class DecodeScheduler:
                 self._verify_fn = jax.jit(
                     _fused_verify, donate_argnums=(1,), **verify_kw
                 )
-            self._draft_admit_fn = jax.jit(
-                _fused_draft_admit, donate_argnums=(1, 2), **draft_admit_kw
-            )
-            # wave buckets for the draft's transition-time flat prefill —
-            # the only surviving consumer of the admit ladder now that the
-            # target side admits through the chunk programs
-            buckets = []
-            b = 1
-            while b < n_slots:
-                buckets.append(b)
-                b *= 2
-            self.admit_buckets = tuple(buckets) + (n_slots,)
+            if not self.feature_draft:
+                self._draft_admit_fn = jax.jit(
+                    _fused_draft_admit, donate_argnums=(1, 2), **draft_admit_kw
+                )
+                # wave buckets for the draft's transition-time flat prefill
+                # — the only surviving consumer of the admit ladder now
+                # that the target side admits through the chunk programs
+                # (the feature head's prompt K/V rides the chunk ladder)
+                buckets = []
+                b = 1
+                while b < n_slots:
+                    buckets.append(b)
+                    b *= 2
+                self.admit_buckets = tuple(buckets) + (n_slots,)
         # on an accelerator, device dispatch + token readback block the
         # calling thread for the device-step latency — run them on the
         # shared compute pool so the serving event loop (ingress, batcher
@@ -1066,31 +1334,71 @@ class DecodeScheduler:
         vslot = np.zeros(self.n_slots, bool)
         bt0 = self.pool.block_tables()  # all-zero: every write junk-sinks
         for c in self.chunk_buckets:
-            toks, self.pool.state = self._chunk_fn(
-                self.params, self.pool.state, bt0,
-                np.zeros((self.n_slots, c), np.int32),
-                zslot, zslot,
-                np.zeros(self.n_slots, np.float32), zslot,
-                self._seed, np.int32(0),
-            )
+            if self.feature_draft:
+                # counts 0: the head's teacher-forced writes mask off and
+                # the feat carry keeps its zeros — no live bytes touched
+                toks, self._feat, self.pool.state, self._dck, self._dcv = (
+                    self._chunk_f_fn(
+                        self.params, self.draft_params, self.pool.state, bt0,
+                        self._dck, self._dcv,
+                        np.zeros((self.n_slots, c), np.int32),
+                        zslot, zslot, self._feat, zslot,
+                        np.zeros(self.n_slots, np.float32), zslot,
+                        self._seed, np.int32(0),
+                    )
+                )
+            else:
+                toks, self.pool.state = self._chunk_fn(
+                    self.params, self.pool.state, bt0,
+                    np.zeros((self.n_slots, c), np.int32),
+                    zslot, zslot,
+                    np.zeros(self.n_slots, np.float32), zslot,
+                    self._seed, np.int32(0),
+                )
         self.pool.warmup()  # the CoW copy ladder (page0 self-copies)
-        if self.spec_enabled:
+        if self.spec_enabled and not self.feature_draft:
             for b in self.admit_buckets:
                 self._dck, self._dcv = self._draft_admit_fn(
                     self.draft_params, self._dck, self._dcv,
                     np.zeros((b, self.seq_len), np.int32), zslot, vslot,
                 )
-        many, self.pool.state = self._step_fn(
-            self.params, self.pool.state, bt0,
-            np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
-            np.zeros(self.n_slots, np.float32), np.zeros(self.n_slots, np.int32),
-            self._seed, np.int32(0),
-        )
+        if self.feature_draft:
+            many, self._feat, self.pool.state = self._step_f_fn(
+                self.params, self.pool.state, bt0,
+                np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
+                self._feat, vslot,
+                np.zeros(self.n_slots, np.float32), np.zeros(self.n_slots, np.int32),
+                self._seed, np.int32(0),
+            )
+        else:
+            many, self.pool.state = self._step_fn(
+                self.params, self.pool.state, bt0,
+                np.zeros(self.n_slots, np.int32), np.zeros(self.n_slots, np.int32),
+                np.zeros(self.n_slots, np.float32), np.zeros(self.n_slots, np.int32),
+                self._seed, np.int32(0),
+            )
         if self.spec_enabled:
             # the speculative round pair: junk writes land in page 0
             zi = np.zeros(self.n_slots, np.int32)
             zf = np.zeros(self.n_slots, np.float32)
-            if self.spec_tree is not None:
+            if self.feature_draft:
+                node_toks, blogits, nk, nv, self._dck, self._dcv = (
+                    self._draft_feat_fn(
+                        self.draft_params, self._dck, self._dcv, self._feat,
+                        zi, zi, zi, zf, zi, self._seed, np.int32(0),
+                        self.spec_tree,
+                    )
+                )
+                wl0 = np.zeros((self.n_slots, self.spec_tree.depth), np.int32)
+                out_t, acc, self.pool.state, self._dck, self._dcv, self._feat = (
+                    self._ftree_verify_fn(
+                        self.params, self.pool.state, bt0, zi, node_toks,
+                        blogits, nk, nv, self._dck, self._dcv, self._feat,
+                        vslot, zi, wl0, zf, zi, self._seed, np.int32(0),
+                        self.spec_tree,
+                    )
+                )
+            elif self.spec_tree is not None:
                 node_toks, blogits, nk, nv, self._dck, self._dcv = (
                     self._draft_tree_fn(
                         self.draft_params, self._dck, self._dcv,
@@ -1126,6 +1434,15 @@ class DecodeScheduler:
         UNDERLYING function, so counts accumulate across scheduler
         instances in one process (multi-tenant) — the zero-recompile
         assertion is therefore relative: recompiles_since_warmup()."""
+        if self.feature_draft:
+            counts = {
+                "step_f": self._step_f_fn._cache_size(),
+                "chunk_f": self._chunk_f_fn._cache_size(),
+                "copy": self.pool.compile_count(),
+                "draft_feat": self._draft_feat_fn._cache_size(),
+                "ftree_verify": self._ftree_verify_fn._cache_size(),
+            }
+            return counts
         counts = {
             "step": self._step_fn._cache_size(),
             "chunk": self._chunk_fn._cache_size(),
@@ -1504,6 +1821,8 @@ class DecodeScheduler:
         self._rb_depth = 0
         self._rb_active = 0
         self._rb_overlap = 0
+        self._rb_probe = False
+        self._rb_widths = ()
         # stale shadow admissions (a round error between the overlap
         # window and the reconcile): the normal flow drains the list at
         # _apply_pending before the round commits, so anything still here
@@ -1590,9 +1909,26 @@ class DecodeScheduler:
                     self._rb_proposed, self._rb_depth, tuple(self._rb_busy),
                     gap, snap["free"], snap["live"], snap["prefix"],
                     self._rb_cow, phase_ns, tuple(self._rb_rdb),
-                    self._rb_overlap,
+                    self._rb_overlap, self._rb_probe, tuple(self._rb_widths),
                 )
             )
+            if self.spec_enabled:
+                # adaptive-speculation state for /decode/health: the tuned
+                # shape, the controller's EWMA, and the effective depth
+                # the NEXT round will see (latest-wins attribute — the
+                # per-round history is in the frames)
+                self.flight.spec_state = {
+                    "tree": getattr(self, "_tree_text", ""),
+                    "widths": list(self._rb_widths),
+                    "nodes": (
+                        self.spec_tree.nodes_for_widths(self._rb_widths)
+                        if self.spec_tree is not None and self._rb_widths
+                        else 0
+                    ),
+                    "accept_ewma": round(self._adapt.rate, 4),
+                    "depth": self._rb_depth,
+                    "probes": self._adapt.probes,
+                }
         self._metrics.decode_round(self._deployment, busy / 1e9, gap / 1e9)
         if self.flight.enabled and self.flight.rounds % 64 == 0:
             # refresh the cumulative bubble gauge off the O(1) totals —
@@ -1661,6 +1997,11 @@ class DecodeScheduler:
         self._slots[slot] = seq
         self.stat_admitted += 1
         self._rb_admitted += 1
+        if self.feature_draft:
+            # the head's attention window opens at the computed suffix: the
+            # prefix-reused span has no draft-side K/V (the chunk rounds
+            # teacher-force only what they compute)
+            self._draft_start[slot] = reuse
         shared_pages = self.pool.alloc.pages_for(reuse) if reuse else 0
         if self.prefix_enabled:
             if entry is not None:
@@ -2063,18 +2404,37 @@ class DecodeScheduler:
             bt = self.pool.block_tables()
         tick = self._next_tick()
 
-        def _do_chunk():
-            toks, state = self._chunk_fn(
-                self.params, self.pool.state, bt, ids, pos, counts, temps,
-                topks, self._seed, tick,
-            )
-            if self._sync_timing:
-                jax.block_until_ready((toks, state))
-            self._mark_enqueued()
-            return np.asarray(toks), state
+        if self.feature_draft:
 
-        t0 = telemetry.now_ns()
-        toks, self.pool.state = await self._timed_call(F_CHUNK, _do_chunk)
+            def _do_chunk():
+                toks, feat, state, dck, dcv = self._chunk_f_fn(
+                    self.params, self.draft_params, self.pool.state, bt,
+                    self._dck, self._dcv, ids, pos, counts, self._feat,
+                    self._draft_start, temps, topks, self._seed, tick,
+                )
+                if self._sync_timing:
+                    jax.block_until_ready((toks, state))
+                self._mark_enqueued()
+                return np.asarray(toks), (feat, state, dck, dcv)
+
+            t0 = telemetry.now_ns()
+            toks, (self._feat, self.pool.state, self._dck, self._dcv) = (
+                await self._timed_call(F_CHUNK, _do_chunk)
+            )
+        else:
+
+            def _do_chunk():
+                toks, state = self._chunk_fn(
+                    self.params, self.pool.state, bt, ids, pos, counts, temps,
+                    topks, self._seed, tick,
+                )
+                if self._sync_timing:
+                    jax.block_until_ready((toks, state))
+                self._mark_enqueued()
+                return np.asarray(toks), state
+
+            t0 = telemetry.now_ns()
+            toks, self.pool.state = await self._timed_call(F_CHUNK, _do_chunk)
         t1 = telemetry.now_ns()
         self.stat_chunk_dispatches += 1
         finishing: list[tuple[_Seq, int]] = []
@@ -2098,7 +2458,9 @@ class DecodeScheduler:
                 seq.chunk_idx += 1
                 if seq.prefill_pos >= self.seq_len:
                     finishing.append((seq, i))
-        if finishing and self.spec_enabled:
+        if finishing and self.spec_enabled and not self.feature_draft:
+            # (feature mode needs no transition-time draft prefill — the
+            # head's prompt K/V was teacher-forced by the chunk dispatches)
             td = time.perf_counter_ns()
             self._draft_admit([i for _, i in finishing])
             # async dispatch: this is enqueue cost; the device time lands
@@ -2127,7 +2489,9 @@ class DecodeScheduler:
                 if self._finished(seq, int(toks[i])):
                     self._retire(i)
 
-    async def _spec_round(self, bt, toks, pos, temps, topks, limits, wlimits, tick) -> None:
+    async def _spec_round(
+        self, bt, toks, pos, temps, topks, limits, wlimits, fmask, tick
+    ) -> None:
         """One speculative round: ONE draft dispatch proposes spec_k
         tokens per slot (or the whole candidate TREE on tree deployments),
         ONE widened target dispatch verifies them, and every slot advances
@@ -2151,7 +2515,21 @@ class DecodeScheduler:
             # pair. ENGINE_FLIGHT_SYNC_TIMING blocks after each program so
             # both columns become ground-truth per-dispatch device wall.
             td0 = time.perf_counter_ns()
-            if tree is not None:
+            feat = None  # the feature carry (feature-draft deployments only)
+            if self.feature_draft:
+                node_toks, blogits, nk, nv, dck, dcv = self._draft_feat_fn(
+                    self.draft_params, self._dck, self._dcv, self._feat, toks,
+                    pos, self._draft_start, temps, topks, self._seed, tick, tree,
+                )
+                if self._sync_timing:
+                    jax.block_until_ready(node_toks)
+                td1 = time.perf_counter_ns()
+                out_t, acc, state, dck, dcv, feat = self._ftree_verify_fn(
+                    self.params, self.pool.state, bt, toks, node_toks, blogits,
+                    nk, nv, dck, dcv, self._feat, fmask, pos, wlimits, temps,
+                    topks, self._seed, tick, tree,
+                )
+            elif tree is not None:
                 node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
                     self.draft_params, self._dck, self._dcv, toks, pos, temps,
                     topks, self._seed, tick, tree,
@@ -2181,12 +2559,14 @@ class DecodeScheduler:
             tv = time.perf_counter_ns()
             out_t, acc = np.asarray(out_t), np.asarray(acc)
             td2 = time.perf_counter_ns()
-            return out_t, acc, state, dck, dcv, td1 - td0, tv - td1, td2 - tv
+            return out_t, acc, state, dck, dcv, feat, td1 - td0, tv - td1, td2 - tv
 
         t0 = telemetry.now_ns()
-        out_t, acc, self.pool.state, self._dck, self._dcv, d_ns, v_enq, v_rdb = (
+        out_t, acc, self.pool.state, self._dck, self._dcv, feat, d_ns, v_enq, v_rdb = (
             await self._device_call(_do_spec)
         )
+        if feat is not None:
+            self._feat = feat
         t1 = telemetry.now_ns()
         self._rb_busy[F_DRAFT] += d_ns
         self._rb_busy[F_VERIFY] += v_enq + v_rdb
@@ -2197,7 +2577,7 @@ class DecodeScheduler:
         self._consume_spec(out_t, acc, limits, wlimits, t0, t1)
 
     async def _spec_round_pipelined(
-        self, bt, toks, pos, temps, topks, limits, wlimits, tick
+        self, bt, toks, pos, temps, topks, limits, wlimits, fmask, tick
     ) -> None:
         """The double-buffered twin of ``_spec_round``: the round pair's
         draft + widened-verify dispatches enqueue back-to-back, round
@@ -2211,7 +2591,18 @@ class DecodeScheduler:
         tree = self.spec_tree
         t0 = telemetry.now_ns()
         td0 = time.perf_counter_ns()
-        if tree is not None:
+        if self.feature_draft:
+            node_toks, blogits, nk, nv, dck, dcv = self._draft_feat_fn(
+                self.draft_params, self._dck, self._dcv, self._feat, toks,
+                pos, self._draft_start, temps, topks, self._seed, tick, tree,
+            )
+            td1 = time.perf_counter_ns()
+            out_dev, acc_dev, state, dck, dcv, self._feat = self._ftree_verify_fn(
+                self.params, self.pool.state, bt, toks, node_toks, blogits,
+                nk, nv, dck, dcv, self._feat, fmask, pos, wlimits, temps,
+                topks, self._seed, tick, tree,
+            )
+        elif tree is not None:
             node_toks, blogits, nk, nv, dck, dcv = self._draft_tree_fn(
                 self.draft_params, self._dck, self._dcv, toks, pos, temps,
                 topks, self._seed, tick, tree,
@@ -2315,12 +2706,20 @@ class DecodeScheduler:
         self._rb_accepted = accepted
         self._rb_proposed = proposed
         if self._adapt is not None:
-            self._adapt.update(accepted, proposed)
+            # the per-slot (accepted, limit) pairs of riding slots feed
+            # the auto-tuner's per-depth reach estimate — the signal the
+            # width masks are reshaped from
+            paths = [
+                (int(acc[i]), int(limits[i]))
+                for i in range(self.n_slots)
+                if limits[i] > 0
+            ]
+            self._adapt.update(accepted, proposed, paths=paths)
         self._metrics.decode_spec(
             self._deployment, proposed, accepted, emitted, mode=mode
         )
 
-    async def _step_round_pipelined(self, bt, toks, pos, temps, topks, tick):
+    async def _step_round_pipelined(self, bt, toks, pos, temps, topks, fmask, tick):
         """The double-buffered plain round: enqueue the fused step, run
         round N+1's host phases under the in-flight dispatch
         (``_overlap_window``), then block on the token readback. The step
@@ -2330,10 +2729,16 @@ class DecodeScheduler:
         block. Sync-timing runs never come here (_pipeline_on forces the
         serial path)."""
         t0 = time.perf_counter_ns()
-        nxt_dev, state = self._step_fn(
-            self.params, self.pool.state, bt, toks, pos, temps, topks,
-            self._seed, tick,
-        )
+        if self.feature_draft:
+            nxt_dev, self._feat, state = self._step_f_fn(
+                self.params, self.pool.state, bt, toks, pos, self._feat,
+                fmask, temps, topks, self._seed, tick,
+            )
+        else:
+            nxt_dev, state = self._step_fn(
+                self.params, self.pool.state, bt, toks, pos, temps, topks,
+                self._seed, tick,
+            )
         self.pool.state = state
         self._rb_active = self.active  # dispatch-time occupancy
         self._overlap_window()
@@ -2379,11 +2784,15 @@ class DecodeScheduler:
 
                 with self._phase(P_SAMPLING):
                     # next-dispatch input build: the sampled-token /
-                    # position vectors every generating slot rides
+                    # position vectors every generating slot rides.
+                    # ``fmask`` marks the generating rows — the feature
+                    # programs' carry mask (a junk-riding slot must not
+                    # clobber its carried feature)
                     toks = np.zeros(self.n_slots, np.int32)
                     pos = np.zeros(self.n_slots, np.int32)
                     temps = np.zeros(self.n_slots, np.float32)
                     topks = np.zeros(self.n_slots, np.int32)
+                    fmask = np.zeros(self.n_slots, bool)
                     n_gen = 0
                     for i, seq in enumerate(self._slots):
                         if seq is None:
@@ -2406,6 +2815,7 @@ class DecodeScheduler:
                         pos[i] = seq.pos
                         temps[i] = seq.temperature
                         topks[i] = seq.top_k
+                        fmask[i] = True
                         n_gen += 1
                 if self.active == 0:
                     # chunk round retired everyone (EOS at prompt end,
@@ -2422,12 +2832,16 @@ class DecodeScheduler:
                 limits = None
                 wlimits = None
                 if self.spec_enabled:
-                    # accept-rate-adaptive effective depth for THIS round:
-                    # the ceiling is the configured spec_k / tree depth,
-                    # 0 degrades the round to plain decode (data-only —
-                    # the program set never changes)
-                    ad = self._adapt.depth()
+                    # accept-driven shape for THIS round: the controller's
+                    # effective depth (ceiling = configured spec_k / tree
+                    # depth, 0 = plain decode) and — on tree deployments —
+                    # the tuned per-depth width ceiling, both data-only so
+                    # the program set never changes. Probe rounds (the
+                    # depth-1 recovery probe, the full-shape width probe)
+                    # are tagged into the flight frame.
+                    ad, tuned, probe = self._adapt.decide()
                     self._rb_depth = int(ad)
+                    self._rb_probe = bool(probe)
                     limits = np.zeros(self.n_slots, np.int32)
                     for i, seq in enumerate(self._slots):
                         if seq is None or seq.prefilling:
@@ -2441,16 +2855,24 @@ class DecodeScheduler:
                         )
                     if self.spec_tree is not None:
                         # per-slot per-depth branching widths: the request's
-                        # tightened tree, cut to the slot's depth allowance
-                        # (budget + adaptation). Width 0 at a depth ends the
+                        # tightened tree, cut by the auto-tuner's width
+                        # ceiling (never widening past the configured tree)
+                        # and the slot's depth allowance (budget +
+                        # adaptation). Width 0 at a depth ends the
                         # acceptance walk there as a limit clamp.
+                        base = self.spec_tree.branching
+                        self._rb_widths = tuned if tuned is not None else base
                         wlimits = np.zeros(
                             (self.n_slots, self.spec_tree.depth), np.int32
                         )
                         for i, seq in enumerate(self._slots):
                             if seq is None or seq.prefilling or limits[i] <= 0:
                                 continue
-                            w = seq.tree_widths or self.spec_tree.branching
+                            w = seq.tree_widths or base
+                            if tuned is not None:
+                                w = tuple(
+                                    min(w[d], tuned[d]) for d in range(len(w))
+                                )
                             for d in range(min(int(limits[i]), len(w))):
                                 if w[d] <= 0:
                                     break
@@ -2468,6 +2890,14 @@ class DecodeScheduler:
                     if wlimits is not None
                     else (limits is not None and bool(limits.any()))
                 )
+                if not spec_round and self.spec_enabled:
+                    # a probe the controller scheduled can still fall to a
+                    # plain round here (every riding slot at its budget
+                    # edge zeroes its limit) — the plain frame must not be
+                    # tagged as exploration nor advertise a tree shape the
+                    # round never ran
+                    self._rb_probe = False
+                    self._rb_widths = ()
 
                 # page residency for the round's writes: 1 token per
                 # generating slot on the plain step, the full [k+1]-wide
@@ -2496,11 +2926,13 @@ class DecodeScheduler:
                 if spec_round:
                     if pipelined:
                         await self._spec_round_pipelined(
-                            bt, toks, pos, temps, topks, limits, wlimits, tick
+                            bt, toks, pos, temps, topks, limits, wlimits,
+                            fmask, tick
                         )
                     else:
                         await self._spec_round(
-                            bt, toks, pos, temps, topks, limits, wlimits, tick
+                            bt, toks, pos, temps, topks, limits, wlimits,
+                            fmask, tick
                         )
                     # reconcile the shadow admissions decided under the
                     # round pair's flight BEFORE the frame commits (they
@@ -2516,8 +2948,24 @@ class DecodeScheduler:
 
                 if pipelined:
                     nxt = await self._step_round_pipelined(
-                        bt, toks, pos, temps, topks, tick
+                        bt, toks, pos, temps, topks, fmask, tick
                     )
+                elif self.feature_draft:
+
+                    def _do_step_f():
+                        nxt, feat, state = self._step_f_fn(
+                            self.params, self.pool.state, bt, toks, pos,
+                            self._feat, fmask, temps, topks, self._seed, tick,
+                        )
+                        if self._sync_timing:
+                            jax.block_until_ready((nxt, state))
+                        self._mark_enqueued()
+                        return np.asarray(nxt), (feat, state)
+
+                    nxt, (self._feat, self.pool.state) = await self._timed_call(
+                        F_STEP, _do_step_f
+                    )
+                    self._rb_active = self.active  # dispatch-time occupancy
                 else:
 
                     def _do_step():
@@ -2574,22 +3022,33 @@ class DecodeScheduler:
             self._slots = [None] * self.n_slots
             self._free = list(range(self.n_slots - 1, -1, -1))
             self._waiting.clear()
-            # the pool state was DONATED into the call that just raised —
-            # its buffers may be invalidated, which would poison every
-            # later admission with 'array has been deleted'. Reallocate
-            # (pool.reset also rebuilds the host allocator, so every page
-            # mapping drops with the bytes) and clear the index entries
-            # that pointed into it.
-            self.pool.reset()
-            if self.spec_enabled:
-                self._dck, self._dcv = self._commit_kv(
-                    self.draft_params,
-                    init_slot_cache(
-                        self.draft_params, self.n_slots, self._draft_ctx, self._dtype
-                    ),
-                )
-            if self.prefix_enabled:
-                self._prefix_index.clear()
+            self._reset_device_state()
+
+    def _reset_device_state(self) -> None:
+        """Error-path device-state rebuild: the pool state (and in spec
+        mode the draft caches / feature buffer) was DONATED into the call
+        that just raised — its buffers may be invalidated, which would
+        poison every later admission with 'array has been deleted'.
+        Reallocate (pool.reset also rebuilds the host allocator, so every
+        page mapping drops with the bytes) and clear the index entries
+        that pointed into it."""
+        self.pool.reset()
+        if self.spec_enabled:
+            self._dck, self._dcv = self._commit_kv(
+                self.draft_params,
+                init_slot_cache(
+                    self.draft_params, self.n_slots, self._draft_ctx, self._dtype
+                ),
+            )
+        if self.feature_draft:
+            dims = decoder_dims(self.params)
+            self._feat = self._commit_kv(
+                self.params,
+                (jnp.zeros((self.n_slots, dims["hidden"]), self._dtype),),
+            )[0]
+            self._draft_start[:] = 0
+        if self.prefix_enabled:
+            self._prefix_index.clear()
 
     async def close(self) -> None:
         """Drain: stop accepting NEW work, finish everything in flight AND
@@ -2774,9 +3233,18 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         else:
             dname, dkw = draft_uri, {}
         # the draft must share the target's vocabulary and position-table
-        # reach — inject both from the target unless the URI pins them
+        # reach — inject both from the target unless the URI pins them. A
+        # feature-head draft (zoo://draft?features=1) must also match the
+        # target's hidden width (its fc fuse consumes the target's
+        # feature vector), so that defaults from the target too — and so
+        # does ffn, because the distill recipe sizes the head's FFN to
+        # the target's by default (the documented distill-then-serve flow
+        # must line up without pinning ffn in the URI).
         dims = decoder_dims(runtime.params)
         dkw = {"vocab": dims["vocab"], "max_len": dims["max_len"], **dkw}
+        if dkw.get("features"):
+            ffn = int(runtime.params["layers"][0]["mlp_in"]["w"].shape[1])
+            dkw = {"hidden": dims["hidden"], "ffn": ffn, **dkw}
         dspec = get_model(dname, **dkw)
         if not (isinstance(dspec.params, dict) and "tok_emb" in dspec.params):
             log.warning(

@@ -287,13 +287,19 @@ class FlightFrame:
     round's host bubble (wall - device busy); ``overlap_ns`` the host work
     the PIPELINED loop ran inside a dispatch's busy window (hidden under
     the in-flight dispatch — inside busy, NOT part of the gap, which is
-    exactly why pipelining shrinks bubble_fraction)."""
+    exactly why pipelining shrinks bubble_fraction); ``probe`` marks a
+    DELIBERATE exploration round of the speculation controller (the
+    depth-1 recovery probe while degraded, the full-shape width probe
+    while narrowed) — aggregates report these apart so exploration is
+    never read as genuine accept degradation; ``spec_widths`` the tuned
+    per-depth width ceiling the round ran under (tree rounds only)."""
 
     __slots__ = (
         "seq", "t_ns", "mode", "active", "prefilling", "queued",
         "admitted", "retired", "blocked", "tokens", "accepted", "proposed",
         "spec_depth", "busy_ns", "gap_ns", "kv_free", "kv_live",
         "kv_prefix", "cow", "phase_ns", "rdb_ns", "overlap_ns",
+        "probe", "spec_widths",
     )
 
     def __init__(
@@ -301,6 +307,7 @@ class FlightFrame:
         retired, blocked, tokens, accepted, proposed, spec_depth,
         busy_ns, gap_ns, kv_free, kv_live, kv_prefix, cow,
         phase_ns=_ZERO_PHASES, rdb_ns=_ZERO_FAMILIES, overlap_ns=0,
+        probe=False, spec_widths=(),
     ):
         self.seq = seq
         self.t_ns = t_ns
@@ -324,6 +331,8 @@ class FlightFrame:
         self.phase_ns = phase_ns
         self.rdb_ns = rdb_ns
         self.overlap_ns = overlap_ns
+        self.probe = probe
+        self.spec_widths = spec_widths
 
     def to_dict(self) -> dict:
         d: dict = {
@@ -373,6 +382,10 @@ class FlightFrame:
             d["accepted"] = self.accepted
             d["proposed"] = self.proposed
             d["spec_depth"] = self.spec_depth
+        if self.spec_widths:
+            d["widths"] = list(self.spec_widths)
+        if self.probe:
+            d["probe"] = True
         if self.cow:
             d["cow"] = self.cow
         return d
@@ -420,6 +433,17 @@ class FlightRecorder:
         self.blocked_rounds: dict[str, int] = {}
         self.accepted_total = 0
         self.proposed_total = 0
+        # deliberate controller exploration (depth-1 recovery probes,
+        # full-shape width probes): counted apart so accept-rate summaries
+        # can exclude them — a probe's low accept is by design, not
+        # degradation
+        self.probe_rounds = 0
+        self.probe_accepted = 0
+        self.probe_proposed = 0
+        # latest adaptive-speculation state (the scheduler's commit point
+        # sets it on spec deployments): tuned widths, EWMA accept,
+        # effective depth — surfaced by health()/aggregate readers
+        self.spec_state: dict | None = None
         self.mode_rounds: dict[str, int] = {}
         # goodput / SLO attainment counters
         self.goodput_met_tokens = 0
@@ -467,6 +491,10 @@ class FlightRecorder:
             )
         self.accepted_total += frame.accepted
         self.proposed_total += frame.proposed
+        if frame.probe:
+            self.probe_rounds += 1
+            self.probe_accepted += frame.accepted
+            self.probe_proposed += frame.proposed
         self.mode_rounds[frame.mode] = self.mode_rounds.get(frame.mode, 0) + 1
 
     @property
@@ -536,6 +564,7 @@ class FlightRecorder:
         modes: dict[str, int] = {}
         blocked: dict[str, int] = {}
         depth_sum = spec_rounds = 0
+        probes = probe_acc = probe_prop = 0
         for f in frames:
             for i, ns in enumerate(f.busy_ns):
                 busy[i] += ns
@@ -557,6 +586,10 @@ class FlightRecorder:
             if f.proposed:
                 depth_sum += f.spec_depth
                 spec_rounds += 1
+            if f.probe:
+                probes += 1
+                probe_acc += f.accepted
+                probe_prop += f.proposed
         busy_total = sum(busy)
         wall = busy_total + gap
         out = {
@@ -605,8 +638,23 @@ class FlightRecorder:
             "blocked_rounds": blocked,
         }
         if proposed:
-            out["accept_rate"] = round(accepted / proposed, 4)
+            # accept_rate excludes PROBE rounds: a depth-1 recovery probe
+            # or a full-shape width probe accepts badly BY DESIGN (that is
+            # what it measures) — folding it in would read deliberate
+            # exploration as degradation. The probes' own accept rides
+            # probe_accept_rate beside the count.
+            np_acc = accepted - probe_acc
+            np_prop = proposed - probe_prop
+            out["accept_rate"] = (
+                round(np_acc / np_prop, 4)
+                if np_prop
+                else round(accepted / proposed, 4)
+            )
             out["spec_depth_mean"] = round(depth_sum / max(spec_rounds, 1), 2)
+        if probes:
+            out["probe_rounds"] = probes
+            if probe_prop:
+                out["probe_accept_rate"] = round(probe_acc / probe_prop, 4)
         if frames:
             last = frames[-1]
             out["kv_pages"] = [last.kv_free, last.kv_live, last.kv_prefix]
@@ -710,9 +758,21 @@ class FlightRecorder:
             "dumps": self.dumps,
         }
         if self.proposed_total:
-            out["accept_rate"] = round(
-                self.accepted_total / self.proposed_total, 4
+            # probe rounds excluded — same rationale as aggregate()
+            np_acc = self.accepted_total - self.probe_accepted
+            np_prop = self.proposed_total - self.probe_proposed
+            out["accept_rate"] = (
+                round(np_acc / np_prop, 4)
+                if np_prop
+                else round(self.accepted_total / self.proposed_total, 4)
             )
+        if self.probe_rounds:
+            out["probe_rounds"] = self.probe_rounds
+        if self.spec_state is not None:
+            # the adaptive-speculation state the scheduler last committed:
+            # chosen tree shape (tuned widths), EWMA accept rate,
+            # effective depth
+            out["spec"] = self.spec_state
         if last is not None:
             out["queued"] = last.queued
             out["kv_pages"] = [last.kv_free, last.kv_live, last.kv_prefix]

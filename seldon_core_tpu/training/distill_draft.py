@@ -21,11 +21,22 @@ and serve the result via the checkpoint-loading draft variant:
 
     tpu.decode_draft_model: "zoo://draft?layers=1&...&distilled=/tmp/draft_distilled.npz"
 
+``--features`` trains the EAGLE-style FEATURE HEAD instead
+(models/decoder.init_feature_draft): the teacher supplies per-position
+hidden states beside its logits (sequence_hidden), the head runs
+teacher-forced on them, and the loss adds feature-regression MSE
+(--feat-weight) and input-feature noise (--feat-noise) — the two
+augmentations that keep the head's serving-time feature AUTOREGRESSION
+(deeper tree nodes feed on its own output) from collapsing. Serve via
+
+    tpu.decode_draft_model: "zoo://draft?features=1&distilled=/tmp/draft_feat.npz"
+
 The report prints the greedy accept-rate proxy (draft/target argmax
 agreement along target-greedy trajectories — exactly the per-position
-acceptance probability of the chain/tree walk) before and after, plus the
-KL trajectory; the measured delta for the stock bench pair is recorded in
-PARITY.md.
+acceptance probability of the chain/tree walk; the feature variant runs
+the head on the TRUE teacher features, the serving root's conditioning)
+before and after, plus the KL trajectory; the measured deltas for the
+stock bench pairs are recorded in PARITY.md.
 """
 
 from __future__ import annotations
@@ -116,6 +127,31 @@ def greedy_accept_proxy(target, draft, prompts: np.ndarray, max_new: int) -> flo
     )
 
 
+def greedy_accept_proxy_features(
+    target, head, prompts: np.ndarray, max_new: int
+) -> float:
+    """``greedy_accept_proxy`` for a FEATURE draft head: the head runs
+    teacher-forced on the target's own hidden states along the target's
+    greedy continuation — exactly the serving ROOT step's conditioning
+    (the root always consumes the TRUE previous feature; deeper tree
+    nodes autoregress on the head's own output, for which this is the
+    per-depth upper-bound analogue of the chain proxy)."""
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.decoder import (
+        feature_sequence_logits, generate, sequence_hidden,
+    )
+
+    full = np.asarray(generate(target, jnp.asarray(prompts), max_new))
+    tl, tf = sequence_hidden(target, jnp.asarray(full[:, :-1]))
+    dl, _ = feature_sequence_logits(head, jnp.asarray(full[:, :-1]), tf)
+    tl, dl = np.asarray(tl), np.asarray(dl)
+    gen = slice(prompts.shape[1] - 1, full.shape[1] - 1)
+    return float(
+        np.mean(np.argmax(tl[:, gen], -1) == np.argmax(dl[:, gen], -1))
+    )
+
+
 def distill(
     *,
     seed: int = 0,
@@ -137,54 +173,97 @@ def distill(
     out: str = "",
     log_every: int = 50,
     data_seed: int = 1234,
+    features: bool = False,
+    feat_weight: float = 1.0,
+    feat_noise: float = 0.2,
+    self_cond: float = 0.0,
+    draft_ffn: int = 0,
 ) -> dict:
-    """Distill the seed-shared truncation draft against its target; returns
-    the report dict (accept proxy before/after, final KL) and writes the
-    checkpoint to ``out`` when set."""
+    """Distill a draft against its target; returns the report dict (accept
+    proxy before/after, final KL) and writes the checkpoint to ``out``
+    when set.
+
+    ``features=False`` (default) trains the seed-shared layer-truncation
+    draft (PR 8's recipe). ``features=True`` trains the EAGLE-style
+    feature HEAD instead (models/decoder.init_feature_draft): the teacher
+    supplies per-position hidden states beside its logits
+    (``sequence_hidden``), the head runs teacher-forced on them, and the
+    loss adds ``feat_weight`` x feature-regression MSE to the KL
+    (training/steps.make_feature_distill_step) so the head's feature
+    autoregression stays anchored; ``feat_noise`` perturbs the input
+    features during training (the EAGLE augmentation for serving-time
+    feature drift at depth — measured: without it deep-node accept
+    collapses and the tree ride LOSES to the token draft). ``draft_ffn``
+    sizes the head's FFN (0 = the target's ``ffn``)."""
     import jax.numpy as jnp
     import optax
 
-    from seldon_core_tpu.models.decoder import generate, init_decoder, sequence_logits
-    from seldon_core_tpu.training.steps import init_state, make_distill_step
+    from seldon_core_tpu.models.decoder import (
+        generate, init_decoder, init_feature_draft, sequence_hidden,
+        sequence_logits,
+    )
+    from seldon_core_tpu.training.steps import (
+        init_state, make_distill_step, make_feature_distill_step,
+    )
 
     target = init_decoder(
         seed, vocab=vocab, hidden=hidden, layers=layers, ffn=ffn,
         max_len=max_len, resid_scale=resid_scale,
     )
-    draft = init_decoder(
-        seed, vocab=vocab, hidden=hidden, layers=draft_layers, ffn=ffn,
-        max_len=max_len, resid_scale=resid_scale,
-    )
+    if features:
+        draft = init_feature_draft(
+            seed, vocab=vocab, hidden=hidden, ffn=draft_ffn or ffn, max_len=max_len
+        )
+        proxy = greedy_accept_proxy_features
+    else:
+        draft = init_decoder(
+            seed, vocab=vocab, hidden=hidden, layers=draft_layers, ffn=ffn,
+            max_len=max_len, resid_scale=resid_scale,
+        )
+        proxy = greedy_accept_proxy
 
     rng = np.random.default_rng(data_seed)
     eval_ids = rng.integers(0, vocab, (eval_prompts, seq)).astype(np.int32)
-    accept_before = greedy_accept_proxy(target, draft, eval_ids, horizon - seq)
+    accept_before = proxy(target, draft, eval_ids, horizon - seq)
 
     import jax
 
     opt = optax.adam(lr)
-    teacher = jax.jit(lambda ids: sequence_logits(target, ids))
-    step = jax.jit(make_distill_step(sequence_logits, opt, teacher_temp))
+    if features:
+        teacher = jax.jit(lambda ids: sequence_hidden(target, ids))
+        step = jax.jit(
+            make_feature_distill_step(
+                opt, teacher_temp, feat_weight, feat_noise, self_cond
+            )
+        )
+    else:
+        teacher = jax.jit(lambda ids: (sequence_logits(target, ids), None))
+        step = jax.jit(make_distill_step(sequence_logits, opt, teacher_temp))
     state = init_state(draft, opt)
 
     # on-policy pool: target-greedy continuations of random prompts,
     # regenerated sparsely (they are the expensive half of the data).
-    # The teacher is FROZEN, so pool rows' logits are computed once per
-    # refresh and gathered per step — recomputing them every step would
-    # spend ~half the teacher forward cost on targets that cannot change.
+    # The teacher is FROZEN, so pool rows' logits (and, in feature mode,
+    # hidden states) are computed once per refresh and gathered per step —
+    # recomputing them every step would spend ~half the teacher forward
+    # cost on targets that cannot change.
+    def _teach(ids):
+        t, f = teacher(jnp.asarray(ids))
+        return np.asarray(t), (np.asarray(f) if f is not None else None)
+
     def on_policy_batch(n):
         p = rng.integers(0, vocab, (n, seq)).astype(np.int32)
         ids = np.asarray(generate(target, jnp.asarray(p), horizon - seq))
-        return ids, np.asarray(teacher(jnp.asarray(ids)))
+        return (ids,) + _teach(ids)
 
-    pool, pool_t = on_policy_batch(max(batch * 4, 32))
-    kl = agree = float("nan")
+    pool, pool_t, pool_f = on_policy_batch(max(batch * 4, 32))
+    kl = agree = fmse = float("nan")
     history = []
     for i in range(steps):
         n_on = int(round(batch * on_policy_frac))
         idx = rng.integers(0, len(pool), n_on) if n_on else None
         rand = rng.integers(0, vocab, (batch - n_on, horizon)).astype(np.int32)
-        rand_t = np.asarray(teacher(jnp.asarray(rand))) if len(rand) else None
+        rand_t, rand_f = _teach(rand) if len(rand) else (None, None)
         if idx is not None:
             ids = np.concatenate([pool[idx], rand])
             t = (
@@ -192,27 +271,45 @@ def distill(
                 if rand_t is not None
                 else pool_t[idx]
             )
+            f = None
+            if features:
+                f = (
+                    np.concatenate([pool_f[idx], rand_f])
+                    if rand_f is not None
+                    else pool_f[idx]
+                )
         else:
-            ids, t = rand, rand_t
-        state, m = step(state, {"x": jnp.asarray(ids), "t": jnp.asarray(t)})
+            ids, t, f = rand, rand_t, rand_f
+        batch_d = {"x": jnp.asarray(ids), "t": jnp.asarray(t)}
+        if features:
+            batch_d["f"] = jnp.asarray(f)
+        state, m = step(state, batch_d)
         kl, agree = float(m["kl"]), float(m["top1_agreement"])
+        if features:
+            fmse = float(m["feat_mse"])
         if log_every and (i + 1) % log_every == 0:
-            history.append({"step": i + 1, "kl": round(kl, 4),
-                            "top1": round(agree, 4)})
-            print(f"step {i+1:5d}  kl {kl:.4f}  top1 {agree:.4f}", flush=True)
+            row = {"step": i + 1, "kl": round(kl, 4), "top1": round(agree, 4)}
+            line = f"step {i+1:5d}  kl {kl:.4f}  top1 {agree:.4f}"
+            if features:
+                row["feat_mse"] = round(fmse, 4)
+                line += f"  fmse {fmse:.4f}"
+            history.append(row)
+            print(line, flush=True)
         if (i + 1) % max(1, steps // 4) == 0:
-            pool, pool_t = on_policy_batch(len(pool))  # refresh as the draft moves
+            # refresh as the draft moves
+            pool, pool_t, pool_f = on_policy_batch(len(pool))
 
     distilled = jax.tree.map(np.asarray, state.params)
-    accept_after = greedy_accept_proxy(target, distilled, eval_ids, horizon - seq)
+    accept_after = proxy(target, distilled, eval_ids, horizon - seq)
     if out:
         save_draft_checkpoint(out, distilled)
-    return {
+    report = {
         "accept_proxy_before": round(accept_before, 4),
         "accept_proxy_after": round(accept_after, 4),
         "final_kl": round(kl, 4),
         "final_top1": round(agree, 4),
         "steps": steps,
+        "features": bool(features),
         "history": history,
         "checkpoint": out or None,
         "geometry": {
@@ -221,6 +318,10 @@ def distill(
             "draft_layers": draft_layers,
         },
     }
+    if features:
+        report["final_feat_mse"] = round(fmse, 4)
+        report["geometry"]["draft_ffn"] = draft_ffn or ffn
+    return report
 
 
 def main(argv=None) -> None:
@@ -252,6 +353,32 @@ def main(argv=None) -> None:
     )
     ap.add_argument("--out", default="", help="checkpoint path (.npz)")
     ap.add_argument("--log-every", type=int, default=50)
+    ap.add_argument(
+        "--features", action="store_true",
+        help="train the EAGLE-style feature draft HEAD (target-hidden + "
+        "token-embedding input) instead of the layer-truncation draft; "
+        "serve via zoo://draft?features=1&distilled=...",
+    )
+    ap.add_argument(
+        "--feat-weight", type=float, default=1.0,
+        help="feature-regression MSE weight beside the KL (features mode)",
+    )
+    ap.add_argument(
+        "--feat-noise", type=float, default=0.2,
+        help="input-feature noise std fraction during training (features "
+        "mode) — the EAGLE drift augmentation; 0 disables",
+    )
+    ap.add_argument(
+        "--self-cond", type=float, default=0.0,
+        help="weight of a self-conditioned second pass (features mode) — "
+        "scheduled sampling in feature space. Ships DISABLED: on the "
+        "bench pair it traded away depth-1 accuracy for less deep-drift "
+        "than the noise augmentation already buys (PARITY r16)",
+    )
+    ap.add_argument(
+        "--draft-ffn", type=int, default=0,
+        help="feature head FFN width (0 = the target's --ffn)",
+    )
     args = ap.parse_args(argv)
     report = distill(
         seed=args.seed, vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -261,6 +388,9 @@ def main(argv=None) -> None:
         teacher_temp=args.teacher_temp,
         on_policy_frac=args.on_policy_frac, out=args.out,
         log_every=args.log_every,
+        features=args.features, feat_weight=args.feat_weight,
+        feat_noise=args.feat_noise, self_cond=args.self_cond,
+        draft_ffn=args.draft_ffn,
     )
     print(json.dumps(report))
 

@@ -139,6 +139,90 @@ def make_distill_step(
     return step
 
 
+def make_feature_distill_step(
+    optimizer: optax.GradientTransformation,
+    teacher_temp: float = 1.0,
+    feat_weight: float = 1.0,
+    feat_noise: float = 0.0,
+    self_cond: float = 0.0,
+):
+    """KL + feature-regression distillation for the EAGLE-style feature
+    draft head (models/decoder.init_feature_draft): batch = {"x": token
+    ids [b, s], "t": teacher-forced TEACHER logits [b, s, vocab], "f":
+    the teacher's final-layer hidden [b, s, d] (models/decoder.
+    sequence_hidden)}. The head runs teacher-forced on the TRUE features
+    (feature_sequence_logits — exactly the serving root step's
+    conditioning); the loss is the same teacher-temp-sharpened KL as the
+    token recipe PLUS ``feat_weight`` x MSE between the head's output
+    hidden and the teacher's next feature — the regression that keeps the
+    head's feature AUTOREGRESSION (deeper tree nodes feed on the head's
+    own output) anchored to the target's feature manifold, per EAGLE.
+
+    Two augmentations close the serving-time gap where deeper tree nodes
+    consume the head's own APPROXIMATE features instead of the
+    teacher-forced truth (without them the head overfits exact features
+    and its accept collapses past depth 2 — measured): ``feat_noise``
+    perturbs the INPUT features with Gaussian noise scaled by the batch's
+    feature std (the regression target stays clean, the EAGLE noise
+    trick), and ``self_cond`` adds a SECOND, self-conditioned forward —
+    the same batch with the head's own stop-gradient feature estimates as
+    inputs (scheduled sampling in feature space: exactly the depth-2
+    conditioning the serving expansion runs) — whose KL+regression rides
+    the loss at that weight. Metrics: kl, top1_agreement (the greedy
+    accept proxy), feat_mse — both from the teacher-forced pass."""
+    from seldon_core_tpu.models.decoder import feature_sequence_logits
+
+    def step(state: TrainState, batch: Mapping[str, jax.Array]):
+        f_in = batch["f"]
+        if feat_noise > 0.0:
+            key = jax.random.fold_in(jax.random.key(7), state.step)
+            f_in = f_in + (
+                feat_noise
+                * jnp.std(f_in)
+                * jax.random.normal(key, f_in.shape, jnp.float32)
+            ).astype(f_in.dtype)
+
+        def loss_fn(p):
+            logits, head_feats = feature_sequence_logits(p, batch["x"], f_in)
+            kl = kl_from_teacher(batch["t"], logits, teacher_temp)
+            fmse = jnp.mean(
+                (head_feats.astype(jnp.float32) - batch["f"].astype(jnp.float32))
+                ** 2
+            )
+            agree = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == jnp.argmax(batch["t"], axis=-1))
+                .astype(jnp.float32)
+            )
+            loss = kl + feat_weight * fmse
+            if self_cond > 0.0:
+                f_self = jax.lax.stop_gradient(head_feats)
+                logits2, head_feats2 = feature_sequence_logits(
+                    p, batch["x"], f_self
+                )
+                kl2 = kl_from_teacher(batch["t"], logits2, teacher_temp)
+                fmse2 = jnp.mean(
+                    (
+                        head_feats2.astype(jnp.float32)
+                        - batch["f"].astype(jnp.float32)
+                    )
+                    ** 2
+                )
+                loss = loss + self_cond * (kl2 + feat_weight * fmse2)
+            return loss, (kl, fmse, agree)
+
+        (_, (kl, fmse, agree)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1),
+            {"kl": kl, "top1_agreement": agree, "feat_mse": fmse},
+        )
+
+    return step
+
+
 def shard_state(
     state: TrainState, mesh: Mesh, param_pspecs: Any | None
 ) -> tuple[TrainState, Any]:

@@ -251,9 +251,21 @@ def worst_case_full_record() -> dict:
                 "spec_dispatches": 66, "tokens_per_sec_raw": 448.6,
                 "tokens_per_sec_rtt": 63.4,
             },
+            "fdistill": {
+                "accept_proxy_before": 0.0, "accept_proxy_after": 0.5391,
+                "final_kl": 0.012,
+            },
+            "ftree": {
+                "dispatches": 78, "recompiles_after_warmup": 0,
+                "accept_rate": 0.641, "tokens_per_ride": 3.52,
+                "spec_dispatches": 61, "tokens_per_sec_raw": 402.1,
+                "tokens_per_sec_rtt": 67.9,
+            },
             "outputs_identical": True,
             "tokens_per_ride_vs_chain": 1.35,
             "rtt_speedup_vs_chain": 1.08,
+            "ftree_ride_vs_tree": 1.1,
+            "ftree_rtt_speedup_vs_tree": 1.07,
         },
         "tokens_per_sec_speedup": 2.64,
         "spec_tokens_per_sec_speedup": 1.71,
@@ -350,12 +362,14 @@ def test_compact_record_carries_every_headline():
         "scan_p50": 3279.11,
         "occ": 0.893,
         "recompiles": 0,
-        "slots": 8,
         # flight-recorder sub-leg, packed to fit the byte budget:
-        # [bubble_fraction, occupancy, record_us] + the top-3 gap-phase
-        # fractions (host-bubble attribution; recorded, not gated)
+        # [bubble_fraction, occupancy, record_us] + the TOP gap-phase
+        # fraction (host-bubble attribution; recorded, not gated; was
+        # top-2 until the gen.ftree_* pack needed the bytes — the PR 14
+        # trim also dropped the config-only slots/spec_k/paged_budget and
+        # the ungated prefix_saved)
         "loop": [0.313, 0.891, 4.8],
-        "loop_ph": {"admit": 0.132, "alloc": 0.113},
+        "loop_ph": {"admit": 0.132},
         # pipelined-vs-serial A/B, packed [tok_s_serial, bubble_serial,
         # overlap_of_gap] — the pipelined side IS gen.tok_s/gen.loop[0];
         # position 2 is --compare-gated (identity contract in the full
@@ -365,16 +379,13 @@ def test_compact_record_carries_every_headline():
         "accept_rate": 0.941,
         "tok_disp": 4.31,
         "spec_spd": 1.71,
-        "spec_k": 4,
-        # prefix-cache sub-leg: cold/warm TTFT split, hit rate, prefill
-        # tokens displaced, tokens/s + ITL with chunking off/on
-        # (short names since PR 11's byte-budget trim; full names in the
-        # detail record)
+        # prefix-cache sub-leg: cold/warm TTFT split, hit rate, tokens/s
+        # + ITL with chunking off/on (short names since PR 11's
+        # byte-budget trim; full names in the detail record)
         "prefix_cold": 171.33,
         "prefix_warm": 41.27,
         "prefix_spd": 4.15,
         "prefix_hit": 0.958,
-        "prefix_saved": 1288,
         "prefix_tok_s": 1411.02,
         "prefix_tok_s_ck": 1389.77,
         "prefix_itl": 44.91,
@@ -386,6 +397,12 @@ def test_compact_record_carries_every_headline():
         "tree_tok_s": [63.4, 58.8],
         "tree_ride": [3.21, 2.37],
         "tree_spd": 1.08,
+        # feature-draft twin (EAGLE-style head) at the same 2-dispatch
+        # round: RTT tokens/s, per-slot ride, non-probe accept rate —
+        # ftree_tok_s and ftree_ride are --compare-gated
+        "ftree_tok_s": 67.9,
+        "ftree_ride": 3.52,
+        "ftree_acc": 0.641,
         # tensor-parallel sub-leg: tokens/s per width (width order), the
         # widest leg's speedup + identity contract, recompiles all-zero
         "tp_w": [1, 2, 4],

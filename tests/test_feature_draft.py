@@ -1,0 +1,399 @@
+"""Feature-level (EAGLE-style) drafting: the head model, its serving
+integration, the accept-driven tree auto-tuner's flight exposure, and the
+distillation CLI.
+
+The load-bearing invariants:
+
+- the head's param stream shares the target's leading draws (embeddings +
+  layer) — the same positional-rng trick the truncation draft rides;
+- the teacher-forced head forward shifts features by one (input j fuses
+  feature j-1 with token j; feature -1 = zeros);
+- the scheduler's feature rounds stay greedy bit-identical to the plain
+  scheduler and the fused scan oracle for ANY head (trained or not), cold
+  and prefix-warm, serial and pipelined, and never recompile on mixed
+  plain/spec traffic — the acceptance rule, not the draft, owns
+  correctness;
+- a chain-only config (decode_spec_k without decode_spec_tree) promotes
+  to the branching-1 tree and rides the same programs;
+- probe rounds are tagged in flight frames and excluded from the
+  recorder's accept-rate summaries;
+- the distillation CLI round-trips through zoo://draft?features=1 and the
+  accept proxy improves over init.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.decoder import (
+    feature_sequence_logits,
+    generate,
+    init_decoder,
+    init_feature_draft,
+    is_feature_draft,
+    sequence_hidden,
+)
+from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
+
+SEQ = 8
+MAX_NEW = 10
+VOCAB = 128
+
+
+def _params(layers=2):
+    return init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=layers, ffn=128, max_len=64,
+        resid_scale=0.1,
+    )
+
+
+def _head(seed=3, ffn=128):
+    return init_feature_draft(seed=seed, vocab=VOCAB, hidden=64, ffn=ffn, max_len=64)
+
+
+def _prompts(n, seed=1):
+    return np.random.default_rng(seed).integers(0, VOCAB, (n, SEQ)).astype(np.int32)
+
+
+def _shared_prompts(n, shared=5, seed=2):
+    rng = np.random.default_rng(seed)
+    head = rng.integers(0, VOCAB, shared).astype(np.int32)
+    return np.stack(
+        [
+            np.concatenate([head, rng.integers(0, VOCAB, SEQ - shared)]).astype(
+                np.int32
+            )
+            for _ in range(n)
+        ]
+    )
+
+
+def _scheduler(params, n_slots=2, **kw) -> DecodeScheduler:
+    s = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=n_slots, **kw
+    )
+    s.warmup()
+    return s
+
+
+def _oracle(params, ids, max_new=MAX_NEW) -> np.ndarray:
+    return np.asarray(generate(params, jnp.asarray(ids), max_new))
+
+
+# ------------------------------------------------------------- head model
+
+
+def test_feature_head_shares_target_param_stream():
+    """Same seed/vocab/hidden/ffn => the head starts with the target's
+    embeddings, weight-tied LM head, and leading layer VERBATIM (the
+    positional-rng stream-sharing the truncation draft documents), with
+    ``fc`` drawn last so it never perturbs the shared prefix."""
+    t = _params()
+    h = _head()
+    assert is_feature_draft(h) and not is_feature_draft(t)
+    np.testing.assert_array_equal(h["tok_emb"], t["tok_emb"])
+    np.testing.assert_array_equal(h["pos_emb"], t["pos_emb"])
+    np.testing.assert_array_equal(
+        h["layers"][0]["qkv"]["w"], t["layers"][0]["qkv"]["w"]
+    )
+    np.testing.assert_array_equal(
+        h["layers"][0]["mlp_in"]["w"], t["layers"][0]["mlp_in"]["w"]
+    )
+    assert h["fc"]["w"].shape == (128, 64)
+
+
+def test_feature_sequence_logits_shift():
+    """Input j fuses feature j-1 with token j (feature -1 = zeros):
+    position 0's logits must be invariant to every feature row except
+    none (it sees only zeros), and position 1's must move when feature 0
+    moves but not when feature 1 does."""
+    t, h = _params(), _head()
+    ids = _prompts(2, seed=7)[:, :4]
+    _, tf = sequence_hidden(t, jnp.asarray(ids))
+    base, feats = feature_sequence_logits(h, jnp.asarray(ids), tf)
+    assert base.shape == (2, 4, VOCAB) and feats.shape == (2, 4, 64)
+    bumped = np.asarray(tf).copy()
+    bumped[:, 0] += 10.0  # feature 0 feeds positions >= 1
+    moved, _ = feature_sequence_logits(h, jnp.asarray(ids), jnp.asarray(bumped))
+    np.testing.assert_allclose(
+        np.asarray(base)[:, 0], np.asarray(moved)[:, 0], rtol=1e-5
+    )
+    assert not np.allclose(np.asarray(base)[:, 1], np.asarray(moved)[:, 1])
+    tail = np.asarray(tf).copy()
+    tail[:, -1] += 10.0  # the last feature feeds nothing in-sequence
+    same, _ = feature_sequence_logits(h, jnp.asarray(ids), jnp.asarray(tail))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(same), rtol=1e-5)
+
+
+# ----------------------------------------------------- scheduler equivalence
+
+
+async def test_feature_tree_greedy_bit_identical_to_plain_and_oracle():
+    """Greedy output with an UNTRAINED head (worst-case draft) matches the
+    plain scheduler and the fused oracle token-for-token, cold and
+    prefix-warm — the acceptance rule owns correctness for ANY draft."""
+    params, head = _params(), _head(seed=11)
+    ids = _shared_prompts(6)
+    oracle = _oracle(params, ids)
+    plain = _scheduler(params, prefix_slots=4, kv_page_size=4)
+    p_outs = await asyncio.gather(*(plain.submit(row) for row in ids[:3]))
+    p_outs += await asyncio.gather(*(plain.submit(row) for row in ids[3:]))
+    await plain.close()
+    sched = _scheduler(
+        params, draft_params=head, spec_tree="2,2,1", prefix_slots=4,
+        kv_page_size=4,
+    )
+    assert sched.feature_draft
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids[:3]))
+    outs += await asyncio.gather(*(sched.submit(row) for row in ids[3:]))
+    for o, p, row in zip(outs, p_outs, oracle):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(p))
+        np.testing.assert_array_equal(np.asarray(o), row)
+    assert sched.stat_spec_dispatches > 0
+    assert sched.stat_prefix_hits > 0  # the warm wave genuinely hit
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_feature_chain_promotion_and_compile_counts():
+    """decode_spec_k without decode_spec_tree promotes a feature draft to
+    the branching-1 tree (the chain IS that tree) — and the feature
+    program set replaces step/chunk/draft-admit in compile_counts."""
+    params, head = _params(), _head()
+    ids = _prompts(3, seed=5)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(params, draft_params=head, spec_k=3)
+    assert sched.feature_draft
+    assert sched.spec_tree is not None and sched.spec_tree.branching == (1, 1, 1)
+    counts = sched.compile_counts()
+    assert {"step_f", "chunk_f", "draft_feat", "ftree_verify", "copy"} <= set(
+        counts
+    )
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids))
+    for o, row in zip(outs, oracle):
+        np.testing.assert_array_equal(np.asarray(o), row)
+    assert sched.recompiles_since_warmup() == 0
+    await sched.close()
+
+
+async def test_feature_sampled_topk1_deterministic():
+    """temperature>0 with top_k=1 is argmax by construction: the sampled
+    acceptance path through the feature verify must reproduce greedy."""
+    params, head = _params(), _head()
+    ids = _prompts(2, seed=9)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(params, draft_params=head, spec_tree="2,1")
+    outs = await asyncio.gather(
+        *(sched.submit(row, temperature=0.7, top_k=1) for row in ids)
+    )
+    for o, row in zip(outs, oracle):
+        np.testing.assert_array_equal(np.asarray(o), row)
+    await sched.close()
+
+
+async def test_feature_mixed_plain_spec_zero_recompiles():
+    """An accept floor degrades the (untrained, ~0-accept) head to plain
+    rounds with periodic probes — plain, chunk, and feature-tree rounds
+    interleave on ONE warmed program set with zero recompiles, and probe
+    rounds are tagged in the flight frames while the health accept rate
+    excludes them."""
+    params, head = _params(), _head(seed=11)
+    ids = _prompts(6, seed=23)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(
+        params, draft_params=head, spec_tree="2,2,1", spec_accept_floor=0.6
+    )
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids[:3]))
+    outs += await asyncio.gather(*(sched.submit(row) for row in ids[3:]))
+    for o, row in zip(outs, oracle):
+        np.testing.assert_array_equal(np.asarray(o), row)
+    assert sched._adapt.rate < 0.6  # converged sub-floor
+    assert sched.recompiles_since_warmup() == 0
+    frames = sched.flight.snapshot()
+    probe_frames = [f for f in frames if f.probe]
+    assert sched._adapt.probes >= 1
+    assert probe_frames, "probe rounds must be tagged in the flight record"
+    health = sched.flight.health()
+    assert health["probe_rounds"] >= 1
+    assert health["spec"]["depth"] >= 0 and "accept_ewma" in health["spec"]
+    # tree rounds carry the width mask they ran under
+    assert any(f.spec_widths for f in frames if f.mode == "tree")
+    await sched.close()
+
+
+async def test_feature_tp2_agreement():
+    """Feature drafting composes with tensor-parallel decode: tp=2 output
+    matches the single-device scheduler and the oracle (hidden 256 — the
+    head axis must divide by the mesh width)."""
+    params = init_decoder(
+        seed=3, vocab=VOCAB, hidden=256, layers=2, ffn=512, max_len=64,
+        resid_scale=0.1,
+    )
+    head = init_feature_draft(seed=3, vocab=VOCAB, hidden=256, ffn=512, max_len=64)
+    ids = _prompts(2, seed=31)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(
+        params, draft_params=head, spec_tree="2,1", mesh_axes={"tp": 2},
+        kv_page_size=4,
+    )
+    assert sched.tp == 2 and sched.feature_draft
+    outs = await asyncio.gather(*(sched.submit(row) for row in ids))
+    for o, row in zip(outs, oracle):
+        np.testing.assert_array_equal(np.asarray(o), row)
+    assert sched.recompiles_since_warmup() == 0
+    assert sched.shard_audit()["components_audited"] >= 4
+    await sched.close()
+
+
+def test_feature_hidden_mismatch_rejected():
+    with pytest.raises(ValueError, match="feature draft hidden"):
+        DecodeScheduler(
+            _params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            draft_params=init_feature_draft(
+                seed=3, vocab=VOCAB, hidden=128, ffn=128, max_len=64
+            ),
+            spec_tree="2,1",
+        )
+
+
+def test_feature_chain_promotion_enforces_verify_width_cap():
+    """The chain->tree promotion must not bypass the widened-verify
+    headroom: an oversized decode_spec_k on a feature draft fails at
+    build, not at trace time (same contract as the token chain)."""
+    from seldon_core_tpu.models.spec_tree import MAX_TREE_NODES
+
+    with pytest.raises(ValueError, match="widened-verify"):
+        DecodeScheduler(
+            _params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            draft_params=_head(), spec_k=MAX_TREE_NODES + 1,
+        )
+
+
+# --------------------------------------------------------- serving wiring
+
+
+async def test_serving_feature_draft_wiring():
+    """TpuSpec decode_draft_model=zoo://draft?features=1 ->
+    scheduler_for_executor: the builder injects the target's hidden
+    beside vocab/max_len, detects the head layout, and the buffered
+    response matches the fused zoo apply."""
+    from seldon_core_tpu.core.message import SeldonMessage
+    from seldon_core_tpu.graph.spec import PredictorSpec
+    from seldon_core_tpu.models.zoo import get_model
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": str(SEQ), "type": "INT"},
+                    {"name": "max_new_tokens", "value": "6", "type": "INT"},
+                    {"name": "vocab", "value": str(VOCAB), "type": "INT"},
+                ],
+            },
+            "tpu": {
+                "max_batch": 4,
+                "batch_buckets": [4],
+                "decode_slots": 2,
+                "decode_draft_model": "zoo://draft?features=1",
+                "decode_spec_tree": "2,1",
+            },
+        }
+    )
+    server = PredictorServer(pred, deployment_name="fd")
+    sched = server.decode_scheduler
+    assert sched is not None and sched.feature_draft
+    assert sched.spec_tree is not None and sched.spec_tree.branching == (2, 1)
+    server.warmup()
+    try:
+        ids = _prompts(2, seed=41)
+        ms = get_model("tiny_gpt", seq=SEQ, max_new_tokens=6, vocab=VOCAB)
+        want = np.asarray(ms.apply_fn(ms.params, jnp.asarray(ids)))
+        out = await sched.execute_message(SeldonMessage.from_array(ids))
+        np.testing.assert_array_equal(np.asarray(out.array), want)
+        assert sched.recompiles_since_warmup() == 0
+    finally:
+        await sched.close()
+
+
+def test_zoo_feature_draft_builds_and_refuses_standalone():
+    from seldon_core_tpu.models.zoo import get_model
+
+    ms = get_model("draft", features=1, vocab=VOCAB, hidden=64, ffn=128, max_len=64)
+    assert is_feature_draft(ms.params)
+    with pytest.raises(ValueError, match="decode_draft_model"):
+        ms.apply_fn(ms.params, np.zeros((1, SEQ), np.int32))
+
+
+# ------------------------------------------------------- distillation CLI
+
+
+@pytest.mark.slow
+def test_distill_features_cli_smoke(tmp_path):
+    """The satellite contract: a tiny feature distillation through the
+    ``python -m`` CLI improves the accept proxy over init, and the
+    checkpoint round-trips through zoo://draft?features=1&distilled= into
+    a servable scheduler whose greedy output stays oracle-exact."""
+    ck = tmp_path / "feat.npz"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "seldon_core_tpu.training.distill_draft",
+            "--features", "--vocab", str(VOCAB), "--hidden", "64",
+            "--layers", "2", "--ffn", "128", "--max-len", "48",
+            "--seq", "8", "--horizon", "24", "--batch", "8",
+            "--steps", "30", "--log-every", "0", "--out", str(ck),
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["features"] is True
+    assert report["accept_proxy_after"] > report["accept_proxy_before"] + 0.1
+    assert ck.exists()
+
+    from seldon_core_tpu.models.zoo import get_model
+
+    ms = get_model(
+        "draft", features=1, vocab=VOCAB, hidden=64, ffn=128, max_len=48,
+        distilled=str(ck),
+    )
+    assert is_feature_draft(ms.params)
+    # the checkpoint genuinely refilled the weights (fc moved off init)
+    assert not np.array_equal(
+        ms.params["fc"]["w"],
+        init_feature_draft(seed=0, vocab=VOCAB, hidden=64, ffn=128, max_len=48)[
+            "fc"
+        ]["w"],
+    )
+
+    async def serve():
+        target = init_decoder(
+            seed=0, vocab=VOCAB, hidden=64, layers=2, ffn=128, max_len=48
+        )
+        ids = _prompts(2, seed=13)
+        oracle = np.asarray(generate(target, jnp.asarray(ids), MAX_NEW))
+        s = DecodeScheduler(
+            target, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            draft_params=ms.params, spec_tree="2,1",
+        )
+        s.warmup()
+        outs = await asyncio.gather(*(s.submit(row) for row in ids))
+        for o, row in zip(outs, oracle):
+            np.testing.assert_array_equal(np.asarray(o), row)
+        assert s.stat_spec_accepted > 0  # the distilled head genuinely accepts
+        await s.close()
+
+    asyncio.run(serve())

@@ -107,6 +107,38 @@ def test_aggregate_math_on_synthetic_frames():
     assert off.rounds == 0 and off.snapshot() == []
 
 
+def test_probe_rounds_excluded_from_accept_summaries():
+    """PR 14: probe rounds (the controller's deliberate exploration —
+    depth-1 recovery probes, full-shape width probes) are tagged in the
+    frame, counted apart, and EXCLUDED from accept_rate in aggregate()
+    and health() — probes accept badly by design and must not read as
+    genuine degradation. Their own accept rides probe_accept_rate."""
+    rec = FlightRecorder(n_slots=4, name="t", capacity=64, enabled=True)
+    # 4 genuine spec rounds at accept 3/4, 2 probes at accept 0/1
+    for i in range(4):
+        rec.record(_frame(i, mode="tree", accepted=3, proposed=4, spec_depth=4,
+                          spec_widths=(2, 2, 1, 1)))
+    for i in range(4, 6):
+        rec.record(_frame(i, mode="tree", accepted=0, proposed=1, spec_depth=1,
+                          probe=True))
+    agg = rec.aggregate()
+    assert agg["accept_rate"] == 0.75  # 12/16, probes excluded
+    assert agg["probe_rounds"] == 2
+    assert agg["probe_accept_rate"] == 0.0
+    health = rec.health()
+    assert health["accept_rate"] == 0.75
+    assert health["probe_rounds"] == 2
+    # frames carry the tag + the tuned width mask for dump readability
+    d_probe = rec.snapshot(1)[0].to_dict()
+    assert d_probe["probe"] is True
+    d_spec = rec.snapshot()[0].to_dict()
+    assert d_spec["widths"] == [2, 2, 1, 1] and "probe" not in d_spec
+    # spec_state (set by the scheduler's commit point) surfaces in health
+    rec.spec_state = {"tree": "2,2,1,1", "widths": [2, 2, 1, 0],
+                      "accept_ewma": 0.71, "depth": 3, "probes": 2}
+    assert rec.health()["spec"]["widths"] == [2, 2, 1, 0]
+
+
 def test_env_kill_switch(monkeypatch):
     monkeypatch.setenv(flight_mod.ENGINE_FLIGHT, "off")
     assert not flight_mod.flight_enabled()

@@ -189,14 +189,14 @@ def test_paged_blocks_match_flat_chunk_and_decode_logits():
     counts = np.zeros(n_slots, np.int32)
     counts[slot] = SEQ
     fl, ck, cv = chunk_prefill(params, ck, cv, jnp.asarray(toks), jnp.asarray(zero), jnp.asarray(counts))
-    pl, pool = paged_chunk_prefill(params, pool, jnp.asarray(bt), jnp.asarray(toks), jnp.asarray(zero), jnp.asarray(counts))
+    pl, _, pool = paged_chunk_prefill(params, pool, jnp.asarray(bt), jnp.asarray(toks), jnp.asarray(zero), jnp.asarray(counts))
     np.testing.assert_array_equal(np.asarray(fl[slot]), np.asarray(pl[slot]))
     tok = int(np.argmax(np.asarray(pl[slot, SEQ - 1])))
     t1 = np.zeros(n_slots, np.int32)
     p1 = np.zeros(n_slots, np.int32)
     t1[slot], p1[slot] = tok, SEQ
     fl, ck, cv = decode_step(params, ck, cv, jnp.asarray(t1), jnp.asarray(p1))
-    pl, pool = paged_decode_step(params, pool, jnp.asarray(bt), jnp.asarray(t1), jnp.asarray(p1))
+    pl, _, pool = paged_decode_step(params, pool, jnp.asarray(bt), jnp.asarray(t1), jnp.asarray(p1))
     np.testing.assert_array_equal(np.asarray(fl[slot]), np.asarray(pl[slot]))
     # junk writes from the free slots above landed only in page 0
     for other in range(n_slots):
@@ -206,7 +206,7 @@ def test_paged_blocks_match_flat_chunk_and_decode_logits():
     q[slot] = [int(np.argmax(np.asarray(pl[slot]))), 4, 7]
     p1[slot] = SEQ + 1
     fvl, _, _ = verify_step(params, ck, cv, jnp.asarray(q), jnp.asarray(p1))
-    pvl, _ = paged_verify_step(params, pool, jnp.asarray(bt), jnp.asarray(q), jnp.asarray(p1))
+    pvl, _, _ = paged_verify_step(params, pool, jnp.asarray(bt), jnp.asarray(q), jnp.asarray(p1))
     # the widened verify reduces over the page-rounded virtual length (20)
     # vs the flat cache's exact one (18): XLA groups the reduction lanes
     # differently, so this comparison is reduction-order-tight, not
@@ -330,7 +330,7 @@ def test_int8_kv_teacher_forced_logit_parity():
     zero = np.zeros(1, np.int32)
     logit_stream = {}
     for name in pools:
-        lg, pools[name] = paged_chunk_prefill(
+        lg, _, pools[name] = paged_chunk_prefill(
             params, pools[name], jnp.asarray(bt), jnp.asarray(toks),
             jnp.asarray(zero), jnp.asarray(counts),
         )
@@ -340,7 +340,7 @@ def test_int8_kv_teacher_forced_logit_parity():
         t1 = np.array([tok], np.int32)
         p1 = np.array([SEQ + i], np.int32)
         for name in pools:
-            lg, pools[name] = paged_decode_step(
+            lg, _, pools[name] = paged_decode_step(
                 params, pools[name], jnp.asarray(bt), jnp.asarray(t1), jnp.asarray(p1)
             )
             logit_stream[name].append(np.asarray(lg[0]))

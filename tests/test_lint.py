@@ -671,6 +671,68 @@ class Helper:
     assert lint_sources({"m.py": src}) == []
 
 
+def test_ladder_covers_feature_draft_programs():
+    """The feature-draft program set (PR 14: _draft_feat_fn /
+    _ftree_verify_fn / _chunk_f_fn / _step_f_fn) is held by the same LC
+    contract as every fused handle: a feature scheduler whose warmup
+    skips the round pair — or whose compile_counts omits it — is flagged;
+    the faithful shape (warmup exercises the full set, compile_counts
+    reports it) is clean. Pins the pass against a regression where a new
+    feature program sneaks past the ladder because its dispatch hides in
+    a mode branch."""
+    bad = """
+class FeatSched:
+    def warmup(self):
+        for c in self.chunk_buckets:
+            self._chunk_f_fn(c)
+        self._step_f_fn(0)
+        # the feature round pair is NOT warmed: first live spec round
+        # would pay both XLA compiles
+
+    def compile_counts(self):
+        return {
+            "step_f": self._step_f_fn._cache_size(),
+            "chunk_f": self._chunk_f_fn._cache_size(),
+        }
+
+    def run(self):
+        node = self._draft_feat_fn(0)
+        return self._ftree_verify_fn(node)
+"""
+    findings = lint_sources({"m.py": bad})
+    assert rules_of(findings) == {"LC001", "LC002"}
+    flagged = {f.symbol for f in findings}
+    assert "FeatSched._draft_feat_fn" in flagged
+    assert "FeatSched._ftree_verify_fn" in flagged
+
+    clean = """
+class FeatSched:
+    def warmup(self):
+        for c in self.chunk_buckets:
+            self._chunk_f_fn(c)
+        self._step_f_fn(0)
+        node = self._draft_feat_fn(0)
+        self._ftree_verify_fn(node)
+
+    def compile_counts(self):
+        return {
+            "step_f": self._step_f_fn._cache_size(),
+            "chunk_f": self._chunk_f_fn._cache_size(),
+            "draft_feat": self._draft_feat_fn._cache_size(),
+            "ftree_verify": self._ftree_verify_fn._cache_size(),
+        }
+
+    def run(self):
+        node = self._draft_feat_fn(0)
+        return self._ftree_verify_fn(node), self._step_f_fn(0)
+
+    def chunk(self):
+        b = next(b for b in self.chunk_buckets if b)
+        return self._chunk_f_fn(b)
+"""
+    assert lint_sources({"m.py": clean}) == []
+
+
 # ------------------------------------------------------- suppression/baseline
 def test_inline_suppression_semantics():
     line = 'import os\nX = os.environ.get("ENGINE_FLIGHT", "on")'

@@ -33,7 +33,7 @@ from seldon_core_tpu.models.spec_tree import (
     SpecTree,
     parse_spec_tree,
 )
-from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler, _SpecAdapt
+from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler, _TreeAutoTuner
 
 SEQ = 8
 MAX_NEW = 10
@@ -137,6 +137,15 @@ def test_spec_tree_tighten_only():
     assert t.tighten((0,)) == (0, 0, 0)  # full opt-out
 
 
+def test_spec_tree_nodes_for_widths():
+    t = SpecTree.from_text("4,3,2,1")
+    assert t.nodes_for_widths(t.branching) == t.n_tree
+    assert t.nodes_for_widths((2, 2, 1, 1)) == 2 + 4 + 4 + 4
+    assert t.nodes_for_widths((4, 3, 0, 1)) == 4 + 12  # 0 truncates below
+    assert t.nodes_for_widths((9,)) == 4  # clamped to branching, depth cut
+    assert t.nodes_for_widths(()) == 0
+
+
 # ---------------------------------------- tree verify vs sequential decode
 
 
@@ -163,7 +172,7 @@ def test_tree_verify_logits_match_sequential_paged_decode():
     zero = np.zeros(n_slots, np.int32)
     counts = np.zeros(n_slots, np.int32)
     counts[slot] = SEQ
-    pl, pool = paged_chunk_prefill(
+    pl, _, pool = paged_chunk_prefill(
         params, pool, jnp.asarray(bt), jnp.asarray(toks), jnp.asarray(zero),
         jnp.asarray(counts),
     )
@@ -175,7 +184,7 @@ def test_tree_verify_logits_match_sequential_paged_decode():
     queries[slot] = np.concatenate([[root_tok], node_toks])
     pos = np.zeros(n_slots, np.int32)
     pos[slot] = SEQ
-    logits, _, _ = paged_tree_verify(
+    logits, _, _, _ = paged_tree_verify(
         params, pool, jnp.asarray(bt), jnp.asarray(queries), jnp.asarray(pos), tree
     )
     logits = np.asarray(logits)[slot]
@@ -192,7 +201,7 @@ def test_tree_verify_logits_match_sequential_paged_decode():
             p1 = np.zeros(n_slots, np.int32)
             t1[slot] = queries[slot, b]
             p1[slot] = SEQ + d
-            lg, seq_pool = paged_decode_step(
+            lg, _, seq_pool = paged_decode_step(
                 params, seq_pool, jnp.asarray(bt), jnp.asarray(t1), jnp.asarray(p1)
             )
         np.testing.assert_allclose(
@@ -469,12 +478,13 @@ async def test_tree_tp2_int8_prefix_warm_agreement():
 
 
 def test_spec_adapt_unit():
-    """The controller in isolation: floor 0 pins the ceiling; the depth
+    """The depth controller in isolation (the _TreeAutoTuner keeps the
+    _SpecAdapt policy verbatim): floor 0 pins the ceiling; the depth
     never exceeds the ceiling at ANY rate; a sub-floor rate degrades to
     plain (0) with a periodic depth-1 probe; good probes recover."""
-    a = _SpecAdapt(0.0, 4)
+    a = _TreeAutoTuner(0.0, 4)
     assert a.depth() == 4  # disabled -> fixed shape
-    a = _SpecAdapt(0.5, 4, alpha=0.5, probe_every=3)
+    a = _TreeAutoTuner(0.5, 4, alpha=0.5, probe_every=3)
     assert a.depth() == 4  # optimistic start
     for _ in range(8):
         a.update(0, 4)  # nothing accepted
@@ -485,6 +495,53 @@ def test_spec_adapt_unit():
     assert a.depth() == 4  # recovered to the ceiling
     a.rate = 10.0  # adversarial estimate: still clamped
     assert a.depth() <= 4
+
+
+def test_tree_autotuner_widths():
+    """The width half of the auto-tuner: floor <= 0 disables (None =
+    configured shape); widths NEVER exceed the configured branching; a
+    depth paths rarely reach narrows toward 1 and is eventually cut;
+    while narrowed, a periodic full-shape probe round is flagged; a
+    recovering workload re-widens."""
+    tree = SpecTree.from_text("4,3,2")
+    a = _TreeAutoTuner(0.0, tree.depth, tree)
+    assert a.widths() is None  # adaptation off -> configured shape
+
+    a = _TreeAutoTuner(0.3, tree.depth, tree, alpha=0.5, probe_every=4)
+    d, w, probe = a.decide()
+    assert w == tree.branching and not probe  # optimistic start
+    # paths always die at depth 1: depth 2/3 nodes are never reached
+    for _ in range(24):
+        a.update(4, 8, paths=[(1, 3), (1, 3)])
+    d, w, probe = a.decide()
+    assert d >= 1
+    assert all(wi <= bi for wi, bi in zip(w, tree.branching))
+    assert w[0] == tree.branching[0]  # depth 1 is always reached
+    assert w[2] == 0  # the tail depth is cut once reach decays
+    # while narrowed, every probe_every-th spec round runs the full shape
+    probes = sum(1 for _ in range(8) if a.decide()[2])
+    assert probes >= 1
+    # recovery: full paths re-widen every depth
+    for _ in range(24):
+        a.update(8, 8, paths=[(3, 3), (3, 3)])
+    d, w, probe = a.decide()
+    assert w == tree.branching
+
+    # depth-0 (plain-degraded) rounds must not consume the width-probe
+    # cadence: a narrowed tuner pushed sub-floor returns (0, None, False)
+    # except for the depth controller's own depth-1 recovery probes, and
+    # the probe counter only moves for those
+    b = _TreeAutoTuner(0.5, tree.depth, tree, alpha=0.5, probe_every=4)
+    for _ in range(16):
+        b.update(0, 8, paths=[(0, 3), (0, 3)])  # nothing accepted, narrow
+    probes_before = b.probes
+    decisions = [b.decide() for _ in range(12)]
+    for d, w, probe in decisions:
+        if d == 0:
+            assert w is None and not probe
+        else:
+            assert d == 1 and probe  # the depth-1 recovery probe
+    assert b.probes - probes_before == sum(1 for d, _, p in decisions if p)
 
 
 async def test_adaptive_degrades_to_plain_under_low_accept_draft():
