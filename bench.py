@@ -1716,10 +1716,11 @@ def serving_gen_tp_cpu(widths: tuple = (1, 2, 4)) -> dict:
     }
 
 
-def gen_tp_subprocess() -> dict | None:
-    """Run the gen.tp_* sub-leg in a fresh process with XLA_FLAGS forcing
-    an 8-device host platform — the device count is fixed at backend init,
-    so the mesh widths under test need their own interpreter."""
+def _forced_device_subprocess(flag: str, label: str) -> dict | None:
+    """Re-run this bench with ``flag`` in a fresh interpreter under an
+    XLA_FLAGS-forced 8-device host platform (device count is fixed at
+    backend init, so legs that need their own device topology need their
+    own process) and parse the JSON line it prints."""
     env = dict(os.environ)
     here = os.path.dirname(os.path.abspath(__file__))
     existing = env.get("PYTHONPATH", "")
@@ -1732,7 +1733,7 @@ def gen_tp_subprocess() -> dict | None:
         ).strip()
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--gen-tp-only"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
             text=True,
             timeout=900,
@@ -1741,13 +1742,245 @@ def gen_tp_subprocess() -> dict | None:
         if out.returncode == 0:
             return json.loads(out.stdout.strip().splitlines()[-1])
         print(
-            f"gen-tp subprocess failed rc={out.returncode}: "
+            f"{label} subprocess failed rc={out.returncode}: "
             f"{out.stderr.strip()[-500:]}",
             file=sys.stderr,
         )
     except Exception as e:  # noqa: BLE001 - diagnostic only, bench continues
-        print(f"gen-tp subprocess failed: {e}", file=sys.stderr)
+        print(f"{label} subprocess failed: {e}", file=sys.stderr)
     return None
+
+
+def gen_tp_subprocess() -> dict | None:
+    """The gen.tp_* sub-leg in its own forced-8-device interpreter."""
+    return _forced_device_subprocess("--gen-tp-only", "gen-tp")
+
+
+def serving_gen_replicas_cpu() -> dict:
+    """gen.replica_*: multi-replica decode scale-out on the shared-prompt
+    geometry — 8 prefix GROUPS (each a distinct 56-token system prompt) x
+    16 requests arriving consecutively per group, seq 64, max_new 16, 4
+    slots per scheduler, every request declaring its reusable span. Three
+    legs:
+
+    - single:      one scheduler (the PR 5 prefix-cache baseline), pinned
+                   to one device via mesh {"data": 1},
+    - affinity:    2 replicas behind the prefix-affinity router — sharers
+                   land on the replica whose pool is warm for them, so the
+                   fleet-wide hit rate HOLDS near the single-replica level
+                   while the two dispatch streams run concurrently,
+    - round_robin: 2 replicas behind naive round-robin — the CONTROL leg:
+                   each group is split across both replicas, every replica
+                   pays its own cold capture, and the hit rate collapses
+                   by construction (recorded, not gated).
+
+    The contract under measurement: affinity holds prefix hit-rate within
+    5% of single-replica (asserted) with zero recompiles, and greedy
+    outputs are bit-identical across all three legs (routing only picks
+    WHICH warm pool serves a request). The 1.6x tokens/s scale-out floor
+    is judged (scale_floor_met) only on hosts with >= 2 cores — on a
+    1-core box both dispatch streams time-share the core and the ratio
+    measures overhead, recorded with `serialized_host: true`; the gated
+    gen.replica_spd enforces across rounds. Runs under the forced 8-device
+    host platform (gen_replicas_subprocess) so each replica's params/pool
+    land on their own forced device with its own XLA thread pool — the
+    in-process twin of one replica per chip."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from seldon_core_tpu.core.message import Meta, SeldonMessage
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    n_slots, vocab = 4, 512
+    p_seq, p_prefix, max_new = 64, 56, 16
+    # 8 distinct prefix groups: enough keys that rendezvous hashing spreads
+    # them across the fleet (4 keys over 2 arms routinely lands 3:1 — the
+    # classic too-few-keys consistent-hashing failure, not a router bug);
+    # 16 requests per group so the steady WARM state dominates the capture
+    # transient the contract is not about
+    n_groups, per_group = 8, 16
+    n_requests = n_groups * per_group
+    p_rng = np.random.default_rng(11)
+    group_prefixes = [
+        p_rng.integers(0, vocab, p_prefix).astype(np.int32) for _ in range(n_groups)
+    ]
+    # arrival order is CONSECUTIVE per group (group = i // per_group):
+    # round-robin then provably splits every group across both replicas
+    # (an interleaved layout with an even group stride would accidentally
+    # parity-align groups to replicas and fake affinity)
+    prompts = np.stack(
+        [
+            np.concatenate(
+                [
+                    group_prefixes[i // per_group],
+                    p_rng.integers(0, vocab, p_seq - p_prefix),
+                ]
+            ).astype(np.int32)
+            for i in range(n_requests)
+        ]
+    )
+
+    def _pred(replicas: int, policy: str):
+        tpu = {
+            "max_batch": n_slots,
+            "batch_buckets": [n_slots],
+            "batch_timeout_ms": 4.0,
+            "queue_timeout_ms": 120000.0,
+            # pin the DEPLOYMENT mesh to one device: on the forced
+            # 8-device host the defaulted data mesh replicates params (and
+            # so the baseline scheduler's pool) across all 8 devices, and
+            # every baseline dispatch would execute 8-way — a strawman.
+            # Fleet replicas place themselves (one replica = one device)
+            # regardless of the deployment mesh.
+            "mesh": {"data": 1},
+            "decode_slots": n_slots,
+            "decode_prefix_slots": 8,
+            "decode_prefill_chunk": 16,
+            "decode_kv_page_size": 16,
+            # explicit page budget with prefix-pin headroom: every request
+            # declares its reusable span, so the auto (flat-equivalent)
+            # budget would reclaim pins as fast as they capture
+            "decode_kv_pages": 1 + n_slots * 5 + n_groups * 4 + 3,
+        }
+        if replicas > 1:
+            tpu["decode_replicas"] = replicas
+            tpu["decode_router_policy"] = policy
+        return _graph_predictor(
+            {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": "64", "type": "INT"},
+                    {"name": "max_new_tokens", "value": str(max_new), "type": "INT"},
+                    {"name": "vocab", "value": str(vocab), "type": "INT"},
+                    {"name": "hidden", "value": "256", "type": "INT"},
+                    {"name": "layers", "value": "4", "type": "INT"},
+                    {"name": "ffn", "value": "1024", "type": "INT"},
+                    {"name": "max_len", "value": "80", "type": "INT"},
+                ],
+            },
+            tpu,
+        )
+
+    async def run_leg(replicas: int, policy: str):
+        server = PredictorServer(
+            _pred(replicas, policy), deployment_name=f"gen-rep-{policy or 'single'}"
+        )
+        server.warmup()
+        sched = server.decode_scheduler
+        t0 = time.perf_counter()
+
+        async def one(i: int):
+            # every request declares its reusable span (the documented
+            # shared-system-prompt client pattern: capture lands at
+            # prefill completion, so a shed group re-warms its overflow
+            # replica after ONE cold request). Each group's opener goes
+            # out ahead of its followers, group start times overlap so
+            # every dispatch stream stays busy throughout
+            tags = {"max_new_tokens": max_new, "cache_prefix": p_prefix}
+            g, k = divmod(i, per_group)
+            if k == 0:
+                await asyncio.sleep(g * 0.05)
+            else:
+                await asyncio.sleep(g * 0.05 + 0.3 + k * 0.005)
+            msg = SeldonMessage.from_array(prompts[i : i + 1], meta=Meta(tags=tags))
+            out = await server.service.predict(msg)
+            return np.asarray(out.array)[0]
+
+        outs = await asyncio.gather(*(one(i) for i in range(n_requests)))
+        elapsed = time.perf_counter() - t0
+        hits, misses = sched.stat_prefix_hits, sched.stat_prefix_misses
+        leg = {
+            "replicas": replicas,
+            "policy": policy or "single",
+            "tokens_per_sec": round(max_new * n_requests / elapsed, 2),
+            "hit_rate": round(hits / max(hits + misses, 1), 3),
+            "prefill_tokens_saved": sched.stat_prefix_tokens_saved,
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
+        if replicas > 1:
+            leg["routes"] = dict(sched.balancer.stat_routes)
+            sched.allocator_audits()  # per-replica pool consistency
+        else:
+            sched.pool.alloc.check()
+        await sched.close()
+        if server.batcher is not None:
+            await server.batcher.close()
+        return leg, np.stack(outs)
+
+    single, single_out = asyncio.run(run_leg(1, ""))
+    affinity, aff_out = asyncio.run(run_leg(2, "affinity"))
+    rr, rr_out = asyncio.run(run_leg(2, "round_robin"))
+    # greedy bit-identity across every leg: routing decides WHERE a request
+    # decodes, never WHAT it decodes
+    assert np.array_equal(single_out, aff_out), "affinity outputs diverged"
+    assert np.array_equal(single_out, rr_out), "round-robin outputs diverged"
+    # the affinity contract: fleet hit rate within 5% of single-replica
+    # (each group still pays exactly ONE cold capture per serving pool),
+    # regardless of the host's core budget
+    assert affinity["hit_rate"] >= single["hit_rate"] - 0.05, (
+        f"affinity hit rate {affinity['hit_rate']} collapsed vs single "
+        f"{single['hit_rate']}"
+    )
+    assert affinity["recompiles_after_warmup"] == 0, "replica fleet recompiled"
+    speedup = (
+        round(affinity["tokens_per_sec"] / single["tokens_per_sec"], 2)
+        if single["tokens_per_sec"]
+        else 0.0
+    )
+    host_cpus = os.cpu_count() or 1
+    # the scale-out floor: two dispatch streams should reach 1.6x one.
+    # Judged only when the host can physically run two streams (on a
+    # 1-core bench host both streams serialize and the ratio measures
+    # thread-hop overhead — the tp leg's tp_speedup caveat), and recorded
+    # rather than asserted: a host-dependent in-leg assert would drop the
+    # WHOLE leg (subprocess exits nonzero, record omits gen.replicas) and
+    # its compare gates would vanish silently — the gated gen.replica_spd
+    # is the enforcement with teeth across rounds.
+    scale_floor_met = None
+    if host_cpus >= 2:
+        scale_floor_met = speedup >= 1.6
+        if not scale_floor_met:
+            print(
+                f"gen.replicas: 2-replica affinity speedup {speedup} below "
+                f"the 1.6x floor on a {host_cpus}-core host (recorded; "
+                "gen.replica_spd gates it vs the prior round)",
+                file=sys.stderr,
+            )
+    return {
+        "scenario": {
+            "requests": n_requests,
+            "groups": n_groups,
+            "seq": p_seq,
+            "shared_prefix": p_prefix,
+            "max_new": max_new,
+            "n_slots_per_replica": n_slots,
+            "host_cpus": host_cpus,
+            "geometry": "paged+prefix, page_size 16, 2 replicas",
+        },
+        "single": single,
+        "affinity": affinity,
+        "round_robin": rr,
+        "affinity_speedup_vs_single": speedup,
+        # on a single-core host the two dispatch streams time-share the
+        # core: the speedup column is a serialized-host floor, not the
+        # scale-out number (which needs >= 2 cores or real devices) —
+        # scale_floor_met is then None (unjudgeable), not False
+        "serialized_host": host_cpus < 2,
+        "scale_floor_met": scale_floor_met,
+        "affinity_hit_delta": round(affinity["hit_rate"] - single["hit_rate"], 3),
+        "outputs_identical": True,
+    }
+
+
+def gen_replicas_subprocess() -> dict | None:
+    """The gen.replica_* sub-leg in its own forced-8-device interpreter:
+    each replica is placed on its own forced device, which carries its own
+    XLA thread pool — two replicas genuinely run two dispatch streams."""
+    return _forced_device_subprocess("--gen-replicas-only", "gen-replicas")
 
 
 def serving_moe_cpu(duration_s: float = 6.0) -> dict:
@@ -2324,12 +2557,10 @@ def compact_record(full: dict) -> dict:
             c["gen"]["tp_tok_s"] = [
                 (gt.get(f"tp{w}") or {}).get("tokens_per_sec") for w in widths
             ]
-            c["gen"]["tp_ttft"] = [
-                (gt.get(f"tp{w}") or {}).get("ttft_p50_ms") for w in widths
-            ]
-            c["gen"]["tp_itl"] = [
-                (gt.get(f"tp{w}") or {}).get("inter_token_p99_ms") for w in widths
-            ]
+            # (tp_ttft/tp_itl — per-width latency rows, never gated — left
+            # with PR 15's byte-budget trim paying for the gen.replica
+            # pack; the detail record keeps ttft_p50_ms/inter_token_p99_ms
+            # per width)
             wide = max((w for w in widths if w > 1), default=0)
             if wide:
                 c["gen"]["tp_speedup"] = (gt.get(f"tp{wide}") or {}).get(
@@ -2341,6 +2572,21 @@ def compact_record(full: dict) -> dict:
             c["gen"]["tp_rc"] = [
                 (gt.get(f"tp{w}") or {}).get("recompiles_after_warmup")
                 for w in widths
+            ]
+        grp = gen.get("replicas") or {}
+        if grp:
+            # multi-replica scale-out sub-leg, packed positionally (the
+            # gen.pipe precedent): [affinity tokens/s, speedup vs single,
+            # affinity hit rate, round-robin hit rate]. The first three
+            # are --compare-gated via the unpacked keys; the round-robin
+            # control is recorded to document the collapse; identity +
+            # serialized-host context live in the detail record.
+            aff = grp.get("affinity") or {}
+            c["gen"]["replica"] = [
+                aff.get("tokens_per_sec"),
+                grp.get("affinity_speedup_vs_single"),
+                aff.get("hit_rate"),
+                (grp.get("round_robin") or {}).get("hit_rate"),
             ]
     pallas = srv.get("pallas_long_seq") or {}
     if pallas:
@@ -2428,6 +2674,15 @@ def _compare_pairs(rec: dict) -> dict:
         ("tp_speedup", "+"), ("recompiles", "0"),
     ):
         put(f"gen.{k}", gen.get(k), d)
+    rep = gen.get("replica")
+    if isinstance(rep, list) and len(rep) >= 3:
+        # packed multi-replica sub-leg: [aff tok/s, speedup vs single,
+        # aff hit rate, rr hit rate] — affinity fleet throughput, its
+        # speedup, and the held hit rate are the gated contract; the
+        # round-robin control's collapsed hit rate is recorded only
+        put("gen.replica_tok_s", rep[0], "+")
+        put("gen.replica_spd", rep[1], "+")
+        put("gen.replica_hit", rep[2], "+")
     # PR 13's byte-budget renames: read the pre-rename spelling as a
     # fallback so --compare against a pre-rename baseline keeps these
     # gates alive (compare skips metrics missing on either side — without
@@ -2608,6 +2863,17 @@ def main() -> None:
         print(json.dumps(serving_gen_tp_cpu()))
         return
 
+    if "--gen-replicas-only" in sys.argv:
+        # same backend-pinning caveat as --gen-tp-only
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if any(d.platform != "cpu" for d in jax.devices()):
+            print("gen-replicas: failed to pin CPU backend", file=sys.stderr)
+            sys.exit(3)
+        print(json.dumps(serving_gen_replicas_cpu()))
+        return
+
     if "--serving-stack-only" in sys.argv:
         # This environment pre-wires a TPU plugin via sitecustomize, so the
         # JAX_PLATFORMS env var alone does NOT switch the subprocess to CPU
@@ -2676,6 +2942,11 @@ def main() -> None:
         tp_leg = gen_tp_subprocess()
         if tp_leg is not None:
             out["gen"]["tp"] = tp_leg
+        # multi-replica scale-out sub-leg: own subprocess for the same
+        # reason (replica-per-forced-device placement)
+        rep_leg = gen_replicas_subprocess()
+        if rep_leg is not None:
+            out["gen"]["replicas"] = rep_leg
         # image-class wire comparison: REST+npy vs gRPC binData, same model
         out["wire_matrix"] = wire_matrix_cpu()
         out["multi_tenant"] = multi_tenant_cpu()

@@ -241,6 +241,102 @@ class EpsilonGreedyRouter(Unit):
         self._lock = threading.Lock()
 
 
+class PrefixAffinityRouterUnit(Unit):
+    """Generative replica router (TPU-native; the source system's ROUTER +
+    bandit-router pattern pointed at decode replicas): requests whose
+    prompts share a leading token block rendezvous-hash to the same child,
+    so prefix sharers keep hitting the child whose prefix pool is warm for
+    them; prompts with no affinity signal (shorter than one block, or no
+    tensor payload) ride reward-driven per-child bandit arms. When the
+    affinity winner's observed queue depth runs past the bounded-load
+    factor, the pick sheds power-of-two-style to the second rendezvous
+    rank. The policy engine is serving/affinity_router.AffinityBalancer —
+    the same one the in-process replicated scheduler uses, so in-graph and
+    in-scheduler routing share one behavior.
+
+    Rewards arrive through the Feedback API (send_feedback replays down
+    ``meta.routing`` exactly like the EpsilonGreedy router), and the
+    serving layer closes the loop automatically: responses carrying
+    ``meta.tags.slo`` verdicts (PR 9) are fed back as rewards with no
+    client change (``consumes_slo_feedback``). Child queue depths are
+    ingested via ``observe_depth`` (an operator poll of each child's
+    ``GET /decode/health`` ``queue_depth`` field).
+
+    Parameters: ``block`` (affinity key length in tokens, default 16 — one
+    KV page), ``fallback`` ("epsilon_greedy" | "thompson"), ``epsilon``,
+    ``load_factor`` (bounded-load shed threshold, default 1.25), ``seed``.
+    State is picklable so persistence/ checkpoints the learned arms
+    (reference C19 contract, same as EpsilonGreedyRouter)."""
+
+    # the serving layer feeds meta.tags.slo verdicts back as rewards to
+    # graphs containing this unit (serving/service.py auto SLO sink)
+    consumes_slo_feedback = True
+
+    def __init__(self, spec: PredictiveUnit):
+        super().__init__(spec)
+        from seldon_core_tpu.serving.affinity_router import (
+            DEFAULT_AFFINITY_BLOCK,
+            AffinityBalancer,
+        )
+
+        if not spec.children:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_ROUTING,
+                f"PREFIX_AFFINITY '{spec.name}' needs children to route over",
+            )
+        self.block = int(self.params.get("block", DEFAULT_AFFINITY_BLOCK))
+        self.balancer = AffinityBalancer(
+            len(spec.children),
+            policy="affinity",
+            fallback=str(self.params.get("fallback", "epsilon_greedy")),
+            epsilon=float(self.params.get("epsilon", 0.1)),
+            load_factor=float(self.params.get("load_factor", 1.25)),
+            seed=self.params.get("seed"),
+        )
+
+    def observe_depth(self, child: int, depth: int) -> None:
+        """Ingest one child's polled queue depth (``GET /decode/health``
+        -> ``queue_depth``) for the bounded-load shed."""
+        self.balancer.observe_depth(child, depth)
+
+    async def route(self, msg: SeldonMessage) -> int:
+        from seldon_core_tpu.serving.affinity_router import prefix_route_key
+
+        key = ()
+        if msg.array is not None:
+            arr = np.atleast_2d(np.asarray(msg.array))
+            if arr.size and np.issubdtype(arr.dtype, np.number):
+                # batched requests route on row 0's prompt: the micro-batch
+                # already groups one request's rows together, and a ROUTER
+                # decides per request
+                key = prefix_route_key(arr[0], block=self.block)
+        arm, _reason = self.balancer.pick(key)
+        return arm
+
+    async def send_feedback(self, feedback: Feedback, routing: int) -> None:
+        self.balancer.reward(routing, feedback.reward)
+
+    # persistence hooks (persistence/persister.py)
+    def __getstate__(self):
+        return {"block": self.block, "balancer": self.balancer}
+
+    def __setstate__(self, state):
+        # restore is called on a unit ALREADY built for the current CR
+        # (persistence/state.py attach): keep THIS graph's arm count and
+        # copy the learned estimates over for arms that still exist — a
+        # pickled 3-child balancer must not make a now-2-child router
+        # route to a removed branch
+        self.block = state["block"]
+        restored = state["balancer"]
+        bal = self.balancer
+        n = min(bal.n_arms, restored.n_arms)
+        for i in range(n):
+            bal.counts[i] = restored.counts[i]
+            bal.rewards[i] = restored.rewards[i]
+            bal.alpha[i] = restored.alpha[i]
+            bal.beta[i] = restored.beta[i]
+
+
 class FaultInjectorUnit(Unit):
     """Chaos-testing transformer (no reference analogue — SURVEY §5.3 notes
     'Fault injection: none'). Fails a configurable fraction of requests or
@@ -433,6 +529,10 @@ def register_builtins(registry: UnitRegistry) -> None:
     )
     registry.register(
         PredictiveUnitImplementation.SHADOW, lambda spec, ctx: ShadowRouterUnit(spec)
+    )
+    registry.register(
+        PredictiveUnitImplementation.PREFIX_AFFINITY,
+        lambda spec, ctx: PrefixAffinityRouterUnit(spec),
     )
     # JAX_MODEL is registered by models/zoo.py (needs the model registry).
     from seldon_core_tpu.models.zoo import make_jax_model_unit

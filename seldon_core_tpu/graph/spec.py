@@ -51,6 +51,11 @@ class PredictiveUnitImplementation(str, enum.Enum):
     SHADOW = "SHADOW"  # serve child 0, mirror traffic to the other children
     # fire-and-forget (candidate validation under production load; their
     # latency/failures never touch the response, their metrics still tick)
+    PREFIX_AFFINITY = "PREFIX_AFFINITY"  # generative replica router: prompts
+    # sharing a leading token block consistent-hash to the same (warm)
+    # child; keyless prompts ride reward-driven bandit arms fed by the
+    # Feedback API; bounded-load shedding on observed child queue depth
+    # (serving/affinity_router.py owns the policy engine)
 
 
 class PredictiveUnitMethod(str, enum.Enum):
@@ -358,6 +363,32 @@ class TpuSpec(_Spec):
     # greedy output stays token-identical to the single-device scheduler
     # at any width. {} (default) keeps single-device dispatch.
     decode_mesh_axes: dict[str, int] = Field(default_factory=dict)
+    # Multi-replica decode scale-out (serving/affinity_router.py): run N
+    # full decode-scheduler replicas — each with its own params copy, page
+    # pool, and prefix index, mapped round-robin onto the attached devices
+    # — behind a prefix-affinity router. Prompts sharing a leading block
+    # land on the same warm replica (prefix hit-rate holds at the
+    # single-replica level while throughput multiplies); prompts with no
+    # affinity signal ride reward-driven bandit arms fed by the Feedback
+    # API. 1 (default) keeps the single scheduler. Needs decode_slots > 0;
+    # not composable with decode_mesh_axes yet (they partition the same
+    # device budget).
+    decode_replicas: int = 1
+    # Routing policy across the replicas: "" / "affinity" (default —
+    # prefix-affinity + bounded-load shed + bandit fallback),
+    # "round_robin" (the control policy: documents the prefix hit-rate
+    # collapse), "bandit" (pure reward-driven arms, no affinity).
+    decode_router_policy: str = ""
+    # Queue-depth autoscale: > decode_replicas lets the router grow the
+    # fleet up to this cap when the mean un-admitted queue depth (the
+    # /decode/health ``queue_depth`` signal) sustains at or above
+    # decode_autoscale_queue_depth. A scale-up replica boots WARM:
+    # the hottest replica's refcount-ranked prefix pages are spilled
+    # through persistence/state.py and pre-seeded into the new pool, so
+    # its first shared-prompt request rides the warm TTFT path. 0
+    # disables autoscale.
+    decode_autoscale_replicas: int = 0
+    decode_autoscale_queue_depth: int = 0
     # Decode-loop SLO targets (serving/decode_scheduler.py + telemetry/
     # flight.py): per-request TTFT / inter-token-latency budgets in ms the
     # goodput/attainment telemetry is judged against. 0 (default) = not
@@ -477,5 +508,6 @@ BUILTIN_IMPLEMENTATIONS = frozenset(
         PredictiveUnitImplementation.OUTLIER_DETECTOR,
         PredictiveUnitImplementation.PYTHON_CLASS,
         PredictiveUnitImplementation.SHADOW,
+        PredictiveUnitImplementation.PREFIX_AFFINITY,
     }
 )

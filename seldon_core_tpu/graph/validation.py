@@ -282,6 +282,77 @@ def validate_deployment(dep: SeldonDeployment) -> None:
                 f"predictor '{pred.name}' decode_spec_accept_floor needs "
                 "decode_spec_k > 0 or decode_spec_tree (nothing to adapt)"
             )
+        # multi-replica decode scale-out (serving/affinity_router.py)
+        if pred.tpu.decode_replicas < 1:
+            problems.append(
+                f"predictor '{pred.name}' decode_replicas must be >= 1"
+            )
+        if pred.tpu.decode_autoscale_replicas < 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_autoscale_replicas must be >= 0"
+            )
+        if pred.tpu.decode_autoscale_queue_depth < 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_autoscale_queue_depth must be >= 0"
+            )
+        fleet_max = max(pred.tpu.decode_replicas, pred.tpu.decode_autoscale_replicas)
+        if fleet_max > 1:
+            if pred.tpu.decode_slots <= 0:
+                problems.append(
+                    f"predictor '{pred.name}' decode_replicas/"
+                    "decode_autoscale_replicas need decode_slots > 0 (the "
+                    "replicated tier multiplies the continuous-batching "
+                    "scheduler)"
+                )
+            if pred.tpu.decode_mesh_axes:
+                problems.append(
+                    f"predictor '{pred.name}' decode_replicas/"
+                    "decode_autoscale_replicas cannot combine with "
+                    "decode_mesh_axes yet (replica scale-out and tensor "
+                    "parallelism partition the same device budget)"
+                )
+        if (
+            0 < pred.tpu.decode_autoscale_replicas <= pred.tpu.decode_replicas
+        ):
+            # == is rejected too: a cap equal to the configured fleet
+            # leaves the autoscaler nothing to add — the config would be
+            # silently inert, the exact trap this block exists to close
+            problems.append(
+                f"predictor '{pred.name}' decode_autoscale_replicas "
+                f"({pred.tpu.decode_autoscale_replicas}) must exceed "
+                f"decode_replicas ({pred.tpu.decode_replicas}) — the "
+                "autoscale cap needs headroom to scale into (and cannot "
+                "shrink the configured fleet)"
+            )
+        if (
+            pred.tpu.decode_autoscale_replicas > pred.tpu.decode_replicas
+            and pred.tpu.decode_autoscale_queue_depth <= 0
+        ):
+            problems.append(
+                f"predictor '{pred.name}' decode_autoscale_replicas needs "
+                "decode_autoscale_queue_depth > 0 (the scale-up signal)"
+            )
+        if (
+            pred.tpu.decode_autoscale_queue_depth > 0
+            and pred.tpu.decode_autoscale_replicas <= 0
+        ):
+            problems.append(
+                f"predictor '{pred.name}' decode_autoscale_queue_depth needs "
+                "decode_autoscale_replicas > 0 (nothing to scale)"
+            )
+        if pred.tpu.decode_router_policy not in ("", "affinity", "round_robin", "bandit"):
+            problems.append(
+                f"predictor '{pred.name}' decode_router_policy "
+                f"'{pred.tpu.decode_router_policy}' must be "
+                "affinity|round_robin|bandit (or empty for the affinity "
+                "default)"
+            )
+        if pred.tpu.decode_router_policy and fleet_max <= 1:
+            problems.append(
+                f"predictor '{pred.name}' decode_router_policy needs "
+                "decode_replicas > 1 or decode_autoscale_replicas > 1 "
+                "(one replica leaves nothing to route)"
+            )
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

@@ -174,6 +174,31 @@ class NullMetrics:
         counter links to the rounds surrounding the breach."""
         pass
 
+    # multi-replica decode router (serving/affinity_router.py): routing
+    # decisions by reason, per-replica queue depth the router balanced on,
+    # bandit arm estimates moved by Feedback-API rewards, fleet size, and
+    # warm-scale-up preseed volume
+    def router_route(self, deployment: str, policy: str, reason: str) -> None:
+        """One routing decision (``reason`` = affinity | shed | fallback |
+        round_robin)."""
+        pass
+
+    def router_queue_depth(self, deployment: str, replica: int, depth: int) -> None:
+        pass
+
+    def router_arm(self, deployment: str, replica: int, estimate: float) -> None:
+        """Reward ingestion moved one arm: its current mean-reward
+        estimate (the epsilon-greedy exploit ranking)."""
+        pass
+
+    def router_replicas(self, deployment: str, n: int) -> None:
+        pass
+
+    def router_preseed(self, deployment: str, pages: int) -> None:
+        """One warm scale-up/boot: prefix-pool pages pre-seeded from a
+        spill."""
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -465,6 +490,40 @@ class Metrics(NullMetrics):
             registry=registry,
             buckets=_LATENCY_BUCKETS,
         )
+        # multi-replica decode router (serving/affinity_router.py)
+        self._router_routes = Counter(
+            "seldon_tpu_router_routes_total",
+            "Decode-replica routing decisions "
+            "(reason=affinity|shed|fallback|round_robin)",
+            ["deployment_name", "policy", "reason"],
+            registry=registry,
+        )
+        self._router_queue_depth = Gauge(
+            "seldon_tpu_router_queue_depth",
+            "Per-replica load (queue depth + active slots) the router "
+            "last balanced on",
+            ["deployment_name", "replica"],
+            registry=registry,
+        )
+        self._router_arm = Gauge(
+            "seldon_tpu_router_arm_estimate",
+            "Per-replica bandit arm mean-reward estimate (moved by "
+            "Feedback-API rewards / automatic SLO verdicts)",
+            ["deployment_name", "replica"],
+            registry=registry,
+        )
+        self._router_replicas = Gauge(
+            "seldon_tpu_router_replicas",
+            "Live decode replicas behind the router (autoscale moves it)",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._router_preseed = Counter(
+            "seldon_tpu_router_preseeded_pages_total",
+            "Prefix-pool pages pre-seeded into warm-booted replicas",
+            ["deployment_name"],
+            registry=registry,
+        )
         # SHADOW router candidate validation: per-shadow-child prediction
         # agreement with the primary (argmax match on classifier outputs)
         self._shadow = Counter(
@@ -623,6 +682,21 @@ class Metrics(NullMetrics):
             except (TypeError, ValueError):  # older client / invalid exemplar
                 pass
         c.inc()
+
+    def router_route(self, deployment, policy, reason):
+        self._router_routes.labels(deployment, policy, reason).inc()
+
+    def router_queue_depth(self, deployment, replica, depth):
+        self._router_queue_depth.labels(deployment, str(replica)).set(depth)
+
+    def router_arm(self, deployment, replica, estimate):
+        self._router_arm.labels(deployment, str(replica)).set(estimate)
+
+    def router_replicas(self, deployment, n):
+        self._router_replicas.labels(deployment).set(n)
+
+    def router_preseed(self, deployment, pages):
+        self._router_preseed.labels(deployment).inc(pages)
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

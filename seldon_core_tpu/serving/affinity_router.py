@@ -1,0 +1,809 @@
+"""Multi-replica decode scale-out: prefix-affinity routing + reward-driven
+load balancing.
+
+The single decode scheduler is saturated on every measured axis (pipelined
+rounds hide the host bubble, the feature draft amortizes each dispatch), so
+the next throughput multiple is horizontal: N scheduler replicas, each with
+its own page pool, prefix index, and dispatch stream — mapped round-robin
+onto the attached devices — behind a ROUTER that keeps warm routing warm.
+This is the source system's defining capability (ROUTER graph nodes + bandit
+routers fed by the Feedback reward API, PAPER.md L3/L5) pointed at the
+generative tier:
+
+- **Prefix-affinity routing**: a prefix-pool hit cuts TTFT 78.0 -> 28.2 ms
+  (PR 5), but the hit only exists on the replica that CAPTURED the prefix.
+  The router normalizes the prompt to its leading block (the same
+  normalization ``PrefixIndex`` admission applies — shared helpers below)
+  and rendezvous-hashes it, so every request sharing a system prompt lands
+  on the same warm replica while distinct prefix groups spread across the
+  fleet. Naive round-robin splits each group R ways and multiplies the cold
+  misses by the replica count — the bench's control leg documents exactly
+  that collapse.
+- **Bounded-load shedding**: affinity must not melt the hot replica. When
+  the rendezvous winner's queue depth exceeds ``load_factor`` x the fleet
+  mean (+1 slack), the pick degrades to power-of-two-choices between the
+  top TWO rendezvous ranks by live queue depth (``/decode/health`` exposes
+  ``queue_depth`` per replica for the out-of-process twin) — the classic
+  consistent-hashing-with-bounded-loads escape valve, and the shed target
+  is still deterministic per key (rank 2), so a spilled group stays warm on
+  ONE overflow replica instead of spraying.
+- **Reward-driven fallback**: requests with no affinity signal (prompts
+  shorter than one block) ride per-replica bandit arms — epsilon-greedy or
+  Thompson — rewarded through the existing Feedback API by the
+  TTFT/ITL/SLO-attainment verdicts PR 9 already stamps into
+  ``meta.tags.slo`` (the serving layer closes the loop automatically; no
+  client change).
+- **Warm scale-up**: a new replica is cold by construction. Scale-up spills
+  the hottest replica's refcount-ranked prefix-pool pages (int8 pools spill
+  the stored bytes + scale planes verbatim — no dequant round-trip) through
+  ``persistence/state.py`` and pre-seeds them into the new replica's pool,
+  so its FIRST shared-prompt request already rides the warm TTFT path.
+
+Everything here is host-side policy — no device programs, no new compile
+ladders. The replicas' fused program sets are untouched; the tier's greedy
+output is bit-identical to a single scheduler for every routing policy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import pickle
+import random
+import threading
+import time
+
+import numpy as np
+
+from seldon_core_tpu.core.errors import APIException, ErrorCode
+from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.metrics import NullMetrics
+
+log = logging.getLogger(__name__)
+
+# default affinity block: one KV page of tokens (the pool's auto page size)
+# — sharers whose common prefix spans at least one page are the ones whose
+# reuse actually displaces prefill work
+DEFAULT_AFFINITY_BLOCK = 16
+
+ROUTER_POLICIES = ("affinity", "round_robin", "bandit")
+FALLBACK_POLICIES = ("epsilon_greedy", "thompson")
+
+
+# --------------------------------------------------------------------------
+# prompt -> prefix-key normalization (shared by scheduler admission and the
+# router; previously inlined in DecodeScheduler._admit_decide/_maybe_capture
+# and only exercised through scheduler e2e paths)
+def usable_prefix_len(length: int, seq_len: int) -> int:
+    """The longest REUSABLE span of a prompt prefix on a ``seq_len`` prompt
+    bucket: clamped to ``seq_len - 1`` because the last prompt position must
+    always be computed fresh — its logits are the first generated token's
+    distribution (the LCP boundary rule admission applies to every
+    radix-trie match). Degenerate inputs (empty prompts, seq_len <= 1)
+    normalize to 0: nothing reusable."""
+    return max(0, min(int(length), int(seq_len) - 1))
+
+
+def capture_prefix_len(length: int, prefix_ctx: int, seq_len: int) -> int:
+    """The span a retiring/hinted slot may CAPTURE into the prefix index:
+    the requested length clamped to the deployment's prefix window
+    (``decode_prefix_ctx``) and the prompt bucket — only prompt positions
+    are ever cached. 0 means nothing capturable."""
+    return max(0, min(int(length), int(prefix_ctx), int(seq_len)))
+
+
+def prefix_route_key(prompt, *, block: int = DEFAULT_AFFINITY_BLOCK, seq_len: int = 0):
+    """The prompt's affinity key: its leading ``block`` tokens, as a tuple.
+
+    Uses the SAME normalization the radix index applies on admission: when
+    ``seq_len`` is given, only the usable span (``usable_prefix_len``) may
+    contribute — a prompt whose usable span is shorter than one block has no
+    affinity signal and returns ``()`` (the router falls back to its bandit
+    arms). One block is deliberately the whole key: two groups that agree on
+    their first block but diverge later also share radix-trie ancestry, so
+    co-locating them is exactly what keeps the shared span warm."""
+    n = len(prompt)
+    usable = usable_prefix_len(n, seq_len) if seq_len > 0 else n
+    if block <= 0 or usable < block:
+        return ()
+    return tuple(int(t) for t in prompt[:block])
+
+
+def _key_rank(key: tuple, arm: int) -> int:
+    """Rendezvous (highest-random-weight) score of ``arm`` for ``key`` —
+    deterministic across processes/restarts (hashlib, not hash())."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(repr(key).encode())
+    h.update(arm.to_bytes(4, "little", signed=False))
+    return int.from_bytes(h.digest(), "little")
+
+
+class AffinityBalancer:
+    """Host-side routing policy over N replica arms.
+
+    - ``pick(key, depths)``: rendezvous-hash ``key`` over the live arms with
+      bounded-load shedding on queue depth; keyless requests ride the
+      reward-driven fallback arms (epsilon-greedy or Thompson).
+    - ``reward(arm, r)``: reward ingestion (r in [0, 1]) — what the
+      Feedback API and the serving layer's automatic SLO sink call.
+
+    Arm state is plain host data and picklable (persistence/state.py
+    checkpoints it exactly like the EpsilonGreedyRouter's counts)."""
+
+    def __init__(
+        self,
+        n_arms: int,
+        *,
+        policy: str = "affinity",
+        fallback: str = "epsilon_greedy",
+        epsilon: float = 0.1,
+        load_factor: float = 1.25,
+        seed=None,
+    ):
+        if n_arms < 1:
+            raise ValueError(f"balancer needs >= 1 arm, got {n_arms}")
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"router policy {policy!r} unsupported (want one of "
+                f"{ROUTER_POLICIES})"
+            )
+        if fallback not in FALLBACK_POLICIES:
+            raise ValueError(
+                f"fallback policy {fallback!r} unsupported (want one of "
+                f"{FALLBACK_POLICIES})"
+            )
+        self.policy = policy
+        self.fallback = fallback
+        self.epsilon = float(epsilon)
+        self.load_factor = float(load_factor)
+        self._rng = random.Random(int(seed)) if seed is not None else random.Random()
+        self.counts = [0] * n_arms
+        self.rewards = [0.0] * n_arms
+        # Thompson state: Beta posterior per arm (successes/failures in
+        # fractional units — an SLO verdict is 0/1, a shaped reward may
+        # land between)
+        self.alpha = [1.0] * n_arms
+        self.beta = [1.0] * n_arms
+        # externally-observed queue depths (the /decode/health poll path);
+        # in-process callers pass live depths to pick() instead. Each
+        # observation carries a timestamp: a reading older than DEPTH_TTL_S
+        # reads as 0 — a crashed poller's last spike must not shed a
+        # group off its warm replica forever
+        self.depths = [0] * n_arms
+        self._depth_ts = [0.0] * n_arms
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.stat_routes = {"affinity": 0, "shed": 0, "fallback": 0, "round_robin": 0}
+
+    @property
+    def n_arms(self) -> int:
+        return len(self.counts)
+
+    def add_arm(self) -> int:
+        """Grow the fleet by one arm (scale-up). Rendezvous hashing moves
+        only ~1/N of the keyspace onto the new arm — existing prefix groups
+        overwhelmingly keep their warm home."""
+        with self._lock:
+            self.counts.append(0)
+            self.rewards.append(0.0)
+            self.alpha.append(1.0)
+            self.beta.append(1.0)
+            self.depths.append(0)
+            self._depth_ts.append(0.0)
+            return len(self.counts) - 1
+
+    # observed depths older than this read as 0 in pick() — bounds the
+    # damage of a stale spike when the health poller stops
+    DEPTH_TTL_S = 30.0
+
+    def observe_depth(self, arm: int, depth: int) -> None:
+        """Ingest a polled queue depth (the /decode/health ``queue_depth``
+        field) for out-of-process replicas."""
+        with self._lock:
+            if 0 <= arm < len(self.depths):
+                self.depths[arm] = max(0, int(depth))
+                self._depth_ts[arm] = time.monotonic()
+
+    def _observed_depths(self) -> list[int]:
+        """The polled depths with the staleness TTL applied (lock held)."""
+        now = time.monotonic()
+        return [
+            d if now - t <= self.DEPTH_TTL_S else 0
+            for d, t in zip(self.depths, self._depth_ts)
+        ]
+
+    # ---------------------------------------------------------------- picks
+    def pick(self, key, depths=None) -> tuple[int, str]:
+        """Route one request: returns ``(arm, reason)`` with reason one of
+        affinity | shed | fallback | round_robin."""
+        with self._lock:
+            n = len(self.counts)
+            d = [
+                int(x)
+                for x in (depths if depths is not None else self._observed_depths())
+            ]
+            d += [0] * (n - len(d))
+            if self.policy == "round_robin":
+                arm = self._rr % n
+                self._rr += 1
+                self.stat_routes["round_robin"] += 1
+                return arm, "round_robin"
+            if self.policy == "affinity" and key:
+                ranked = sorted(range(n), key=lambda a: _key_rank(tuple(key), a), reverse=True)
+                primary = ranked[0]
+                # bounded load: the hot replica may run ahead of the fleet
+                # mean by load_factor (+1 slack so tiny fleets don't shed
+                # on depth 1-vs-0); past that, power-of-two-choices between
+                # the top two rendezvous ranks keeps the spill warm on ONE
+                # deterministic overflow replica
+                bound = self.load_factor * (sum(d) / n) + 1.0
+                if n > 1 and d[primary] > bound:
+                    second = ranked[1]
+                    if d[second] < d[primary]:
+                        # a shed is only a shed when the key MOVES — an
+                        # even-deeper rank 2 keeps the request home, and
+                        # counting that as displaced would overstate shed
+                        # traffic in the routes metric
+                        self.stat_routes["shed"] += 1
+                        return second, "shed"
+                self.stat_routes["affinity"] += 1
+                return primary, "affinity"
+            # keyless (or policy=bandit): the reward-driven fallback arms
+            self.stat_routes["fallback"] += 1
+            return self._fallback_pick(d), "fallback"
+
+    def _fallback_pick(self, depths) -> int:
+        n = len(self.counts)
+        if self.fallback == "thompson":
+            draws = [
+                self._rng.betavariate(self.alpha[i], self.beta[i]) for i in range(n)
+            ]
+            return int(max(range(n), key=draws.__getitem__))
+        if self._rng.random() < self.epsilon:
+            return self._rng.randrange(n)
+        means = [
+            self.rewards[i] / self.counts[i] if self.counts[i] else float("inf")
+            for i in range(n)
+        ]
+        best = max(means)
+        # estimate ties break by LIVE load, then index: before any reward
+        # lands every arm ties at +inf, and without this the exploit
+        # branch would herd ~1-epsilon of keyless traffic onto arm 0
+        # while the rest of the fleet idles
+        tied = [i for i in range(n) if means[i] == best]
+        return int(min(tied, key=lambda i: (depths[i], i)))
+
+    # -------------------------------------------------------------- rewards
+    def reward(self, arm: int, r: float) -> None:
+        """Reward ingestion for one served request (clamped to [0, 1]) —
+        moves BOTH estimators so a live policy flip needs no re-learning."""
+        if not (0 <= int(arm) < len(self.counts)):
+            return
+        r = min(1.0, max(0.0, float(r)))
+        with self._lock:
+            self.counts[arm] += 1
+            self.rewards[arm] += r
+            self.alpha[arm] += r
+            self.beta[arm] += 1.0 - r
+
+    def arm_estimate(self, arm: int) -> float:
+        c = self.counts[arm]
+        return self.rewards[arm] / c if c else 0.0
+
+    # persistence hooks (persistence/state.py contract)
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_lock", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+# --------------------------------------------------------------------------
+# prefix-page spill / preseed (warm scale-up through persistence/state.py)
+SPILL_UNIT = "prefix_pages"  # state_key unit id the spill payload rides
+
+
+def spill_key(deployment_id: str) -> str:
+    from seldon_core_tpu.persistence.state import state_key
+
+    return state_key(deployment_id or "decode", SPILL_UNIT)
+
+
+def spill_to_store(sched, store, deployment_id: str, top_n: int = 0) -> int:
+    """Export ``sched``'s hottest prefix entries into a persistence store
+    (FileStateStore/RedisStateStore). Returns entries spilled."""
+    payload = sched.export_prefix_state(top_n=top_n)
+    if payload is None or not payload["entries"]:
+        return 0
+    store.save(spill_key(deployment_id), pickle.dumps(payload))
+    return len(payload["entries"])
+
+
+def preseed_from_store(sched, store, deployment_id: str) -> int:
+    """Pre-seed ``sched``'s page pool from a spilled payload; returns the
+    entries seeded (0 when the store holds nothing or nothing fits)."""
+    raw = store.load(spill_key(deployment_id))
+    if raw is None:
+        return 0
+    try:
+        payload = pickle.loads(raw)
+    except Exception:  # noqa: BLE001 - stale/corrupt spill must not fail boot
+        log.warning("corrupt prefix spill for %r ignored", deployment_id)
+        return 0
+    return sched.preseed_prefix_state(payload)
+
+
+def preseed_enabled() -> bool:
+    """ENGINE_DECODE_REPLICA_PRESEED kill switch (default on): "off"
+    disables warm pre-seeding at scale-up/boot — cold boots only."""
+    import os
+
+    from seldon_core_tpu.utils.env import ENGINE_DECODE_REPLICA_PRESEED
+
+    return os.environ.get(ENGINE_DECODE_REPLICA_PRESEED, "on").lower() not in (
+        "off",
+        "0",
+        "false",
+    )
+
+
+# --------------------------------------------------------------------------
+class ReplicatedDecodeScheduler:
+    """N decode-scheduler replicas behind the affinity balancer, presenting
+    the single scheduler's serving surface (``submit`` /
+    ``execute_message`` / ``warmup`` / ``close`` / stats) so the batcher,
+    the streaming ingress, and the bench drive it unchanged.
+
+    Each replica owns its full device state (params copy, page pool, prefix
+    index, draft cache) on its own device — ``factory(i)`` places replica i
+    on ``jax.devices()[i % n_devices]`` — so N replicas are N independent
+    dispatch streams: the in-process twin of N decode pods, and the real
+    thing on a multi-chip host. Greedy output is bit-identical to a single
+    scheduler under EVERY routing policy (each replica is the proven
+    scheduler; routing only decides which warm pool serves a request).
+
+    Autoscale: when ``autoscale_replicas`` caps a larger fleet, a sustained
+    mean queue depth >= ``autoscale_queue_depth`` (the same signal
+    ``/decode/health`` exports) boots one more replica in the background —
+    pre-seeded from the hottest replica's spilled prefix pages so it serves
+    shared prompts warm from its first request."""
+
+    # a scale-up needs BOTH: this many hot observations AND the queue
+    # held hot for this long — the observation count alone would let one
+    # millisecond-scale burst (several submits arriving together) boot an
+    # expensive replica that the burst never needed
+    AUTOSCALE_STREAK = 3
+    AUTOSCALE_HOLD_S = 0.5
+
+    def __init__(
+        self,
+        factory,
+        n_replicas: int,
+        *,
+        policy: str = "",
+        fallback: str = "epsilon_greedy",
+        epsilon: float = 0.1,
+        load_factor: float = 1.25,
+        affinity_block: int = DEFAULT_AFFINITY_BLOCK,
+        autoscale_replicas: int = 0,
+        autoscale_queue_depth: int = 0,
+        spill_store=None,
+        spill_store_factory=None,
+        metrics: NullMetrics | None = None,
+        deployment_name: str = "",
+        seed: int = 0,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.factory = factory
+        self.policy = policy or "affinity"
+        self.replicas = [self._attach(factory(i)) for i in range(n_replicas)]
+        self.affinity_block = int(affinity_block) or DEFAULT_AFFINITY_BLOCK
+        self.autoscale_replicas = int(autoscale_replicas)
+        self.autoscale_queue_depth = int(autoscale_queue_depth)
+        self.spill_store = spill_store
+        # resolved on the FIRST spill, not at build: a file store's ctor
+        # mkdirs its directory, and most fleets never scale up
+        self._spill_store_factory = spill_store_factory
+        self._metrics = metrics or NullMetrics()
+        self._deployment = deployment_name
+        self.balancer = AffinityBalancer(
+            n_replicas,
+            policy=self.policy,
+            fallback=fallback,
+            epsilon=epsilon,
+            load_factor=load_factor,
+            seed=seed,
+        )
+        self._hot_streak = 0
+        self._hot_since: float | None = None
+        self._scaling = False
+        self._scale_task: asyncio.Task | None = None
+        self.stat_scale_ups = 0
+        self.stat_preseeded_entries = 0
+        self._metrics.router_replicas(self._deployment, len(self.replicas))
+
+    def _attach(self, replica):
+        """Fleet wiring for one replica: dispatches hop OFF the event loop
+        onto a dedicated single-thread executor (one dispatch stream per
+        replica — N replicas' device work genuinely overlaps; XLA releases
+        the GIL during execution) even on the CPU backend, where a lone
+        scheduler would dispatch inline."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        replica._offload_dispatch = True
+        replica._dispatch_pool = ThreadPoolExecutor(
+            max_workers=1,
+            thread_name_prefix=f"decode-r{getattr(replica, 'replica_id', 0)}",
+        )
+        return replica
+
+    # ------------------------------------------------------------ delegates
+    @property
+    def _r0(self):
+        return self.replicas[0]
+
+    @property
+    def seq_len(self) -> int:
+        return self._r0.seq_len
+
+    @property
+    def max_new_tokens(self) -> int:
+        return self._r0.max_new_tokens
+
+    @property
+    def eos_id(self) -> int:
+        return self._r0.eos_id
+
+    @property
+    def slo_ttft_s(self) -> float:
+        return self._r0.slo_ttft_s
+
+    @property
+    def slo_itl_s(self) -> float:
+        return self._r0.slo_itl_s
+
+    @property
+    def active(self) -> int:
+        return sum(r.active for r in self.replicas)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.queue_depth for r in self.replicas)
+
+    @property
+    def prefix_enabled(self) -> bool:
+        return self._r0.prefix_enabled
+
+    # aggregated attribution (bench/soak read these off the single
+    # scheduler today; the replicated tier sums)
+    @property
+    def stat_prefix_hits(self) -> int:
+        return sum(r.stat_prefix_hits for r in self.replicas)
+
+    @property
+    def stat_prefix_misses(self) -> int:
+        return sum(r.stat_prefix_misses for r in self.replicas)
+
+    @property
+    def stat_prefix_tokens_saved(self) -> int:
+        return sum(r.stat_prefix_tokens_saved for r in self.replicas)
+
+    @property
+    def stat_tokens(self) -> int:
+        return sum(r.stat_tokens for r in self.replicas)
+
+    @property
+    def stat_chunk_dispatches(self) -> int:
+        return sum(r.stat_chunk_dispatches for r in self.replicas)
+
+    def __getattr__(self, name: str):
+        # any scheduler attribution counter not explicitly aggregated
+        # above sums across the fleet (soak/bench read stat_* freely)
+        if name.startswith("stat_"):
+            return sum(getattr(r, name) for r in self.replicas)
+        raise AttributeError(name)
+
+    def request_params_from_meta(self, meta: Meta) -> dict:
+        return self._r0.request_params_from_meta(meta)
+
+    def warmup(self) -> None:
+        for r in self.replicas:
+            r.warmup()
+        # the fused program set is module-level, so sibling replicas share
+        # each function's underlying jit cache: replica N's warmup entries
+        # (distinct device placements = distinct signatures) would read as
+        # phantom "recompiles" against replica 0's earlier baseline.
+        # Re-snapshot every replica once the WHOLE fleet is warm.
+        for r in self.replicas:
+            r._warmup_compile_counts = r.compile_counts()
+
+    def compile_counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for i, r in enumerate(self.replicas):
+            for k, v in r.compile_counts().items():
+                out[f"r{i}.{k}"] = v
+        return out
+
+    def recompiles_since_warmup(self) -> int:
+        return sum(r.recompiles_since_warmup() for r in self.replicas)
+
+    async def close(self) -> None:
+        task = self._scale_task
+        if task is not None:
+            # let an in-flight scale-up settle: cancelling mid-warmup
+            # would leak a half-built replica's device state
+            try:
+                await task
+            except Exception:  # noqa: BLE001 - logged by the task itself
+                pass
+        await asyncio.gather(*(r.close() for r in self.replicas))
+        for r in self.replicas:
+            pool = getattr(r, "_dispatch_pool", None)
+            if pool is not None:
+                pool.shutdown(wait=False)
+
+    # -------------------------------------------------------------- routing
+    def _live_depths(self) -> list[int]:
+        # queue depth + active slots: a replica with free slots beats one
+        # that is merely not-queueing (both O(1) reads)
+        return [r.queue_depth + r.active for r in self.replicas]
+
+    def route(self, prompt) -> tuple[int, str]:
+        """Pick the serving replica for one prompt (token ids)."""
+        key = prefix_route_key(
+            prompt, block=self.affinity_block, seq_len=self.seq_len
+        )
+        arm, reason = self.balancer.pick(key, self._live_depths())
+        self._metrics.router_route(self._deployment, self.policy, reason)
+        return arm, reason
+
+    def _reward_sink(self, arm: int, inner):
+        """Per-request reward closure for the STREAMING path (no buffered
+        response tags to ride the Feedback API): the scheduler's SLO
+        verdict rewards the serving arm directly. Buffered requests carry
+        ``meta.tags.replica`` instead and reward through
+        ``ingest_feedback`` — one reward per request either way."""
+
+        def sink(ok: bool) -> None:
+            self._reward_arm(arm, 1.0 if ok else 0.0)
+            if inner is not None:
+                inner(ok)
+
+        return sink
+
+    def _reward_arm(self, arm: int, r: float) -> None:
+        self.balancer.reward(arm, r)
+        self._metrics.router_arm(
+            self._deployment,
+            arm,
+            self.balancer.arm_estimate(arm),
+        )
+
+    async def submit(self, prompt, *, _slo_sink=None, **kw):
+        """Route one sequence to its replica and submit (the streaming
+        ingress path — per-row SLO verdicts reward the serving arm
+        directly, since a streamed response never rides the Feedback
+        API)."""
+        self._autoscale_tick()
+        arm, _reason = self.route(prompt)
+        sink = _slo_sink
+        if self.slo_ttft_s > 0 or self.slo_itl_s > 0:
+            sink = self._reward_sink(arm, _slo_sink)
+        return await self.replicas[arm].submit(prompt, _slo_sink=sink, **kw)
+
+    async def execute_message(self, msg: SeldonMessage) -> SeldonMessage:
+        """Buffered serving entry: every row routes independently (rows of
+        one request sharing a prefix land on the same warm replica; mixed
+        rows spread), each rides its replica's own execute_message, and
+        the merged response mirrors the single scheduler's contract —
+        plus ``meta.tags.replica`` (per-row serving replica) so the
+        Feedback API can route rewards back to the arms."""
+        arr = msg.array
+        if arr is None:
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_JSON,
+                "generative predictor needs tensor token ids",
+            )
+        self._autoscale_tick()
+        rows = np.atleast_2d(np.asarray(arr)).astype(np.int32)
+        picks = []
+        for row in rows:
+            arm, _reason = self.route(row)
+            picks.append(arm)
+
+        async def one(i: int) -> SeldonMessage:
+            sub = SeldonMessage.from_array(rows[i : i + 1], meta=msg.meta)
+            return await self.replicas[picks[i]].execute_message(sub)
+
+        outs = await asyncio.gather(
+            *(one(i) for i in range(len(rows))), return_exceptions=True
+        )
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
+        full = np.concatenate([np.atleast_2d(np.asarray(o.array)) for o in outs])
+        tags = {**msg.meta.tags, "replica": picks}
+        gen_lens: list[int] = []
+        slo: list[str] = []
+        for o in outs:
+            gen_lens.extend(o.meta.tags.get("gen_lens") or [])
+            slo.extend(o.meta.tags.get("slo") or [])
+        tags["gen_lens"] = gen_lens
+        if slo:
+            tags["slo"] = slo
+        meta = Meta(
+            puid=msg.meta.puid,
+            tags=tags,
+            routing=dict(msg.meta.routing),
+            request_path=dict(msg.meta.request_path),
+        )
+        return msg.with_array_meta(full, meta)
+
+    # ----------------------------------------------------------- feedback
+    def ingest_feedback(self, feedback, *, use_slo: bool = False) -> int:
+        """Feedback-API reward ingestion: the response's per-row
+        ``meta.tags.replica`` names the serving arms; ``feedback.reward``
+        moves their estimates. ``use_slo=True`` (the serving layer's
+        AUTOMATIC sink only) rewards each row from the response's own SLO
+        verdict instead — a client's explicit reward is always honored
+        verbatim, including an explicit 0.0 down-vote. Returns arms
+        updated; rows naming an unknown replica (forged tags, or a
+        response predating a fleet resize) are skipped, never an
+        error."""
+        resp = feedback.response
+        if resp is None:
+            return 0
+        arms = resp.meta.tags.get("replica")
+        if not isinstance(arms, (list, tuple)) or not arms:
+            return 0
+        slo = resp.meta.tags.get("slo")
+        updated = 0
+        for i, arm in enumerate(arms):
+            try:
+                arm = int(arm)
+            except (TypeError, ValueError):
+                continue
+            if not (0 <= arm < len(self.replicas)):
+                continue
+            r = float(feedback.reward)
+            if use_slo and isinstance(slo, (list, tuple)) and i < len(slo):
+                r = 1.0 if slo[i] == "met" else 0.0
+            self._reward_arm(arm, r)
+            updated += 1
+        return updated
+
+    # ---------------------------------------------------------- autoscale
+    def _autoscale_tick(self) -> None:
+        """Queue-depth autoscale check (O(replicas), runs per request):
+        a sustained mean queue depth >= the threshold boots one replica in
+        the background, warm-seeded from the hottest replica's spill."""
+        # per-request (not per-row) queue-depth gauge refresh — route()'s
+        # per-row hot path reads depths but must not pay O(replicas)
+        # metric label resolutions per row
+        for i, d in enumerate(self._live_depths()):
+            self._metrics.router_queue_depth(self._deployment, i, d)
+        if (
+            self.autoscale_replicas <= len(self.replicas)
+            or self.autoscale_queue_depth <= 0
+            or self._scaling
+        ):
+            return
+        mean_depth = sum(r.queue_depth for r in self.replicas) / len(self.replicas)
+        now = time.monotonic()
+        if mean_depth >= self.autoscale_queue_depth:
+            self._hot_streak += 1
+            if self._hot_since is None:
+                self._hot_since = now
+        else:
+            self._hot_streak = 0
+            self._hot_since = None
+            return
+        if (
+            self._hot_streak >= self.AUTOSCALE_STREAK
+            and now - self._hot_since >= self.AUTOSCALE_HOLD_S
+        ):
+            self._scaling = True
+            self._hot_streak = 0
+            self._hot_since = None
+            self._scale_task = asyncio.ensure_future(self._scale_up())
+
+    def _hottest_replica(self):
+        """The replica whose prefix index served the most hits — the one
+        whose working set a new replica wants."""
+        return max(self.replicas, key=lambda r: r.stat_prefix_hits)
+
+    async def _export_spill(self) -> dict | None:
+        """Export the hottest replica's prefix pages ON the event loop —
+        the allocator/index cannot mutate mid-read there (no awaits inside
+        the export), so entry->pages->bytes stays consistent. The pool's
+        device buffers may still be mid-donation to an in-flight dispatch
+        (reads raise "Array has been deleted"); retry until the export
+        lands between rounds."""
+        src = self._hottest_replica()
+        for _ in range(500):
+            try:
+                return src.export_prefix_state()
+            except RuntimeError:
+                await asyncio.sleep(0.005)
+        log.warning("prefix spill never found a quiescent round — cold boot")
+        return None
+
+    def _build_warm_replica(self, replica_id: int, payload):
+        """Blocking build: construct + preseed + warmup (runs on a worker
+        thread — XLA compiles must not stall the serving loop; the spill
+        payload is host data exported on the loop beforehand)."""
+        new = self._attach(self.factory(replica_id))
+        if payload is not None:
+            self.stat_preseeded_entries += new.preseed_prefix_state(payload)
+        new.warmup()
+        # shared-jit-cache note (see warmup): the new replica's compiles
+        # would read as phantom recompiles on the serving replicas —
+        # re-baseline them at the scale-up boundary
+        for r in self.replicas:
+            r._warmup_compile_counts = r.compile_counts()
+        return new
+
+    async def _scale_up(self) -> None:
+        t0 = time.perf_counter()
+        try:
+            rid = len(self.replicas)
+            payload = None
+            if preseed_enabled() and self.prefix_enabled:
+                payload = await self._export_spill()
+                if self.spill_store is None and self._spill_store_factory is not None:
+                    try:
+                        self.spill_store = self._spill_store_factory()
+                    except Exception:  # noqa: BLE001 - a broken store must not fail the scale-up
+                        log.exception("replica spill store unusable — in-process spill only")
+                    self._spill_store_factory = None
+                if self.spill_store is not None and payload and payload["entries"]:
+                    # round-trip THROUGH the persistence store so an
+                    # operator restart (or an out-of-process replica)
+                    # boots from the same payload this scale-up used — but
+                    # a store outage (disk full, redis down) must not
+                    # abort the scale-up: the in-memory payload in hand
+                    # still warm-boots the replica
+                    try:
+                        self.spill_store.save(
+                            spill_key(self._deployment), pickle.dumps(payload)
+                        )
+                        raw = self.spill_store.load(spill_key(self._deployment))
+                        if raw is not None:
+                            payload = pickle.loads(raw)
+                    except Exception:  # noqa: BLE001 - degraded, not fatal
+                        log.exception(
+                            "replica spill store round-trip failed — "
+                            "scale-up continues with the in-process payload"
+                        )
+            loop = asyncio.get_running_loop()
+            new = await loop.run_in_executor(
+                None, self._build_warm_replica, rid, payload
+            )
+            self.replicas.append(new)
+            self.balancer.add_arm()
+            self.stat_scale_ups += 1
+            self._metrics.router_replicas(self._deployment, len(self.replicas))
+            log.info(
+                "decode autoscale: replica %s up in %.1fs (queue depth %s, "
+                "preseeded entries so far: %s)",
+                rid,
+                time.perf_counter() - t0,
+                self.autoscale_queue_depth,
+                self.stat_preseeded_entries,
+            )
+        except Exception:  # noqa: BLE001 - a failed scale-up must not kill serving
+            log.exception("decode autoscale: replica boot failed")
+        finally:
+            self._scaling = False
+            self._scale_task = None
+
+    # ------------------------------------------------------------- audits
+    def allocator_audits(self) -> None:
+        """Per-replica pool-consistency audits (soak/test gate)."""
+        for r in self.replicas:
+            r.pool.alloc.check()

@@ -187,6 +187,10 @@ from seldon_core_tpu.parallel.tp import (
     kv_sharding,
     tree_node_sharding,
 )
+from seldon_core_tpu.serving.affinity_router import (
+    capture_prefix_len,
+    usable_prefix_len,
+)
 from seldon_core_tpu.serving.kv_pool import PagedKVPool
 
 log = logging.getLogger(__name__)
@@ -806,6 +810,7 @@ class DecodeScheduler:
         slo_itl_ms: float = 0.0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
+        replica_id: int = 0,
         dtype=jnp.float32,
     ):
         if n_slots < 1:
@@ -836,6 +841,10 @@ class DecodeScheduler:
         self.queue_timeout_s = float(queue_timeout_s)
         self._metrics = metrics or NullMetrics()
         self._deployment = deployment_name
+        # which replica of a scale-out fleet this scheduler is (0 on
+        # single-scheduler deployments) — rides the flight recorder into
+        # /decode/health so the affinity router can address it
+        self.replica_id = int(replica_id)
         self._dtype = dtype
         self._seed = np.int32(seed)
         # monotonically increasing RNG tick, folded into the seed key
@@ -1185,6 +1194,18 @@ class DecodeScheduler:
         # executor's _settle_to_host. CPU-backend calls are the compute
         # itself and gain nothing from the hop.
         self._host_backend = all(d.platform == "cpu" for d in jax.devices())
+        # multi-replica fleets override the CPU-backend inline-dispatch
+        # default: each replica's dispatches hop to the shared compute pool
+        # (XLA releases the GIL during execution) so N replicas' device
+        # work genuinely overlaps instead of serializing on the one event
+        # loop — the same rationale as offload_compute for co-hosted
+        # tenants. Single schedulers keep the inline fast path (the hop
+        # buys nothing when there is nothing to overlap with).
+        self._offload_dispatch = False
+        # fleet replicas get a DEDICATED single-thread dispatch executor
+        # (one dispatch stream per replica — the in-process twin of one
+        # engine thread per pod); None falls back to the shared pool
+        self._dispatch_pool = None
         self._slots: list[_Seq | None] = [None] * n_slots
         self._free: list[int] = list(range(n_slots - 1, -1, -1))
         self._waiting: collections.deque[_Seq] = collections.deque()
@@ -1220,6 +1241,8 @@ class DecodeScheduler:
         self.stat_prefix_tokens_saved = 0
         self.stat_prefix_captures = 0
         self.stat_prefix_capture_skips = 0
+        # entries pre-seeded from another replica's spill at warm boot
+        self.stat_prefix_preseeded = 0
         self.stat_chunk_dispatches = 0
         # paged-pool attribution (the allocator owns the counters; these
         # track what the scheduler itself dispatched/declined)
@@ -1248,8 +1271,12 @@ class DecodeScheduler:
                 name=deployment_name or "decode",
                 slo_ttft_ms=float(slo_ttft_ms),
                 slo_itl_ms=float(slo_itl_ms),
+                replica_id=self.replica_id,
             )
         )
+        # live O(1) queue-depth read for /decode/health — what the replica
+        # router's bounded-load shed polls
+        self.flight.queue_depth_source = lambda: len(self._waiting)
         # per-round host-phase timer (telemetry/flight.py PHASES): every
         # host segment of the loop runs under `with self._phase(P_X):` so
         # the frame's gap decomposes into admission / alloc / scatter /
@@ -1476,6 +1503,150 @@ class DecodeScheduler:
     @property
     def active(self) -> int:
         return self.n_slots - len(self._free)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting un-admitted — the autoscale/shed signal
+        (/decode/health ``queue_depth``)."""
+        return len(self._waiting)
+
+    # ---------------------------------------------- warm scale-up spill
+    def export_prefix_state(self, top_n: int = 0) -> dict | None:
+        """Spill the prefix cache's hottest entries — prompt tokens plus
+        their pool pages' bytes AS STORED (an int8 pool spills quantized
+        planes + scale/zp verbatim; no dequant round-trip) — so a new
+        replica can pre-seed its own pool (serving/affinity_router.py).
+        Ranked by how referenced each entry's pages are (allocator
+        refcounts: live sharers = heat), then index hits. ``top_n`` caps
+        the entries (0 = all). Returns None when the prefix cache is
+        off."""
+        if not self.prefix_enabled:
+            return None
+        alloc = self.pool.alloc
+        entries = sorted(
+            self._prefix_index.entries.values(),
+            key=lambda e: (
+                sum(int(alloc.refs[p]) for p in e.pages),
+                e.hits,
+                e.last_use,
+            ),
+            reverse=True,
+        )
+        if top_n > 0:
+            entries = entries[: int(top_n)]
+        # gather ONLY the selected entries' pages device-side and read
+        # back those slices — never the whole pool (a full-pool host copy
+        # is the entire KV cache's bytes, and the autoscale spill runs
+        # this on the serving loop at peak load by design)
+        return {
+            "page_size": self.pool.page_size,
+            "kv_dtype": self.pool.kv_dtype,
+            "entries": [
+                {
+                    "tokens": np.asarray(e.tokens, np.int32).copy(),
+                    "components": [
+                        np.asarray(comp[:, jnp.asarray(e.pages, jnp.int32)])
+                        for comp in self.pool.state
+                    ],
+                }
+                for e in entries
+            ],
+        }
+
+    def preseed_prefix_state(self, payload: dict | None) -> int:
+        """Pre-seed the page pool + prefix index from a spilled payload
+        (``export_prefix_state``), so this replica's FIRST shared-prompt
+        request admits on the warm TTFT path. Pure boot-time work: pages
+        come straight off the free list into prefix pins (reservation
+        invariant untouched), bytes land with one eager update per pool
+        component, and the arrays are re-committed to their existing
+        sharding so the warmed program signatures stay exactly the live
+        ones. Entries that don't fit this deployment's geometry are
+        skipped; pool pressure stops the walk. Returns entries seeded."""
+        if not self.prefix_enabled or not payload:
+            return 0
+        if (
+            payload.get("page_size") != self.pool.page_size
+            or payload.get("kv_dtype") != self.pool.kv_dtype
+        ):
+            log.warning(
+                "prefix spill geometry mismatch (page_size/kv_dtype) — "
+                "preseed skipped"
+            )
+            return 0
+        state = list(self.pool.state)
+        # stage every entry first, then apply ONE scatter per pool
+        # component: a per-entry .at[].set materializes a full component
+        # copy each time, multiplying boot time (and peak device memory)
+        # by the entry count on a real pool
+        staged: list[tuple[np.ndarray, object]] = []  # (span tokens, pin)
+        staged_bytes: list[list[np.ndarray]] = [[] for _ in state]
+        for entry in payload.get("entries", ()):
+            tokens = np.asarray(entry.get("tokens"), np.int32).reshape(-1)
+            comps = entry.get("components") or []
+            if len(comps) != len(state):
+                continue
+            # whole pages only: a partial tail page has no donor slot to
+            # copy-on-write from here, so clamp DOWN to the page boundary
+            # (the uncovered tail prefills — same as any partial hit)
+            length = capture_prefix_len(len(tokens), self.prefix_ctx, self.seq_len)
+            length = (length // self.pool.page_size) * self.pool.page_size
+            n_pages = self.pool.alloc.pages_for(length)
+            if n_pages < 1:
+                continue
+            span = tokens[:length]
+            _, depth = self._prefix_index.match(span, touch=False)
+            if depth >= length or any(
+                len(t) >= length and np.array_equal(t[:length], span)
+                for t, _ in staged
+            ):
+                continue  # already covered (existing or staged entry)
+            # every axis validated BEFORE the pin allocation — including
+            # the page axis on every sibling component (a truncated/
+            # corrupt spill must be SKIPPED per the contract, not raise
+            # out of the boot with a pin leaked)
+            ok = True
+            entry_bytes = []
+            for ci, dst in enumerate(state):
+                full = np.asarray(comps[ci])
+                if (
+                    full.ndim != len(dst.shape)
+                    or full.shape[0] != dst.shape[0]
+                    or full.shape[1] < n_pages
+                    or full.shape[2:] != tuple(dst.shape[2:])
+                    or full.dtype != dst.dtype
+                ):
+                    ok = False
+                    break
+                entry_bytes.append(full[:, :n_pages])
+            if not ok:
+                continue
+            pin = self.pool.alloc.preseed_pin(n_pages)
+            if pin is None:
+                break  # free list exhausted — stop seeding, keep serving
+            staged.append((span, pin))
+            for ci, src in enumerate(entry_bytes):
+                staged_bytes[ci].append(src)
+        if not staged:
+            return 0
+        pages = np.asarray(
+            [p for _, pin in staged for p in pin.pages], np.int64
+        )
+        for ci, dst in enumerate(state):
+            src = np.concatenate(staged_bytes[ci], axis=1)
+            state[ci] = jax.device_put(
+                dst.at[:, pages].set(jnp.asarray(src)), dst.sharding
+            )
+        self.pool.state = tuple(state)
+        for span, pin in staged:
+            _, evicted = self._prefix_index.insert(span, pin.pages, pin.pin_id)
+            if evicted is not None:
+                self.pool.alloc.release(evicted.pin_id)
+                self._metrics.decode_prefix_evicted(self._deployment)
+        self.stat_prefix_preseeded += len(staged)
+        self._metrics.router_preseed(self._deployment, int(len(pages)))
+        self._kv_gauges()
+        return len(staged)
 
     async def submit(
         self,
@@ -1723,7 +1894,7 @@ class DecodeScheduler:
         gone). Called at prefill completion for hinted captures
         (meta.tags.cache_prefix — the prefix K/V exists from that moment)
         and at retirement for the automatic full-prompt policy."""
-        length = min(length, self.prefix_ctx, self.seq_len)
+        length = capture_prefix_len(length, self.prefix_ctx, self.seq_len)
         if length < 1:
             return
         _, depth = self._prefix_index.match(seq.prompt, touch=False)
@@ -1796,12 +1967,17 @@ class DecodeScheduler:
 
     async def _device_call(self, fn):
         """Run a device dispatch + readback off the event loop on accel
-        backends (XLA releases the GIL); inline on the CPU backend."""
-        if self._host_backend:
+        backends (XLA releases the GIL); inline on the CPU backend —
+        unless this scheduler is one replica of a fleet, whose dispatches
+        must overlap the siblings' (``_offload_dispatch``)."""
+        if self._host_backend and not self._offload_dispatch:
             return fn()
         from seldon_core_tpu.models.base import compute_pool
 
-        return await asyncio.get_running_loop().run_in_executor(compute_pool(), fn)
+        pool = self._dispatch_pool
+        return await asyncio.get_running_loop().run_in_executor(
+            pool if pool is not None else compute_pool(), fn
+        )
 
     # --------------------------------------------------- round flight frame
     def _round_reset(self, t_ns: int | None = None) -> None:
@@ -1964,10 +2140,12 @@ class DecodeScheduler:
         if self.prefix_enabled:
             with self._phase(P_PREFIX_MATCH):
                 entry, depth = self._prefix_index.match(seq.prompt)
-            # always leave >= 1 suffix token: the last prompt
-            # position's logits are the first generated token's
-            # distribution
-            reuse = min(depth, self.seq_len - 1)
+            # the shared prompt->prefix normalization (affinity_router):
+            # always leave >= 1 suffix token — the last prompt position's
+            # logits are the first generated token's distribution. The
+            # replica router normalizes the SAME way, so a prompt it
+            # judged warm is one admission judges warm too.
+            reuse = usable_prefix_len(depth, self.seq_len)
             if reuse <= 0:
                 entry = None
         # a cache_prefix hint pins pages at prefill completion; if the
@@ -2316,7 +2494,7 @@ class DecodeScheduler:
                 # loop instead of silently paying a full prefill.
                 with self._phase(P_PREFIX_MATCH):
                     _, depth = self._prefix_index.match(p.seq.prompt, touch=False)
-                if min(depth, self.seq_len - 1) > reuse:
+                if usable_prefix_len(depth, self.seq_len) > reuse:
                     self.pool.alloc.retire(p.slot)  # undo the shallow mapping
                     entry, reuse, ok = self._admit_decide(p.seq, p.slot)
                     if not ok:
@@ -3279,8 +3457,7 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
                 mesh_axes, "; ".join(problems),
             )
             mesh_axes = {}
-    return DecodeScheduler(
-        runtime.params,
+    sched_kwargs = dict(
         seq_len=int(gen["seq"]),
         max_new_tokens=int(gen["max_new_tokens"]),
         n_slots=int(tpu_spec.decode_slots),
@@ -3289,7 +3466,6 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         top_k=int(getattr(tpu_spec, "decode_top_k", 0)),
         seed=int(getattr(tpu_spec, "decode_seed", 0)),
         queue_timeout_s=float(getattr(tpu_spec, "queue_timeout_ms", 0.0)) / 1000.0,
-        draft_params=draft_params,
         spec_k=spec_k if draft_params is not None else 0,
         spec_tree=spec_tree if draft_params is not None else "",
         spec_accept_floor=float(getattr(tpu_spec, "decode_spec_accept_floor", 0.0)),
@@ -3299,10 +3475,95 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         kv_page_size=int(getattr(tpu_spec, "decode_kv_page_size", 0)),
         kv_pages=int(getattr(tpu_spec, "decode_kv_pages", 0)),
         kv_dtype=str(getattr(tpu_spec, "decode_kv_dtype", "") or ""),
-        mesh_axes=mesh_axes,
         slo_ttft_ms=float(getattr(tpu_spec, "decode_slo_ttft_ms", 0.0)),
         slo_itl_ms=float(getattr(tpu_spec, "decode_slo_itl_ms", 0.0)),
         metrics=metrics,
-        deployment_name=deployment_name,
         dtype=runtime.dtype,
+    )
+    replicas = max(1, int(getattr(tpu_spec, "decode_replicas", 1) or 1))
+    autoscale_max = int(getattr(tpu_spec, "decode_autoscale_replicas", 0) or 0)
+    if max(replicas, autoscale_max) > 1 and mesh_axes:
+        # replica scale-out and tensor parallelism partition the same
+        # device budget; composing them (TP groups per replica) is future
+        # work — the warn-disable precedent keeps a stale CR serving
+        log.warning(
+            "decode_replicas/decode_autoscale_replicas with decode_mesh_axes "
+            "is not supported yet — running one tensor-parallel scheduler"
+        )
+        replicas, autoscale_max = 1, 0
+    if max(replicas, autoscale_max) <= 1:
+        return DecodeScheduler(
+            runtime.params,
+            draft_params=draft_params,
+            mesh_axes=mesh_axes,
+            deployment_name=deployment_name,
+            **sched_kwargs,
+        )
+
+    # multi-replica decode scale-out (serving/affinity_router.py): N full
+    # scheduler replicas — each with its own params copy, page pool, and
+    # prefix index on its own device (round-robin over the attached
+    # devices: N replicas = N independent dispatch streams) — behind the
+    # prefix-affinity router with the reward-driven fallback policy.
+    import os
+
+    from seldon_core_tpu.serving.affinity_router import ReplicatedDecodeScheduler
+    from seldon_core_tpu.persistence.state import make_state_store
+    from seldon_core_tpu.utils import env as envmod
+
+    base_name = deployment_name or "decode"
+    devices = jax.devices()
+    target_params = runtime.params
+
+    def _replica_factory(i: int) -> DecodeScheduler:
+        # EVERY replica (0 included) gets its own single-device params
+        # copy: replica i lives wholly on device i (mod host size). The
+        # runtime's own placement may span the deployment mesh — a replica
+        # dispatching replicated over N devices would serialize the whole
+        # fleet through every device
+        dev = devices[i % len(devices)]
+        p = jax.device_put(target_params, dev)
+        dp = None if draft_params is None else jax.device_put(draft_params, dev)
+        return DecodeScheduler(
+            p,
+            draft_params=dp,
+            deployment_name=f"{base_name}/r{i}",
+            replica_id=i,
+            **sched_kwargs,
+        )
+
+    store_factory = None
+    if autoscale_max > replicas:
+        # spill through the persistence store — SAME default as the
+        # microservice's unit-state persistence (file://./.seldon_state),
+        # so an operator restart (or an out-of-process replica) boots
+        # from the payload the last scale-up wrote. Resolved lazily at
+        # the first spill (the file store's ctor mkdirs its directory).
+        spill_url = os.environ.get(
+            envmod.PERSISTENCE_STORE, "file://./.seldon_state"
+        )
+
+        def store_factory():
+            try:
+                return make_state_store(spill_url)
+            except ValueError:
+                log.warning(
+                    "PERSISTENCE_STORE %r unusable — replica spill stays "
+                    "in-process", spill_url,
+                )
+                return None
+
+    return ReplicatedDecodeScheduler(
+        _replica_factory,
+        replicas,
+        policy=str(getattr(tpu_spec, "decode_router_policy", "") or ""),
+        affinity_block=int(getattr(tpu_spec, "decode_kv_page_size", 0) or 0) or 16,
+        autoscale_replicas=autoscale_max,
+        autoscale_queue_depth=int(
+            getattr(tpu_spec, "decode_autoscale_queue_depth", 0) or 0
+        ),
+        spill_store_factory=store_factory,
+        metrics=metrics,
+        deployment_name=base_name,
+        seed=int(getattr(tpu_spec, "decode_seed", 0)),
     )

@@ -315,6 +315,28 @@ class PageAllocator:
         self._pins[pin.pin_id] = pin
         return pin
 
+    def preseed_pin(self, n: int) -> PoolPin | None:
+        """Allocate ``n`` free pages directly into a prefix pin (warm
+        scale-up: a new replica's pool is seeded from another replica's
+        spilled pages before it serves traffic — serving/affinity_router).
+        Returns None when the free list cannot cover it. The reservation
+        invariant holds unchanged: the pages leave the free list but enter
+        the pin-only (reclaimable) set, so ``free + reclaimable`` is
+        constant."""
+        n = int(n)
+        if n < 1 or n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        pin = PoolPin(self._next_pin, pages)
+        self._next_pin += 1
+        self._clock += 1
+        pin.last_use = self._clock
+        for p in pages:
+            self.refs[p] = 1
+            self.pin_count[p] = 1
+        self._pins[pin.pin_id] = pin
+        return pin
+
     def touch(self, pin_id: int) -> None:
         pin = self._pins.get(pin_id)
         if pin is not None:

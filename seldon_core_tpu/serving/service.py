@@ -7,6 +7,7 @@ Parity: reference engine PredictionService.java (:52-57 puid assignment,
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 
 from seldon_core_tpu.core.codec_npy import array_from_npy, is_npy, npy_from_array
@@ -20,6 +21,8 @@ from seldon_core_tpu.serving.batcher import MicroBatcher
 from seldon_core_tpu.telemetry import get_tracer
 from seldon_core_tpu.telemetry.access_log import enabled as access_log_enabled
 from seldon_core_tpu.telemetry.access_log import log_request
+
+log = logging.getLogger(__name__)
 
 
 def mirror_npy_kind(out: SeldonMessage) -> SeldonMessage:
@@ -111,6 +114,15 @@ class PredictionService:
         # (serving/decode_scheduler.py) — feeds per-token streaming and the
         # batcher's generative handoff; None for every other deployment
         self.decode_scheduler = decode_scheduler
+        # automatic reward loop closure (serving/affinity_router.py): when
+        # the graph contains a router that consumes SLO feedback (the
+        # PREFIX_AFFINITY builtin marks itself), responses carrying
+        # meta.tags.slo verdicts are replayed down the Feedback path as
+        # rewards — no client change needed
+        self._slo_feedback_graph = any(
+            getattr(n.unit, "consumes_slo_feedback", False)
+            for n in executor.root.walk()
+        )
 
     def _request_deadline(self, msg: SeldonMessage) -> Deadline | None:
         """The request's deadline budget: the deployment default
@@ -245,6 +257,7 @@ class PredictionService:
                     request_path=dict(out.meta.request_path),
                 )
             )
+        self._maybe_slo_feedback(out)
         if npy_requested:
             out = mirror_npy_kind(out)
         self.metrics.ingress_request(
@@ -254,6 +267,49 @@ class PredictionService:
             trace_id=buf.trace_id if buf is not None else None,
         )
         return out
+
+    def _maybe_slo_feedback(self, out: SeldonMessage) -> None:
+        """Close the reward loop automatically: a response carrying per-row
+        ``meta.tags.slo`` verdicts (the decode tier stamps them, PR 9) is
+        replayed as a reward with NO client involvement —
+
+        - to the replicated decode tier's bandit arms via the per-row
+          ``meta.tags.replica`` it stamped (``ingest_feedback`` reads the
+          per-row verdicts directly), and
+        - down the graph's Feedback path when a router consumes SLO
+          feedback (PREFIX_AFFINITY), rewarded with the met-fraction,
+          fire-and-forget so the caller never waits on its own reward.
+
+        Requests with no SLO judgment (or graphs with nothing consuming
+        rewards) cost one dict lookup."""
+        slo = out.meta.tags.get("slo")
+        if not isinstance(slo, (list, tuple)) or not slo:
+            return
+        sched = self.decode_scheduler
+        if (
+            sched is not None
+            and hasattr(sched, "ingest_feedback")
+            and "replica" in out.meta.tags
+        ):
+            try:
+                # use_slo: the automatic sink rewards each row from its
+                # own SLO verdict (a client's explicit reward — including
+                # an explicit 0.0 down-vote — is always honored verbatim)
+                sched.ingest_feedback(Feedback(response=out), use_slo=True)
+            except Exception:  # noqa: BLE001 - rewards must not fail serving
+                log.exception("automatic SLO feedback (replica arms) failed")
+        if self._slo_feedback_graph:
+            met = sum(1.0 for v in slo if v == "met") / len(slo)
+            task = asyncio.ensure_future(
+                self.executor.send_feedback(Feedback(response=out, reward=met))
+            )
+            task.add_done_callback(
+                lambda t: t.cancelled()
+                or (
+                    t.exception()
+                    and log.warning("automatic SLO feedback failed: %s", t.exception())
+                )
+            )
 
     async def predict_stream(
         self,
@@ -419,6 +475,13 @@ class PredictionService:
                 },
             ) as buf:
                 await self.executor.send_feedback(feedback)
+                # replicated decode tier: a response that was served by
+                # replica arms (meta.tags.replica) routes the client's
+                # reward back to them — the Feedback API reaches the
+                # router even though it is not a graph node
+                sched = self.decode_scheduler
+                if sched is not None and hasattr(sched, "ingest_feedback"):
+                    sched.ingest_feedback(feedback)
         except APIException as e:
             status = e.error.http_status
             raise

@@ -410,9 +410,17 @@ class FlightRecorder:
         slo_ttft_ms: float = 0.0,
         slo_itl_ms: float = 0.0,
         dump_interval_s: float = 5.0,
+        replica_id: int = 0,
     ):
         self.name = name or "decode"
         self.n_slots = max(int(n_slots), 1)
+        # multi-replica decode scale-out (serving/affinity_router.py): which
+        # replica of its deployment this recorder observes, and a live O(1)
+        # queue-depth read the affinity router's bounded-load shed polls
+        # through /decode/health (None falls back to the last frame's
+        # queued count)
+        self.replica_id = int(replica_id)
+        self.queue_depth_source = None
         self.capacity = int(capacity) or _env_capacity()
         self.enabled = flight_enabled() if enabled is None else bool(enabled)
         self.slo_ttft_ms = float(slo_ttft_ms)
@@ -723,10 +731,22 @@ class FlightRecorder:
         ) <= self.HEALTH_WINDOW
         if recently_breached and status == "ok":
             status = "breaching"
+        queue_depth = last.queued if last is not None else 0
+        if self.queue_depth_source is not None:
+            try:
+                queue_depth = int(self.queue_depth_source())
+            except Exception:  # noqa: BLE001 - a health read must never raise
+                pass
         out = {
             "name": self.name,
             "status": status,
             "enabled": self.enabled,
+            # O(1) reads the replica router polls: which replica this is
+            # and how deep its un-admitted queue runs RIGHT NOW (live
+            # source when the scheduler registered one, else the last
+            # committed frame)
+            "replica_id": self.replica_id,
+            "queue_depth": queue_depth,
             "rounds": rounds,
             "occupancy_mean": round(self.occupancy_sum / rounds, 4) if rounds else 0.0,
             "bubble_fraction": round(self.bubble_fraction(), 4),

@@ -62,6 +62,7 @@ async def soak(
     prefix_share: float = 0.0,
     paged: bool = False,
     tp: int = 0,
+    replicas: int = 0,
     profile_out: str = "",
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
@@ -88,7 +89,20 @@ async def soak(
         paged = True
         if prefix_share <= 0:
             prefix_share = 0.6
-    generative = spec_k > 0 or bool(spec_tree) or prefix_share > 0 or paged or tp > 1
+    if replicas > 1:
+        # the replica soak's point is prefix-AFFINITY routing across the
+        # fleet: it needs the paged pool's small page size (the affinity
+        # key is one page of tokens — the default 16-token block exceeds
+        # the soak's short prompts) and a shared-prefix traffic mix
+        if tp > 1:
+            raise RuntimeError("soak --replicas does not compose with --tp")
+        paged = True
+        if prefix_share <= 0:
+            prefix_share = 0.6
+    generative = (
+        spec_k > 0 or bool(spec_tree) or prefix_share > 0 or paged or tp > 1
+        or replicas > 1
+    )
     if generative:
         if model != "iris_mlp":
             import sys as _sys
@@ -160,6 +174,20 @@ async def soak(
                 decode_kv_pages=budget,
                 decode_prefill_chunk=ps,
             )
+        if replicas > 1:
+            predictor_extra["tpu"].update(
+                decode_replicas=replicas,
+                decode_router_policy="affinity",
+            )
+            # pin headroom on top of the deliberately-tight paged budget:
+            # the replica soak asserts the fleet HIT RATE, and a budget
+            # that reclaims prefix pins as fast as groups capture would
+            # fail that assert for allocator reasons, not routing ones
+            ps = predictor_extra["tpu"]["decode_kv_page_size"]
+            pin_pages = -(-max(1, features // 2) // ps)
+            predictor_extra["tpu"]["decode_kv_pages"] += (
+                4 * replicas * pin_pages + 2
+            )
     if fault_spec is not None:
         # the faulted leg exercises the resilience layer end-to-end: the
         # model node gets a retry policy (absorbing injected transport
@@ -221,22 +249,29 @@ async def soak(
             lag_samples.append(window_max_lag * 1e3)
 
     payload_fn = None
+    shared_sent = {"n": 0}
+    n_groups = 4 * replicas if replicas > 1 else 1
     if prefix_share > 0:
         # prompt mix: `prefix_share` of requests open with a fixed system
         # prefix (half the prompt bucket) + a random tail, the rest are
         # fully random — retiring slots auto-capture full prompts, and the
         # radix index's longest-common-prefix match turns ANY captured
         # sharer into a hit for the next one; the random tails churn the
-        # LRU pool so eviction runs under load too
+        # LRU pool so eviction runs under load too. A replicated soak uses
+        # SEVERAL distinct system prefixes (4 per replica) so the affinity
+        # router has a keyspace to spread — one group would just pin one
+        # replica hot
         shared_len = max(1, features // 2)
-        system_prefix = [7] * shared_len
+        prefixes = [[7 + g] * shared_len for g in range(n_groups)]
 
         def payload_fn(rng):
             def tail(n):
                 return [rng.randrange(64) for _ in range(n)]
 
             if rng.random() < prefix_share:
-                prompt = system_prefix + tail(features - shared_len)
+                shared_sent["n"] += 1
+                g = rng.randrange(n_groups)
+                prompt = prefixes[g] + tail(features - shared_len)
             else:
                 prompt = tail(features)
             return {"data": {"ndarray": [prompt] * batch}}
@@ -312,26 +347,30 @@ async def soak(
             ),
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    fleet = getattr(sched, "replicas", None) if sched is not None else None
     paged_stats = None
     if paged and sched is not None:
-        a = sched.pool.alloc
+        pools = [r.pool for r in fleet] if fleet else [sched.pool]
+        allocs = [p.alloc for p in pools]
         paged_stats = {
-            "page_size": sched.pool.page_size,
-            "page_budget": sched.pool.n_pages,
+            "page_size": pools[0].page_size,
+            "page_budget": sum(p.n_pages for p in pools),
             "peak_slots": sched.stat_peak_active,
-            "pages_shared": a.stat_pages_shared,
-            "cow_copies": a.stat_cow_copies,
-            "pins_reclaimed": a.stat_pin_reclaims,
-            "pages_reclaimed": a.stat_reclaimed_pages,
+            "pages_shared": sum(a.stat_pages_shared for a in allocs),
+            "cow_copies": sum(a.stat_cow_copies for a in allocs),
+            "pins_reclaimed": sum(a.stat_pin_reclaims for a in allocs),
+            "pages_reclaimed": sum(a.stat_reclaimed_pages for a in allocs),
             "admit_blocked_rounds": sched.stat_admit_blocked_rounds,
-            "pages_free_end": a.free_pages,
-            "pages_live_end": a.live_pages,
-            "pages_prefix_end": a.prefix_pages,
+            "pages_free_end": sum(a.free_pages for a in allocs),
+            "pages_live_end": sum(a.live_pages for a in allocs),
+            "pages_prefix_end": sum(a.prefix_pages for a in allocs),
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
-        # end-of-run allocator audit: a soak that leaked or double-freed a
-        # page fails loudly here rather than reporting a green run
-        a.check()
+        # end-of-run allocator audit (per replica on a fleet): a soak that
+        # leaked or double-freed a page fails loudly here rather than
+        # reporting a green run
+        for a in allocs:
+            a.check()
     tp_stats = None
     if tp > 1:
         # a --tp soak that silently fell back to single-device (mesh
@@ -355,8 +394,82 @@ async def soak(
             "requested_tp": tp,
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    replica_stats = None
+    if replicas > 1:
+        # a --replicas soak that silently fell back to one scheduler
+        # (validation refused, spec dropped) must not report green
+        if fleet is None or len(fleet) < replicas:
+            raise RuntimeError(
+                f"soak --replicas {replicas}: replicated decode tier not "
+                f"built (got {0 if fleet is None else len(fleet)} replicas)"
+            )
+        hits = sched.stat_prefix_hits
+        misses = sched.stat_prefix_misses
+        lookups = max(hits + misses, 1)
+        # the analytic round-robin FLOOR for this mix, from the traffic
+        # the payload generator actually sent: shared rows can hit at
+        # best after their group's cold capture, and under round-robin
+        # EVERY replica pays its own capture per group — so round-robin's
+        # hit count is bounded by shared_rows - replicas * groups * batch
+        # (batch rows per request admit and look up individually).
+        # Affinity pays one capture per group fleet-wide; beating the
+        # floor is the point of keying the router on the radix prefix.
+        shared_rows = shared_sent["n"] * batch
+        cold_rows_per_group = batch  # one cold REQUEST (batch rows)
+        rr_cold = len(fleet) * n_groups * cold_rows_per_group
+        rr_floor = max(0.0, (shared_rows - rr_cold) / lookups)
+        agg_hit = hits / lookups
+        replica_stats = {
+            "replicas": len(fleet),
+            "policy": sched.policy,
+            "routes": dict(sched.balancer.stat_routes),
+            "aggregate_hit_rate": round(agg_hit, 3),
+            "rr_floor_hit_rate": round(rr_floor, 3),
+            "shared_requests_sent": shared_sent["n"],
+            "scale_ups": sched.stat_scale_ups,
+            "per_replica": [
+                {
+                    "replica_id": r.replica_id,
+                    "admitted": r.stat_admitted,
+                    "hits": r.stat_prefix_hits,
+                    "misses": r.stat_prefix_misses,
+                    "queue_depth_end": r.queue_depth,
+                }
+                for r in fleet
+            ],
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+        }
+        # fleet hit-rate above the round-robin floor — the affinity
+        # contract under a sustained mixed shared/divergent stream. Only
+        # judged when the mix sent enough shared traffic for the floor to
+        # separate from capture-race noise (a sparse short smoke records
+        # the numbers without asserting on them).
+        if shared_rows >= 4 * rr_cold and hits > 0:
+            if not agg_hit > rr_floor:
+                raise RuntimeError(
+                    f"soak --replicas: aggregate prefix hit rate {agg_hit:.3f} "
+                    f"did not clear the round-robin floor {rr_floor:.3f} — "
+                    "affinity routing is not keeping sharers co-located"
+                )
     flight_stats = None
-    if generative and sched is not None and getattr(sched, "flight", None):
+    if generative and fleet is not None:
+        # per-replica flight summaries (each replica owns its recorder;
+        # /decode/health serves the same per-replica rows live)
+        per_replica = []
+        for r in fleet:
+            agg = r.flight.aggregate()
+            per_replica.append(
+                {
+                    "name": r.flight.name,
+                    "replica_id": r.replica_id,
+                    "rounds": agg["rounds"],
+                    "occupancy_mean": agg["occupancy_mean"],
+                    "bubble_fraction": agg["bubble_fraction"],
+                    "goodput": agg["goodput"],
+                }
+            )
+        flight_stats = {"per_replica": per_replica}
+    elif generative and sched is not None and getattr(sched, "flight", None):
         # the flight recorder's aggregate beside the allocator audit: the
         # same bubble/occupancy/blocked-cause read-out GET /decode/flight
         # serves live, as an end-of-run summary
@@ -414,7 +527,7 @@ async def soak(
         # fails CI here instead of shipping as a quiet perf regression
         if (
             sched is not None
-            and sched._pipeline_on()
+            and getattr(sched, "_pipeline_on", lambda: False)()
             and flight_stats is not None
             and flight_stats.get("rounds")
         ):
@@ -480,6 +593,7 @@ async def soak(
         ) if lag_sorted else None,
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
+        **({"replicas": replica_stats} if replica_stats is not None else {}),
         **({"flight": flight_stats} if flight_stats is not None else {}),
         **({"profile": profile_stats} if profile_stats is not None else {}),
         **({"spec": spec_stats} if spec_stats is not None else {}),
@@ -560,6 +674,19 @@ def main(argv=None) -> None:
         "under 'tp' and the end-of-run allocator check runs as usual",
     )
     ap.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="run the soak against a REPLICATED generative deployment: N "
+        "decode-scheduler replicas behind the prefix-affinity router "
+        "(decode_replicas=N; implies --paged and a multi-group shared-"
+        "prefix mix, forces an N-device host platform when no accelerator "
+        "provides one); the report gains per-replica admissions/hits and "
+        "the routing split under 'replicas', every replica's allocator is "
+        "audited, and the aggregate prefix hit rate must clear the "
+        "analytic round-robin floor",
+    )
+    ap.add_argument(
         "--profile",
         default="",
         metavar="FILE",
@@ -579,11 +706,12 @@ def main(argv=None) -> None:
     )
     args = ap.parse_args(argv)
 
-    if args.tp > 1:
+    if args.tp > 1 or args.replicas > 1:
         # the host platform's device count is fixed at backend init — set
         # the flag before anything imports jax (harmless when a real
         # multi-chip backend is attached: the flag only shapes the CPU
-        # platform)
+        # platform). Replicas want one forced device each (the replica
+        # factory places replica i on device i).
         import os
         import sys as _sys
 
@@ -594,7 +722,8 @@ def main(argv=None) -> None:
         ):
             os.environ["XLA_FLAGS"] = (
                 flags
-                + f" --xla_force_host_platform_device_count={max(8, args.tp)}"
+                + " --xla_force_host_platform_device_count="
+                + str(max(8, args.tp, args.replicas))
             ).strip()
 
     def _run(fault_spec=None) -> dict:
@@ -612,6 +741,7 @@ def main(argv=None) -> None:
                 prefix_share=args.prefix_share,
                 paged=args.paged,
                 tp=args.tp,
+                replicas=args.replicas,
                 profile_out=args.profile,
             )
         )

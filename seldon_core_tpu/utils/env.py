@@ -78,6 +78,11 @@ ENGINE_DECODE_PIPELINE = "ENGINE_DECODE_PIPELINE"
 ENGINE_DECODE_PROFILE = "ENGINE_DECODE_PROFILE"
 ENGINE_DECODE_PROFILE_HZ = "ENGINE_DECODE_PROFILE_HZ"
 ENGINE_DECODE_PROFILE_TABLE = "ENGINE_DECODE_PROFILE_TABLE"
+# multi-replica decode scale-out (serving/affinity_router.py): "off"
+# disables warm pre-seeding of scale-up replicas from spilled prefix-pool
+# pages — new replicas then boot cold (diagnosis lever: isolates a preseed
+# regression from the routing policy). Default on.
+ENGINE_DECODE_REPLICA_PRESEED = "ENGINE_DECODE_REPLICA_PRESEED"
 
 
 def rest_timeouts(env: dict | None = None) -> tuple[float, float]:

@@ -224,6 +224,37 @@ def worst_case_full_record() -> dict:
                 "speedup_vs_tp1": 0.65,
             },
         },
+        "replicas": {
+            "scenario": {
+                "requests": 128, "groups": 8, "seq": 64, "shared_prefix": 56,
+                "max_new": 16, "n_slots_per_replica": 4, "host_cpus": 1,
+                "geometry": "paged+prefix, page_size 16, 2 replicas",
+            },
+            "single": {
+                "replicas": 1, "policy": "single", "tokens_per_sec": 440.68,
+                "hit_rate": 0.938, "prefill_tokens_saved": 6720,
+                "recompiles_after_warmup": 0,
+            },
+            "affinity": {
+                "replicas": 2, "policy": "affinity", "tokens_per_sec": 348.29,
+                "hit_rate": 0.914, "prefill_tokens_saved": 6552,
+                "recompiles_after_warmup": 0,
+                "routes": {"affinity": 113, "shed": 15, "fallback": 0,
+                           "round_robin": 0},
+            },
+            "round_robin": {
+                "replicas": 2, "policy": "round_robin",
+                "tokens_per_sec": 323.53, "hit_rate": 0.844,
+                "prefill_tokens_saved": 6048, "recompiles_after_warmup": 0,
+                "routes": {"affinity": 0, "shed": 0, "fallback": 0,
+                           "round_robin": 128},
+            },
+            "affinity_speedup_vs_single": 0.79,
+            "serialized_host": True,
+            "scale_floor_met": None,
+            "affinity_hit_delta": -0.024,
+            "outputs_identical": True,
+        },
         "tree": {
             "scenario": {
                 "requests": 24, "n_slots": 4, "seq": 32, "shared_prefix": 24,
@@ -405,13 +436,17 @@ def test_compact_record_carries_every_headline():
         "ftree_acc": 0.641,
         # tensor-parallel sub-leg: tokens/s per width (width order), the
         # widest leg's speedup + identity contract, recompiles all-zero
+        # tp_ttft/tp_itl (per-width latency rows, never gated) left with
+        # PR 15's byte-budget trim paying for the gen.replica pack
         "tp_w": [1, 2, 4],
         "tp_tok_s": [1388.41, 1101.33, 905.87],
-        "tp_ttft": [40.11, 51.72, 66.41],
-        "tp_itl": [22.18, 28.05, 35.92],
         "tp_speedup": 0.65,
         "tp_ident": True,
         "tp_rc": [0, 0, 0],
+        # multi-replica scale-out sub-leg, packed [affinity tok/s,
+        # speedup vs single, affinity hit rate, round-robin hit rate] —
+        # first three --compare-gated, rr documents the collapse
+        "replica": [348.29, 0.79, 0.914, 0.844],
     }
     assert c["bert_tflops"] == 35.21
     assert c["bert_mfu_pct"] == 61.77
