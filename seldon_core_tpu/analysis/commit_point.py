@@ -25,6 +25,13 @@ point. These rules keep that invariant structural:
   anywhere else — including mutating calls like ``.append``/``.clear``,
   which plain store analysis misses — re-opens the speculate-vs-commit
   drift the shadow state exists to prevent.
+- CP004: the replica lifecycle funnel (``self._replica_states`` — the
+  fleet's up/draining/evicted/down machine, serving/affinity_router.py)
+  gets the same discipline: in a class that defines the transition funnel
+  (``_set_replica_state``), the state list may be mutated ONLY by
+  ``__init__`` and the funnel itself — eligibility flips, lifecycle
+  metrics, and flight-recorder fields all hang off the transition, so a
+  write from anywhere else ships a half-applied transition.
 """
 
 from __future__ import annotations
@@ -52,6 +59,11 @@ _MUTATING_CALLS = frozenset(
         "setdefault", "sort", "reverse",
     )
 )
+
+# CP004: the replica lifecycle list and its single sanctioned funnel
+_LIFECYCLE_ATTR = "_replica_states"
+_LIFECYCLE_FUNNEL = "_set_replica_state"
+_LIFECYCLE_WRITERS = ("__init__", _LIFECYCLE_FUNNEL)
 
 
 def _self_attr_writes(stmt: ast.stmt) -> list[tuple[str, ast.AST]]:
@@ -109,6 +121,7 @@ class CommitPointPass:
         "CP001": "round-committed attribute mutated outside _commit_round/_round_reset",
         "CP002": "same self.* attribute written on both sides of an await without a lock",
         "CP003": "shadow/pending round state mutated outside the pipeline builders and _apply_pending",
+        "CP004": "replica lifecycle state mutated outside the _set_replica_state funnel",
     }
 
     def run(self, project: Project) -> list[Finding]:
@@ -164,6 +177,7 @@ class CommitPointPass:
                                     )
                                 )
         self._check_pending(pf, cls, methods, findings)
+        self._check_lifecycle(pf, cls, methods, findings)
         for m in methods:
             if isinstance(m, ast.AsyncFunctionDef):
                 self._check_async(pf, cls, m, findings)
@@ -213,6 +227,65 @@ class CommitPointPass:
                             "`_pipeline_take_*` accessor), or rename the "
                             "attribute out of the `_pending` namespace if "
                             "it is not shadow state"
+                        ),
+                        symbol=f"{cls.name}.{m.name}",
+                    )
+                )
+
+    # ------------------------------------------------------------ CP004
+    def _check_lifecycle(
+        self,
+        pf: ParsedFile,
+        cls: ast.ClassDef,
+        methods: list,
+        findings: list[Finding],
+    ) -> None:
+        # engages only on classes defining the transition funnel — a class
+        # that happens to name an attribute `_replica_states` without the
+        # state machine is left alone (the CP003 shape-gating pattern)
+        if not any(m.name == _LIFECYCLE_FUNNEL for m in methods):
+            return
+        for m in methods:
+            if m.name in _LIFECYCLE_WRITERS:
+                continue
+            sites: list[tuple[str, ast.AST]] = []
+            for node in ast.walk(m):
+                if isinstance(node, ast.stmt):
+                    sites += [
+                        (a, s)
+                        for a, s in _self_attr_writes(node)
+                        if a == _LIFECYCLE_ATTR
+                    ]
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATING_CALLS
+                        and isinstance(f.value, ast.Attribute)
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id == "self"
+                        and f.value.attr == _LIFECYCLE_ATTR
+                    ):
+                        sites.append((f.value.attr, node))
+            for attr, site in sites:
+                findings.append(
+                    Finding(
+                        rule="CP004",
+                        path=pf.path,
+                        line=site.lineno,
+                        col=site.col_offset,
+                        message=(
+                            f"`self.{attr}` is replica lifecycle state but "
+                            f"is mutated in `{cls.name}.{m.name}` — only "
+                            f"`{_LIFECYCLE_FUNNEL}` and `__init__` may "
+                            "write it (eligibility/metrics/flight fields "
+                            "hang off the transition; a direct write ships "
+                            "a half-applied one)"
+                        ),
+                        hint=(
+                            f"route the transition through "
+                            f"`{_LIFECYCLE_FUNNEL}` (it extends the list "
+                            "for new arms itself)"
                         ),
                         symbol=f"{cls.name}.{m.name}",
                     )

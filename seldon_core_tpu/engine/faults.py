@@ -161,6 +161,94 @@ class ChaosUnit(Unit):
         await self.inner.send_feedback(feedback, routing)
 
 
+@dataclasses.dataclass(frozen=True)
+class DecodeFaultSpec:
+    """Decode-tier fault profile for ONE scheduler replica.
+
+    Round ordinals are 1-based and count ACTIVE decode rounds from the
+    moment ``install_decode_faults`` runs, so a mid-soak installation kills
+    the replica's very next round with ``hang_at_round=1`` /
+    ``oom_at_round=1`` regardless of how long it has been serving. Probe
+    ordinals count ``health_probe`` calls the same way. Every decision is a
+    pure function of (spec, call ordinal): reruns replay the identical
+    fault sequence, which is what lets the migration oracle compare a
+    killed run against an uninterrupted one token for token.
+    """
+
+    hang_at_round: int = 0  # decode round that stalls (0 = never)
+    hang_s: float = 30.0  # how long the hung round sleeps
+    oom_at_round: int = 0  # round whose KV write hits an induced page-OOM
+    readback_stall_ms: float = 0.0  # added stall per device readback
+    stall_from_round: int = 0  # first round the readback stall applies (0 = never)
+    drop_health_from: int = 0  # first health probe to drop (0 = never)
+    drop_health_count: int = 0  # probes dropped from there (0 = all of them)
+    seed: int = 0
+
+
+class DecodeFaultState:
+    """Deterministic decode-tier fault driver (the continuous-batching twin
+    of FaultSchedule). The scheduler consults it at three hook points — top
+    of each active round, each device readback, and each health probe — and
+    the state counts those calls so decisions depend only on the spec and
+    the ordinal, never on wall clock."""
+
+    def __init__(self, spec: DecodeFaultSpec):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self.rounds = 0
+        self.probes = 0
+        self.injected = 0
+
+    def round_decision(self) -> FaultDecision:
+        with self._lock:
+            self.rounds += 1
+            s = self.spec
+            if s.hang_at_round > 0 and self.rounds == s.hang_at_round:
+                self.injected += 1
+                return FaultDecision("hang", s.hang_s)
+            if s.oom_at_round > 0 and self.rounds == s.oom_at_round:
+                self.injected += 1
+                return FaultDecision("oom")
+            return FaultDecision("ok")
+
+    def readback_stall_s(self) -> float:
+        with self._lock:
+            s = self.spec
+            if (
+                s.readback_stall_ms > 0
+                and s.stall_from_round > 0
+                and self.rounds >= s.stall_from_round
+            ):
+                self.injected += 1
+                return s.readback_stall_ms / 1000.0
+            return 0.0
+
+    def health_drop(self) -> bool:
+        with self._lock:
+            self.probes += 1
+            s = self.spec
+            if s.drop_health_from <= 0 or self.probes < s.drop_health_from:
+                return False
+            if (
+                s.drop_health_count > 0
+                and self.probes >= s.drop_health_from + s.drop_health_count
+            ):
+                return False
+            self.injected += 1
+            return True
+
+
+def install_decode_faults(scheduler, spec: DecodeFaultSpec) -> DecodeFaultState:
+    """Arm a DecodeScheduler (one fleet replica) with a decode-tier fault
+    profile. Mirrors install_faults: the scheduler keeps doing its real
+    work, the state object is returned so chaos tests can read
+    .rounds/.probes/.injected, and installing over a previous profile
+    replaces it (the soak kill flag installs mid-run)."""
+    state = DecodeFaultState(spec)
+    scheduler._faults = state
+    return state
+
+
 def install_faults(
     executor, faults: dict[str, FaultSpec], on_fault=None
 ) -> dict[str, FaultSchedule]:

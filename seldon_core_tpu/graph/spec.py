@@ -389,6 +389,23 @@ class TpuSpec(_Spec):
     # disables autoscale.
     decode_autoscale_replicas: int = 0
     decode_autoscale_queue_depth: int = 0
+    # Fleet health/eviction (serving/affinity_router.py): poll each
+    # replica's /decode/health probe every decode_health_poll_ms; a
+    # replica missing decode_health_miss_threshold consecutive probes
+    # (exception, dropped response, or active slots with a stagnant tick
+    # counter — a hung dispatch loop answers host-side probes) trips its
+    # per-replica breaker: it leaves rendezvous ranking, its in-flight
+    # generations migrate to surviving replicas (teacher-forced replay
+    # from the last committed token — bit-identical resume), and it is
+    # readmitted through the breaker's half-open probe once it answers
+    # again. 0 (default) disables polling; request-path crash eviction
+    # still works without it.
+    decode_health_poll_ms: float = 0.0
+    decode_health_miss_threshold: int = 3
+    # Graceful drain budget (drain_replica/scale_down): how long a
+    # draining replica may finish in-flight work before the remainder is
+    # migrated and its device released.
+    decode_drain_timeout_ms: float = 5000.0
     # Decode-loop SLO targets (serving/decode_scheduler.py + telemetry/
     # flight.py): per-request TTFT / inter-token-latency budgets in ms the
     # goodput/attainment telemetry is judged against. 0 (default) = not

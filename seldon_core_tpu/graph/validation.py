@@ -353,6 +353,25 @@ def validate_deployment(dep: SeldonDeployment) -> None:
                 "decode_replicas > 1 or decode_autoscale_replicas > 1 "
                 "(one replica leaves nothing to route)"
             )
+        if pred.tpu.decode_health_poll_ms < 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_health_poll_ms must be >= 0"
+            )
+        if pred.tpu.decode_health_miss_threshold < 1:
+            problems.append(
+                f"predictor '{pred.name}' decode_health_miss_threshold must "
+                "be >= 1 (zero would evict on the first poll)"
+            )
+        if pred.tpu.decode_drain_timeout_ms < 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_drain_timeout_ms must be >= 0"
+            )
+        if pred.tpu.decode_health_poll_ms > 0 and fleet_max <= 1:
+            problems.append(
+                f"predictor '{pred.name}' decode_health_poll_ms needs "
+                "decode_replicas > 1 or decode_autoscale_replicas > 1 (a "
+                "single replica has no surviving arm to evict onto)"
+            )
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

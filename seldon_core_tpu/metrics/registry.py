@@ -199,6 +199,32 @@ class NullMetrics:
         spill."""
         pass
 
+    # fleet health / fault tolerance (serving/affinity_router.py): the
+    # replica lifecycle funnel (up -> evicted -> up, up -> draining ->
+    # down) plus the failure counters chaos runs assert on
+    def replica_state(self, deployment: str, replica: int, state: str) -> None:
+        """Lifecycle gauge: 0=up 1=draining 2=evicted 3=down."""
+        pass
+
+    def replica_eviction(self, deployment: str) -> None:
+        pass
+
+    def replica_recovery(self, deployment: str) -> None:
+        pass
+
+    def replica_drain(self, deployment: str) -> None:
+        pass
+
+    def replica_migration(self, deployment: str, n: int) -> None:
+        """n in-flight generations migrated off a dead/draining replica."""
+        pass
+
+    def replica_boot_failure(self, deployment: str) -> None:
+        pass
+
+    def replica_spill_failure(self, deployment: str) -> None:
+        pass
+
     def compile(self, deployment: str, bucket: int, duration_s: float) -> None:
         pass
 
@@ -524,6 +550,50 @@ class Metrics(NullMetrics):
             ["deployment_name"],
             registry=registry,
         )
+        # fleet health / fault tolerance (serving/affinity_router.py): the
+        # replica lifecycle funnel plus the counters chaos runs assert on
+        self._replica_state = Gauge(
+            "seldon_tpu_replica_state",
+            "Decode replica lifecycle state (0=up 1=draining 2=evicted 3=down)",
+            ["deployment_name", "replica"],
+            registry=registry,
+        )
+        self._replica_evictions = Counter(
+            "seldon_tpu_replica_evictions_total",
+            "Decode replicas evicted from routing (health breaker opened)",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._replica_recoveries = Counter(
+            "seldon_tpu_replica_recoveries_total",
+            "Evicted decode replicas readmitted via half-open probe",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._replica_drains = Counter(
+            "seldon_tpu_replica_drains_total",
+            "Decode replicas gracefully drained and released",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._replica_migrations = Counter(
+            "seldon_tpu_replica_migrations_total",
+            "In-flight generations migrated off dead/draining replicas",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._replica_boot_failures = Counter(
+            "seldon_tpu_replica_boot_failures_total",
+            "Scale-up replica boots that failed",
+            ["deployment_name"],
+            registry=registry,
+        )
+        self._replica_spill_failures = Counter(
+            "seldon_tpu_replica_spill_failures_total",
+            "Prefix-spill store/preseed round-trips that failed",
+            ["deployment_name"],
+            registry=registry,
+        )
         # SHADOW router candidate validation: per-shadow-child prediction
         # agreement with the primary (argmax match on classifier outputs)
         self._shadow = Counter(
@@ -697,6 +767,32 @@ class Metrics(NullMetrics):
 
     def router_preseed(self, deployment, pages):
         self._router_preseed.labels(deployment).inc(pages)
+
+    def replica_state(self, deployment, replica, state):
+        from seldon_core_tpu.serving.affinity_router import replica_state_value
+
+        self._replica_state.labels(deployment, str(replica)).set(
+            replica_state_value(state)
+        )
+
+    def replica_eviction(self, deployment):
+        self._replica_evictions.labels(deployment).inc()
+
+    def replica_recovery(self, deployment):
+        self._replica_recoveries.labels(deployment).inc()
+
+    def replica_drain(self, deployment):
+        self._replica_drains.labels(deployment).inc()
+
+    def replica_migration(self, deployment, n):
+        if n > 0:
+            self._replica_migrations.labels(deployment).inc(n)
+
+    def replica_boot_failure(self, deployment):
+        self._replica_boot_failures.labels(deployment).inc()
+
+    def replica_spill_failure(self, deployment):
+        self._replica_spill_failures.labels(deployment).inc()
 
     def compile(self, deployment, bucket, duration_s):
         self._compile.labels(deployment, str(bucket)).observe(duration_s)

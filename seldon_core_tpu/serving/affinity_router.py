@@ -38,6 +38,22 @@ generative tier:
   the stored bytes + scale planes verbatim — no dequant round-trip) through
   ``persistence/state.py`` and pre-seeds them into the new replica's pool,
   so its FIRST shared-prompt request already rides the warm TTFT path.
+- **Fault tolerance**: a health poller probes every replica each interval
+  (``health_probe`` in-process; GET /decode/health out-of-process), feeds
+  the polled ``queue_depth`` into the balancer, and counts consecutive
+  misses into a per-replica circuit breaker (engine/resilience.py
+  semantics). A breaker that opens EVICTS the replica from rendezvous
+  ranking and MIGRATES its in-flight generations: each tracked request
+  resubmits on a surviving replica with the tokens it already streamed as
+  a teacher-forced replay, so the client's stream resumes at the next
+  token — bit-identical to an uninterrupted greedy run, no duplicates, no
+  gaps. A half-open probe readmits the replica once it answers again.
+  ``drain_replica``/``scale_down`` is the graceful inverse of scale-up:
+  stop admission, let in-flight work finish (migrating stragglers), spill
+  the refcount-ranked prefix pages to the store AND push them to their new
+  rendezvous homes, then release the device. Every replica state write
+  goes through the ``_set_replica_state`` funnel (lint-enforced single
+  writer, the PR 10/13 pattern).
 
 Everything here is host-side policy — no device programs, no new compile
 ladders. The replicas' fused program sets are untouched; the tier's greedy
@@ -58,6 +74,13 @@ import numpy as np
 
 from seldon_core_tpu.core.errors import APIException, ErrorCode
 from seldon_core_tpu.core.message import Meta, SeldonMessage
+from seldon_core_tpu.engine.resilience import (
+    CLOSED,
+    OPEN,
+    CircuitBreaker,
+    current_deadline,
+)
+from seldon_core_tpu.graph.spec import BreakerSpec
 from seldon_core_tpu.metrics import NullMetrics
 
 log = logging.getLogger(__name__)
@@ -69,6 +92,34 @@ DEFAULT_AFFINITY_BLOCK = 16
 
 ROUTER_POLICIES = ("affinity", "round_robin", "bandit")
 FALLBACK_POLICIES = ("epsilon_greedy", "thompson")
+
+# replica lifecycle states (the drain/eviction funnel — every write goes
+# through ReplicatedDecodeScheduler._set_replica_state, single-writer by
+# lint CP004):
+#   up --(breaker opens)--> evicted --(half-open probe ok)--> up
+#   up --(drain_replica)--> draining --(spill + close)--> down   [terminal]
+REPLICA_UP = "up"
+REPLICA_DRAINING = "draining"
+REPLICA_EVICTED = "evicted"
+REPLICA_DOWN = "down"
+
+_REPLICA_STATE_VALUES = {
+    REPLICA_UP: 0,
+    REPLICA_DRAINING: 1,
+    REPLICA_EVICTED: 2,
+    REPLICA_DOWN: 3,
+}
+
+
+def replica_state_value(state: str) -> int:
+    """Numeric encoding for the seldon_tpu_replica_state gauge (the
+    breaker_state_value pattern)."""
+    return _REPLICA_STATE_VALUES.get(state, -1)
+
+# bounded migration retries per request: a request may survive multiple
+# replica deaths, but a poisoned prompt that kills EVERY replica it lands
+# on must eventually fail instead of cycling the fleet forever
+MAX_MIGRATIONS = 3
 
 
 # --------------------------------------------------------------------------
@@ -139,6 +190,7 @@ class AffinityBalancer:
         fallback: str = "epsilon_greedy",
         epsilon: float = 0.1,
         load_factor: float = 1.25,
+        depth_ttl_s: float | None = None,
         seed=None,
     ):
         if n_arms < 1:
@@ -172,6 +224,16 @@ class AffinityBalancer:
         # group off its warm replica forever
         self.depths = [0] * n_arms
         self._depth_ts = [0.0] * n_arms
+        # fleet ELIGIBILITY: evicted/draining/down arms stay in the arrays
+        # (rendezvous ranks are positional — removing an arm would reshuffle
+        # every key's home) but are skipped by every pick path
+        self._eligible = [True] * n_arms
+        # staleness TTL for polled depths: the router ties it to its poll
+        # interval so a dead poller's last spike decays within a few missed
+        # polls instead of pinning a shed for the class default
+        self.depth_ttl_s = (
+            float(depth_ttl_s) if depth_ttl_s is not None else self.DEPTH_TTL_S
+        )
         self._rr = 0
         self._lock = threading.Lock()
         self.stat_routes = {"affinity": 0, "shed": 0, "fallback": 0, "round_robin": 0}
@@ -191,7 +253,19 @@ class AffinityBalancer:
             self.beta.append(1.0)
             self.depths.append(0)
             self._depth_ts.append(0.0)
+            self._eligible.append(True)
             return len(self.counts) - 1
+
+    def set_eligible(self, arm: int, ok: bool) -> None:
+        """Mark one arm routable/unroutable (the replica state funnel's
+        view into the balancer: only UP replicas are eligible)."""
+        with self._lock:
+            if 0 <= arm < len(self._eligible):
+                self._eligible[arm] = bool(ok)
+
+    def eligible_arms(self) -> list[int]:
+        with self._lock:
+            return [i for i, ok in enumerate(self._eligible) if ok]
 
     # observed depths older than this read as 0 in pick() — bounds the
     # damage of a stale spike when the health poller stops
@@ -209,7 +283,7 @@ class AffinityBalancer:
         """The polled depths with the staleness TTL applied (lock held)."""
         now = time.monotonic()
         return [
-            d if now - t <= self.DEPTH_TTL_S else 0
+            d if now - t <= self.depth_ttl_s else 0
             for d, t in zip(self.depths, self._depth_ts)
         ]
 
@@ -224,21 +298,33 @@ class AffinityBalancer:
                 for x in (depths if depths is not None else self._observed_depths())
             ]
             d += [0] * (n - len(d))
+            # every pick path ranges over the ELIGIBLE arms only — an
+            # evicted/draining replica is invisible to routing. A fully
+            # ineligible fleet routes anyway (the submit path's migration
+            # retry will surface the failure; refusing to pick would turn
+            # a degraded fleet into a hard outage at the router)
+            live = [i for i in range(n) if self._eligible[i]]
+            if not live:
+                live = list(range(n))
             if self.policy == "round_robin":
-                arm = self._rr % n
+                arm = live[self._rr % len(live)]
                 self._rr += 1
                 self.stat_routes["round_robin"] += 1
                 return arm, "round_robin"
             if self.policy == "affinity" and key:
-                ranked = sorted(range(n), key=lambda a: _key_rank(tuple(key), a), reverse=True)
+                ranked = sorted(
+                    live, key=lambda a: _key_rank(tuple(key), a), reverse=True
+                )
                 primary = ranked[0]
                 # bounded load: the hot replica may run ahead of the fleet
                 # mean by load_factor (+1 slack so tiny fleets don't shed
                 # on depth 1-vs-0); past that, power-of-two-choices between
                 # the top two rendezvous ranks keeps the spill warm on ONE
                 # deterministic overflow replica
-                bound = self.load_factor * (sum(d) / n) + 1.0
-                if n > 1 and d[primary] > bound:
+                bound = self.load_factor * (
+                    sum(d[i] for i in live) / len(live)
+                ) + 1.0
+                if len(ranked) > 1 and d[primary] > bound:
                     second = ranked[1]
                     if d[second] < d[primary]:
                         # a shed is only a shed when the key MOVES — an
@@ -251,27 +337,26 @@ class AffinityBalancer:
                 return primary, "affinity"
             # keyless (or policy=bandit): the reward-driven fallback arms
             self.stat_routes["fallback"] += 1
-            return self._fallback_pick(d), "fallback"
+            return self._fallback_pick(d, live), "fallback"
 
-    def _fallback_pick(self, depths) -> int:
-        n = len(self.counts)
+    def _fallback_pick(self, depths, live) -> int:
         if self.fallback == "thompson":
-            draws = [
-                self._rng.betavariate(self.alpha[i], self.beta[i]) for i in range(n)
-            ]
-            return int(max(range(n), key=draws.__getitem__))
+            draws = {
+                i: self._rng.betavariate(self.alpha[i], self.beta[i]) for i in live
+            }
+            return int(max(live, key=draws.__getitem__))
         if self._rng.random() < self.epsilon:
-            return self._rng.randrange(n)
-        means = [
-            self.rewards[i] / self.counts[i] if self.counts[i] else float("inf")
-            for i in range(n)
-        ]
-        best = max(means)
+            return live[self._rng.randrange(len(live))]
+        means = {
+            i: self.rewards[i] / self.counts[i] if self.counts[i] else float("inf")
+            for i in live
+        }
+        best = max(means.values())
         # estimate ties break by LIVE load, then index: before any reward
         # lands every arm ties at +inf, and without this the exploit
         # branch would herd ~1-epsilon of keyless traffic onto arm 0
         # while the rest of the fleet idles
-        tied = [i for i in range(n) if means[i] == best]
+        tied = [i for i in live if means[i] == best]
         return int(min(tied, key=lambda i: (depths[i], i)))
 
     # -------------------------------------------------------------- rewards
@@ -300,6 +385,9 @@ class AffinityBalancer:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.Lock()
+        # checkpoints from before the fault-tolerance fields existed
+        self.__dict__.setdefault("_eligible", [True] * len(self.counts))
+        self.__dict__.setdefault("depth_ttl_s", self.DEPTH_TTL_S)
 
 
 # --------------------------------------------------------------------------
@@ -352,6 +440,35 @@ def preseed_enabled() -> bool:
 
 
 # --------------------------------------------------------------------------
+class _TrackedRequest:
+    """Router-side record of one in-flight generation: the emitted tokens
+    (recorded through the on_token shim — only FRESH tokens arrive there,
+    replayed ones are suppressed scheduler-side), the serving arm, and the
+    replica-submit task the migration path cancels. This is what makes a
+    generation recoverable: on eviction the record resubmits elsewhere
+    with ``tokens`` as the teacher-forced replay."""
+
+    __slots__ = ("prompt", "tokens", "caller_on_token", "arm", "task", "migrating")
+
+    def __init__(self, prompt, caller_on_token):
+        self.prompt = prompt
+        self.tokens: list[int] = []
+        self.caller_on_token = caller_on_token
+        self.arm = -1
+        self.task: asyncio.Task | None = None
+        self.migrating = False
+
+    def on_token(self, tok: int, idx: int) -> None:
+        # global stream index contract: the scheduler emits idx ==
+        # len(seq.tokens) - 1 with replayed positions pre-appended, so a
+        # resumed request's first fresh token arrives at exactly
+        # len(self.tokens) — append keeps the record aligned with the
+        # client-visible stream across any number of migrations
+        self.tokens.append(int(tok))
+        if self.caller_on_token is not None:
+            self.caller_on_token(tok, idx)
+
+
 class ReplicatedDecodeScheduler:
     """N decode-scheduler replicas behind the affinity balancer, presenting
     the single scheduler's serving surface (``submit`` /
@@ -393,6 +510,9 @@ class ReplicatedDecodeScheduler:
         autoscale_queue_depth: int = 0,
         spill_store=None,
         spill_store_factory=None,
+        health_poll_ms: float = 0.0,
+        health_miss_threshold: int = 3,
+        drain_timeout_ms: float = 5000.0,
         metrics: NullMetrics | None = None,
         deployment_name: str = "",
         seed: int = 0,
@@ -411,21 +531,65 @@ class ReplicatedDecodeScheduler:
         self._spill_store_factory = spill_store_factory
         self._metrics = metrics or NullMetrics()
         self._deployment = deployment_name
+        # health poll / eviction / drain knobs (tpu.decode_health_poll_ms,
+        # decode_health_miss_threshold, decode_drain_timeout_ms)
+        self.health_poll_s = max(0.0, float(health_poll_ms)) / 1e3
+        self.health_miss_threshold = max(1, int(health_miss_threshold))
+        self.drain_timeout_s = max(0.0, float(drain_timeout_ms)) / 1e3
         self.balancer = AffinityBalancer(
             n_replicas,
             policy=self.policy,
             fallback=fallback,
             epsilon=epsilon,
             load_factor=load_factor,
+            # tie the stale-depth TTL to the poll cadence when polling is
+            # on: a dead poller's spike decays after ~3 missed polls
+            # instead of the 30s class default
+            depth_ttl_s=(3.0 * self.health_poll_s if self.health_poll_s > 0 else None),
             seed=seed,
         )
         self._hot_streak = 0
         self._hot_since: float | None = None
         self._scaling = False
         self._scale_task: asyncio.Task | None = None
+        self._closed = False
+        # replica lifecycle state, indexed like self.replicas. ALL writes
+        # go through _set_replica_state (lint CP004 single-writer) — the
+        # funnel owns the balancer eligibility flip, the lifecycle
+        # counters/metrics, and the flight-recorder health fields, so no
+        # transition can half-apply.
+        self._replica_states = [REPLICA_UP] * n_replicas
+        # per-replica health breakers (engine/resilience.py): threshold
+        # consecutive probe misses open the breaker (-> eviction); after
+        # reset it half-opens and ONE successful probe readmits. reset is
+        # one poll interval so the first post-eviction poll already probes.
+        self._breakers = [self._new_breaker(i) for i in range(n_replicas)]
+        self._misses = [0] * n_replicas
+        self._last_ticks = [-1] * n_replicas
+        self._inflight: list[set[_TrackedRequest]] = [set() for _ in range(n_replicas)]
+        self._poll_task: asyncio.Task | None = None
         self.stat_scale_ups = 0
         self.stat_preseeded_entries = 0
+        self.stat_evictions = 0
+        self.stat_recoveries = 0
+        self.stat_drains = 0
+        self.stat_migrations = 0
+        self.stat_boot_failures = 0
+        self.stat_spill_failures = 0
+        self.stat_health_misses = 0
         self._metrics.router_replicas(self._deployment, len(self.replicas))
+
+    def _new_breaker(self, arm: int) -> CircuitBreaker:
+        spec = BreakerSpec(
+            failure_threshold=self.health_miss_threshold,
+            error_rate=1.0,
+            window=self.health_miss_threshold,
+            reset_ms=max(self.health_poll_s * 1e3, 1.0),
+            half_open_probes=1,
+        )
+        return CircuitBreaker(
+            spec, on_transition=lambda state, a=arm: self._on_breaker(a, state)
+        )
 
     def _attach(self, replica):
         """Fleet wiring for one replica: dispatches hop OFF the event loop
@@ -444,8 +608,18 @@ class ReplicatedDecodeScheduler:
 
     # ------------------------------------------------------------ delegates
     @property
+    def live_replicas(self):
+        """(arm, replica) pairs that still exist — drained replicas leave a
+        None TOMBSTONE in self.replicas (removing the entry would renumber
+        every surviving arm and reshuffle rendezvous homes)."""
+        return [(i, r) for i, r in enumerate(self.replicas) if r is not None]
+
+    @property
     def _r0(self):
-        return self.replicas[0]
+        for r in self.replicas:
+            if r is not None:
+                return r
+        raise RuntimeError("decode fleet has no live replicas")
 
     @property
     def seq_len(self) -> int:
@@ -469,11 +643,11 @@ class ReplicatedDecodeScheduler:
 
     @property
     def active(self) -> int:
-        return sum(r.active for r in self.replicas)
+        return sum(r.active for _, r in self.live_replicas)
 
     @property
     def queue_depth(self) -> int:
-        return sum(r.queue_depth for r in self.replicas)
+        return sum(r.queue_depth for _, r in self.live_replicas)
 
     @property
     def prefix_enabled(self) -> bool:
@@ -483,56 +657,67 @@ class ReplicatedDecodeScheduler:
     # scheduler today; the replicated tier sums)
     @property
     def stat_prefix_hits(self) -> int:
-        return sum(r.stat_prefix_hits for r in self.replicas)
+        return sum(r.stat_prefix_hits for _, r in self.live_replicas)
 
     @property
     def stat_prefix_misses(self) -> int:
-        return sum(r.stat_prefix_misses for r in self.replicas)
+        return sum(r.stat_prefix_misses for _, r in self.live_replicas)
 
     @property
     def stat_prefix_tokens_saved(self) -> int:
-        return sum(r.stat_prefix_tokens_saved for r in self.replicas)
+        return sum(r.stat_prefix_tokens_saved for _, r in self.live_replicas)
 
     @property
     def stat_tokens(self) -> int:
-        return sum(r.stat_tokens for r in self.replicas)
+        return sum(r.stat_tokens for _, r in self.live_replicas)
 
     @property
     def stat_chunk_dispatches(self) -> int:
-        return sum(r.stat_chunk_dispatches for r in self.replicas)
+        return sum(r.stat_chunk_dispatches for _, r in self.live_replicas)
 
     def __getattr__(self, name: str):
         # any scheduler attribution counter not explicitly aggregated
         # above sums across the fleet (soak/bench read stat_* freely)
         if name.startswith("stat_"):
-            return sum(getattr(r, name) for r in self.replicas)
+            return sum(
+                getattr(r, name) for r in self.__dict__["replicas"] if r is not None
+            )
         raise AttributeError(name)
 
     def request_params_from_meta(self, meta: Meta) -> dict:
         return self._r0.request_params_from_meta(meta)
 
     def warmup(self) -> None:
-        for r in self.replicas:
+        for _, r in self.live_replicas:
             r.warmup()
         # the fused program set is module-level, so sibling replicas share
         # each function's underlying jit cache: replica N's warmup entries
         # (distinct device placements = distinct signatures) would read as
         # phantom "recompiles" against replica 0's earlier baseline.
         # Re-snapshot every replica once the WHOLE fleet is warm.
-        for r in self.replicas:
+        for _, r in self.live_replicas:
             r._warmup_compile_counts = r.compile_counts()
 
     def compile_counts(self) -> dict[str, int]:
         out: dict[str, int] = {}
-        for i, r in enumerate(self.replicas):
+        for i, r in self.live_replicas:
             for k, v in r.compile_counts().items():
                 out[f"r{i}.{k}"] = v
         return out
 
     def recompiles_since_warmup(self) -> int:
-        return sum(r.recompiles_since_warmup() for r in self.replicas)
+        return sum(r.recompiles_since_warmup() for _, r in self.live_replicas)
 
     async def close(self) -> None:
+        self._closed = True
+        poll = self._poll_task
+        if poll is not None:
+            poll.cancel()
+            try:
+                await poll
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._poll_task = None
         task = self._scale_task
         if task is not None:
             # let an in-flight scale-up settle: cancelling mid-warmup
@@ -541,8 +726,15 @@ class ReplicatedDecodeScheduler:
                 await task
             except Exception:  # noqa: BLE001 - logged by the task itself
                 pass
-        await asyncio.gather(*(r.close() for r in self.replicas))
-        for r in self.replicas:
+        # evicted replicas ABORT (a hung loop never drains close()'s way);
+        # healthy ones drain normally
+        await asyncio.gather(
+            *(
+                (r.abort() if self._replica_states[i] == REPLICA_EVICTED else r.close())
+                for i, r in self.live_replicas
+            )
+        )
+        for _, r in self.live_replicas:
             pool = getattr(r, "_dispatch_pool", None)
             if pool is not None:
                 pool.shutdown(wait=False)
@@ -550,8 +742,12 @@ class ReplicatedDecodeScheduler:
     # -------------------------------------------------------------- routing
     def _live_depths(self) -> list[int]:
         # queue depth + active slots: a replica with free slots beats one
-        # that is merely not-queueing (both O(1) reads)
-        return [r.queue_depth + r.active for r in self.replicas]
+        # that is merely not-queueing (both O(1) reads). Tombstones read 0
+        # — they are ineligible, so the value never routes anything; it
+        # only keeps the list positionally aligned with the arms.
+        return [
+            0 if r is None else r.queue_depth + r.active for r in self.replicas
+        ]
 
     def route(self, prompt) -> tuple[int, str]:
         """Pick the serving replica for one prompt (token ids)."""
@@ -588,54 +784,148 @@ class ReplicatedDecodeScheduler:
         """Route one sequence to its replica and submit (the streaming
         ingress path — per-row SLO verdicts reward the serving arm
         directly, since a streamed response never rides the Feedback
-        API)."""
+        API). The request is TRACKED: if its replica is evicted or its
+        loop crashes mid-generation, it resubmits on a surviving replica
+        with the already-streamed tokens as a teacher-forced replay —
+        the caller (and its SSE stream) never sees the failure."""
+        self._ensure_poller()
         self._autoscale_tick()
-        arm, _reason = self.route(prompt)
-        sink = _slo_sink
-        if self.slo_ttft_s > 0 or self.slo_itl_s > 0:
-            sink = self._reward_sink(arm, _slo_sink)
-        return await self.replicas[arm].submit(prompt, _slo_sink=sink, **kw)
+        out, _arm = await self._submit_routed(prompt, kw, _slo_sink, reward=True)
+        return out
+
+    async def _submit_routed(
+        self, prompt, kw: dict, slo_sink, *, reward: bool
+    ) -> tuple[np.ndarray, int]:
+        """The tracked submit/migrate loop every request rides. Returns
+        (result, serving_arm). ``reward`` wires the streaming path's
+        direct SLO->arm reward sink; the buffered path rewards through
+        meta.tags.replica + ingest_feedback instead (one reward per
+        request either way)."""
+        rec = _TrackedRequest(prompt, kw.pop("on_token", None))
+        migrations = 0
+        while True:
+            arm, _reason = self.route(prompt)
+            sink = slo_sink
+            if reward and (self.slo_ttft_s > 0 or self.slo_itl_s > 0):
+                sink = self._reward_sink(arm, slo_sink)
+            replica = self.replicas[arm]
+            if replica is None:
+                # the balancer routed into a tombstone (whole fleet
+                # ineligible) — nothing can serve this
+                raise APIException(
+                    ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                    "decode fleet has no serving replicas",
+                )
+            kw2 = dict(kw)
+            if rec.tokens:
+                # resumed leg: teacher-force the already-streamed tokens
+                # and ride PLAIN rounds — replayed positions must write
+                # the replayed tokens' K/V, and only the plain step's
+                # cache write is conditioned on the effective input (a
+                # speculative round writes its PROPOSED tokens). Greedy
+                # spec output is bit-identical to plain, so the opt-out
+                # changes nothing downstream.
+                kw2["_replay_tokens"] = list(rec.tokens)
+                kw2["spec_k"] = 0
+                kw2["spec_tree"] = "0"
+            rec.arm = arm
+            rec.migrating = False
+            self._inflight[arm].add(rec)
+            rec.task = asyncio.ensure_future(
+                replica.submit(prompt, on_token=rec.on_token, _slo_sink=sink, **kw2)
+            )
+            try:
+                out = await rec.task
+                return out, arm
+            except asyncio.CancelledError:
+                if rec.migrating and not self._closed:
+                    # eviction/drain cancelled the replica-side future:
+                    # re-route (the dead arm is already ineligible) and
+                    # resume from the last streamed token
+                    migrations += 1
+                    if migrations > MAX_MIGRATIONS:
+                        raise APIException(
+                            ErrorCode.ENGINE_MICROSERVICE_ERROR,
+                            f"generation migrated {migrations - 1} times "
+                            "without completing — giving up",
+                        )
+                    continue
+                # genuine caller cancellation (client vanished): make sure
+                # the replica-side future is cancelled too, then propagate
+                rec.task.cancel()
+                raise
+            except APIException as e:
+                if (
+                    e.error is ErrorCode.ENGINE_MICROSERVICE_ERROR
+                    and not self._closed
+                    and migrations < MAX_MIGRATIONS
+                    and self._note_replica_failure(arm, str(e))
+                ):
+                    # the replica LOOP died under this request (induced
+                    # OOM, wedged dispatch): evict it and migrate
+                    migrations += 1
+                    continue
+                raise
+            finally:
+                self._inflight[arm].discard(rec)
 
     async def execute_message(self, msg: SeldonMessage) -> SeldonMessage:
         """Buffered serving entry: every row routes independently (rows of
         one request sharing a prefix land on the same warm replica; mixed
-        rows spread), each rides its replica's own execute_message, and
-        the merged response mirrors the single scheduler's contract —
-        plus ``meta.tags.replica`` (per-row serving replica) so the
-        Feedback API can route rewards back to the arms."""
+        rows spread), each rides the TRACKED submit path (so buffered
+        requests survive replica death exactly like streams), and the
+        merged response mirrors the single scheduler's contract — plus
+        ``meta.tags.replica`` (per-row serving replica) so the Feedback
+        API can route rewards back to the arms."""
         arr = msg.array
         if arr is None:
             raise APIException(
                 ErrorCode.ENGINE_INVALID_JSON,
                 "generative predictor needs tensor token ids",
             )
+        self._ensure_poller()
         self._autoscale_tick()
         rows = np.atleast_2d(np.asarray(arr)).astype(np.int32)
-        picks = []
-        for row in rows:
-            arm, _reason = self.route(row)
-            picks.append(arm)
+        overrides = self.request_params_from_meta(msg.meta)
+        r0 = self._r0
+        track_slo = bool(self.slo_ttft_s or self.slo_itl_s) or (
+            current_deadline() is not None
+        )
+        slo_flags: list[bool] = [True] * len(rows)
+        picks: list[int] = [0] * len(rows)
 
-        async def one(i: int) -> SeldonMessage:
-            sub = SeldonMessage.from_array(rows[i : i + 1], meta=msg.meta)
-            return await self.replicas[picks[i]].execute_message(sub)
+        async def one(i: int) -> np.ndarray:
+            sink = (
+                (lambda ok, i=i: slo_flags.__setitem__(i, ok))
+                if track_slo
+                else None
+            )
+            out, arm = await self._submit_routed(
+                rows[i], dict(overrides), sink, reward=False
+            )
+            picks[i] = arm
+            return out
 
+        # settle EVERY row before failing the request (the single
+        # scheduler's gather contract)
         outs = await asyncio.gather(
             *(one(i) for i in range(len(rows))), return_exceptions=True
         )
         for o in outs:
             if isinstance(o, BaseException):
                 raise o
-        full = np.concatenate([np.atleast_2d(np.asarray(o.array)) for o in outs])
-        tags = {**msg.meta.tags, "replica": picks}
+        max_new = overrides.get("max_new_tokens", r0.max_new_tokens)
+        max_new = max(1, min(int(max_new), r0.max_new_tokens))
+        width = rows.shape[1] + max_new
+        pad_id = self.eos_id if self.eos_id >= 0 else 0
+        full = np.full((len(outs), width), pad_id, np.int32)
         gen_lens: list[int] = []
-        slo: list[str] = []
-        for o in outs:
-            gen_lens.extend(o.meta.tags.get("gen_lens") or [])
-            slo.extend(o.meta.tags.get("slo") or [])
-        tags["gen_lens"] = gen_lens
-        if slo:
-            tags["slo"] = slo
+        for i, o in enumerate(outs):
+            full[i, : len(o)] = o
+            gen_lens.append(int(len(o) - rows.shape[1]))
+        tags = {**msg.meta.tags, "replica": picks, "gen_lens": gen_lens}
+        if track_slo:
+            tags["slo"] = ["met" if ok else "breached" for ok in slo_flags]
         meta = Meta(
             puid=msg.meta.puid,
             tags=tags,
@@ -677,6 +967,312 @@ class ReplicatedDecodeScheduler:
             updated += 1
         return updated
 
+    # ----------------------------------------------- health poll / eviction
+    def replica_states(self) -> list[str]:
+        """Lifecycle state per arm (positional, tombstones included)."""
+        return list(self._replica_states)
+
+    def _set_replica_state(self, arm: int, state: str, reason: str = "") -> None:
+        """THE replica lifecycle transition funnel — the only writer of
+        ``_replica_states`` (lint CP004, the _commit_round/_pending*
+        pattern). Owns everything a transition implies: the balancer
+        eligibility flip, the lifecycle counters + prometheus metrics, and
+        the replica's flight-recorder health fields, so no consumer can
+        observe a half-applied transition."""
+        while len(self._replica_states) <= arm:
+            self._replica_states.append(REPLICA_UP)
+        prev = self._replica_states[arm]
+        if prev == state:
+            return
+        self._replica_states[arm] = state
+        self.balancer.set_eligible(arm, state == REPLICA_UP)
+        r = self.replicas[arm] if arm < len(self.replicas) else None
+        if r is not None:
+            r.flight.replica_state = state
+        self._metrics.replica_state(self._deployment, arm, state)
+        if state == REPLICA_EVICTED:
+            self.stat_evictions += 1
+            self._metrics.replica_eviction(self._deployment)
+        elif state == REPLICA_UP and prev == REPLICA_EVICTED:
+            self.stat_recoveries += 1
+            self._metrics.replica_recovery(self._deployment)
+        elif state == REPLICA_DOWN:
+            self.stat_drains += 1
+            self._metrics.replica_drain(self._deployment)
+        log.info(
+            "decode replica %s: %s -> %s%s",
+            arm, prev, state, f" ({reason})" if reason else "",
+        )
+
+    def _ensure_poller(self) -> None:
+        """Start the health poll task lazily (it needs a running loop —
+        the router is built before serving starts). Idempotent, called
+        from the request paths."""
+        if self.health_poll_s <= 0 or self._closed:
+            return
+        t = self._poll_task
+        if t is not None and not t.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._poll_task = loop.create_task(self._health_poll_loop())
+
+    async def _health_poll_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self.health_poll_s)
+            try:
+                self.poll_fleet_once()
+            except Exception:  # noqa: BLE001 - the poller must outlive one bad poll
+                log.exception("decode health poll failed")
+
+    def poll_fleet_once(self) -> None:
+        """One poll sweep over the fleet (public so soak/tests can drive
+        the state machine synchronously): probe every live replica, feed
+        its queue depth to the balancer, count consecutive misses into its
+        breaker. A probe that ANSWERS but shows active slots with no tick
+        progress since the last poll is a miss too — a hung dispatch
+        answers host-side probes while serving nothing. Evicted replicas
+        get the breaker's half-open probe and readmit on success."""
+        for arm, r in self.live_replicas:
+            state = self._replica_states[arm]
+            if state in (REPLICA_DOWN, REPLICA_DRAINING):
+                continue
+            br = self._breakers[arm]
+            if state == REPLICA_EVICTED:
+                if not br.allow():
+                    # still inside the open window — no probe this poll
+                    continue
+                if self._probe_ok(arm, r):
+                    br.record_success()  # -> closed -> readmit (funnel)
+                    self._misses[arm] = 0
+                else:
+                    br.record_failure()  # half-open fail -> re-open
+                continue
+            if self._probe_ok(arm, r):
+                self._misses[arm] = 0
+                br.record_success()
+            else:
+                self._misses[arm] += 1
+                self.stat_health_misses += 1
+                br.record_failure()  # threshold misses -> open -> evict
+            r.flight.consecutive_misses = self._misses[arm]
+
+    def _probe_ok(self, arm: int, r) -> bool:
+        try:
+            h = r.health_probe()
+        except Exception:  # noqa: BLE001 - any probe failure is a miss
+            self._last_ticks[arm] = -1
+            return False
+        ticks = int(h.get("ticks", 0))
+        stuck = int(h.get("active", 0)) > 0 and ticks == self._last_ticks[arm]
+        self._last_ticks[arm] = ticks
+        if stuck:
+            return False
+        self.balancer.observe_depth(arm, int(h.get("queue_depth", 0)))
+        return True
+
+    def _on_breaker(self, arm: int, state: str) -> None:
+        """Breaker transition hook: every transition ticks the existing
+        breaker metrics (one endpoint per replica, so dashboards see the
+        open/half-open/closed funnel per arm), and open/closed drive the
+        replica lifecycle."""
+        self._metrics.breaker(self._deployment, f"decode-replica-{arm}", state)
+        if state == OPEN and self._replica_states[arm] == REPLICA_UP:
+            self._set_replica_state(arm, REPLICA_EVICTED, "health breaker open")
+            self._migrate_inflight(arm, "eviction")
+        elif state == CLOSED and self._replica_states[arm] == REPLICA_EVICTED:
+            self._set_replica_state(arm, REPLICA_UP, "half-open probe recovered")
+
+    def _note_replica_failure(self, arm: int, reason: str) -> bool:
+        """A request-path replica failure (loop crash fails every slot
+        future with ENGINE_MICROSERVICE_ERROR): force the breaker open so
+        eviction AND readmission ride the same funnel the poller uses.
+        Returns True when the replica is out of rotation (the caller may
+        migrate); False when it was already evicted/draining or there is
+        nowhere left to migrate to."""
+        if self._replica_states[arm] == REPLICA_UP:
+            br = self._breakers[arm]
+            while br.state != OPEN:
+                br.record_failure()
+            log.warning("decode replica %s failed in-request: %s", arm, reason)
+        others = [
+            i
+            for i, _ in self.live_replicas
+            if i != arm and self._replica_states[i] == REPLICA_UP
+        ]
+        return bool(others)
+
+    def _migrate_inflight(self, arm: int, reason: str) -> int:
+        """Kick every tracked request off ``arm``: flag it migrating and
+        cancel its replica-side future (the scheduler retires cancelled
+        slots and frees their pages on its next round — or at abort() for
+        a hung loop). The tracked submit loop catches the cancellation,
+        re-routes, and resumes from the last streamed token."""
+        recs = [rec for rec in self._inflight[arm] if rec.task is not None]
+        for rec in recs:
+            rec.migrating = True
+            if not rec.task.done():
+                rec.task.cancel()
+        if recs:
+            self.stat_migrations += len(recs)
+            self._metrics.replica_migration(self._deployment, len(recs))
+            log.info(
+                "decode replica %s: migrating %d in-flight generation(s) (%s)",
+                arm, len(recs), reason,
+            )
+        return len(recs)
+
+    # ------------------------------------------------------ drain/scale-down
+    async def drain_replica(self, arm: int, *, timeout_s: float | None = None) -> dict:
+        """Graceful scale-DOWN of one replica — the inverse of warm
+        scale-up. Stops admission (draining arms are ineligible), waits up
+        to the drain timeout for in-flight work to finish, migrates any
+        stragglers, spills the refcount-ranked prefix pages to the store
+        AND pushes each entry to its new rendezvous home among the
+        survivors, then closes the replica and tombstones its slot.
+        Terminal: a drained arm never serves again (scale-up appends a
+        fresh arm instead — rendezvous positions are forever)."""
+        if not (0 <= arm < len(self.replicas)) or self.replicas[arm] is None:
+            raise ValueError(f"replica {arm} does not exist")
+        if self._replica_states[arm] != REPLICA_UP:
+            raise ValueError(
+                f"replica {arm} is not serving (state: {self._replica_states[arm]})"
+            )
+        survivors = [
+            i
+            for i, _ in self.live_replicas
+            if i != arm and self._replica_states[i] == REPLICA_UP
+        ]
+        if not survivors:
+            raise ValueError("cannot drain the last serving replica")
+        r = self.replicas[arm]
+        self._set_replica_state(arm, REPLICA_DRAINING, "drain requested")
+        budget = self.drain_timeout_s if timeout_s is None else max(0.0, timeout_s)
+        deadline = time.monotonic() + budget
+        while (
+            (r.active or r.queue_depth or self._inflight[arm])
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.01)
+        migrated = 0
+        if self._inflight[arm]:
+            migrated = self._migrate_inflight(arm, "drain timeout")
+            # the migrating requests leave this arm's tracking set as soon
+            # as their cancellations land — bounded wait, then proceed to
+            # close (their replica-side futures are already cancelled)
+            waited = 0.0
+            while self._inflight[arm] and waited < 1.0:
+                await asyncio.sleep(0.005)
+                waited += 0.005
+        spilled = await self._spill_replica_state(arm, r)
+        await r.close()
+        pool = getattr(r, "_dispatch_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+        self.replicas[arm] = None
+        self._set_replica_state(arm, REPLICA_DOWN, "drained")
+        self._metrics.router_replicas(self._deployment, len(self.live_replicas))
+        log.info(
+            "decode replica %s drained: %d generation(s) migrated, "
+            "%d prefix entr(ies) pushed to siblings",
+            arm, migrated, spilled,
+        )
+        return {"replica": arm, "migrated": migrated, "spilled_entries": spilled}
+
+    async def scale_down(self) -> dict:
+        """Drain the COLDEST serving replica: fewest prefix hits, then
+        lightest live load, then the highest arm id (prefer releasing the
+        newest device)."""
+        candidates = [
+            i for i, _ in self.live_replicas if self._replica_states[i] == REPLICA_UP
+        ]
+        if len(candidates) <= 1:
+            raise ValueError("cannot scale down a single-replica fleet")
+        arm = min(
+            candidates,
+            key=lambda i: (
+                self.replicas[i].stat_prefix_hits,
+                self.replicas[i].queue_depth + self.replicas[i].active,
+                -i,
+            ),
+        )
+        return await self.drain_replica(arm)
+
+    async def _spill_replica_state(self, arm: int, r) -> int:
+        """Drain-side prefix handoff: export the draining replica's
+        refcount-ranked pages (quiescence-retry like the scale-up spill),
+        round-trip through the persistence store (so an operator restart
+        boots from it), and PUSH each entry to the surviving arm that
+        rendezvous-owns its key — the sibling serves the group warm on its
+        next request instead of waiting for a pull."""
+        if not (preseed_enabled() and self.prefix_enabled):
+            return 0
+        payload = None
+        for _ in range(500):
+            try:
+                payload = r.export_prefix_state()
+                break
+            except RuntimeError:
+                await asyncio.sleep(0.005)
+        if not payload or not payload["entries"]:
+            return 0
+        if self.spill_store is None and self._spill_store_factory is not None:
+            try:
+                self.spill_store = self._spill_store_factory()
+            except Exception:  # noqa: BLE001 - a broken store must not fail the drain
+                log.exception("replica spill store unusable — sibling push only")
+            self._spill_store_factory = None
+        if self.spill_store is not None:
+            try:
+                self.spill_store.save(
+                    spill_key(self._deployment), pickle.dumps(payload)
+                )
+            except Exception:  # noqa: BLE001 - degraded, not fatal — and COUNTED
+                self.stat_spill_failures += 1
+                self._metrics.replica_spill_failure(self._deployment)
+                log.exception(
+                    "drain spill store save failed — sibling push continues"
+                )
+        survivors = [
+            i
+            for i, _ in self.live_replicas
+            if i != arm and self._replica_states[i] == REPLICA_UP
+        ]
+        if not survivors:
+            return 0
+        targets: dict[int, list] = {}
+        seq_len = self.seq_len
+        for e in payload["entries"]:
+            key = prefix_route_key(
+                e["tokens"], block=self.affinity_block, seq_len=seq_len
+            )
+            if key:
+                home = max(survivors, key=lambda a: _key_rank(tuple(key), a))
+            else:
+                # keyless span (shorter than one block): park it on the
+                # least-loaded survivor
+                home = min(
+                    survivors, key=lambda a: self.replicas[a].queue_depth
+                )
+            targets.setdefault(home, []).append(e)
+        seeded = 0
+        for home, entries in targets.items():
+            sub = {
+                "page_size": payload["page_size"],
+                "kv_dtype": payload["kv_dtype"],
+                "entries": entries,
+            }
+            try:
+                seeded += self.replicas[home].preseed_prefix_state(sub)
+            except Exception:  # noqa: BLE001 - a full sibling pool degrades, not fails
+                self.stat_spill_failures += 1
+                self._metrics.replica_spill_failure(self._deployment)
+                log.exception("drain preseed into replica %s failed", home)
+        self.stat_preseeded_entries += seeded
+        return seeded
+
     # ---------------------------------------------------------- autoscale
     def _autoscale_tick(self) -> None:
         """Queue-depth autoscale check (O(replicas), runs per request):
@@ -687,13 +1283,15 @@ class ReplicatedDecodeScheduler:
         # metric label resolutions per row
         for i, d in enumerate(self._live_depths()):
             self._metrics.router_queue_depth(self._deployment, i, d)
+        live = self.live_replicas
         if (
-            self.autoscale_replicas <= len(self.replicas)
+            not live
+            or self.autoscale_replicas <= len(live)
             or self.autoscale_queue_depth <= 0
             or self._scaling
         ):
             return
-        mean_depth = sum(r.queue_depth for r in self.replicas) / len(self.replicas)
+        mean_depth = sum(r.queue_depth for _, r in live) / len(live)
         now = time.monotonic()
         if mean_depth >= self.autoscale_queue_depth:
             self._hot_streak += 1
@@ -713,9 +1311,14 @@ class ReplicatedDecodeScheduler:
             self._scale_task = asyncio.ensure_future(self._scale_up())
 
     def _hottest_replica(self):
-        """The replica whose prefix index served the most hits — the one
-        whose working set a new replica wants."""
-        return max(self.replicas, key=lambda r: r.stat_prefix_hits)
+        """The serving replica whose prefix index served the most hits —
+        the one whose working set a new replica wants."""
+        up = [
+            r
+            for i, r in self.live_replicas
+            if self._replica_states[i] == REPLICA_UP
+        ] or [r for _, r in self.live_replicas]
+        return max(up, key=lambda r: r.stat_prefix_hits)
 
     async def _export_spill(self) -> dict | None:
         """Export the hottest replica's prefix pages ON the event loop —
@@ -744,7 +1347,7 @@ class ReplicatedDecodeScheduler:
         # shared-jit-cache note (see warmup): the new replica's compiles
         # would read as phantom recompiles on the serving replicas —
         # re-baseline them at the scale-up boundary
-        for r in self.replicas:
+        for _, r in self.live_replicas:
             r._warmup_compile_counts = r.compile_counts()
         return new
 
@@ -775,7 +1378,9 @@ class ReplicatedDecodeScheduler:
                         raw = self.spill_store.load(spill_key(self._deployment))
                         if raw is not None:
                             payload = pickle.loads(raw)
-                    except Exception:  # noqa: BLE001 - degraded, not fatal
+                    except Exception:  # noqa: BLE001 - degraded, not fatal — and COUNTED
+                        self.stat_spill_failures += 1
+                        self._metrics.replica_spill_failure(self._deployment)
                         log.exception(
                             "replica spill store round-trip failed — "
                             "scale-up continues with the in-process payload"
@@ -786,8 +1391,16 @@ class ReplicatedDecodeScheduler:
             )
             self.replicas.append(new)
             self.balancer.add_arm()
+            # grow the per-arm health tracking in lockstep with the fleet
+            # (the funnel extends _replica_states itself — CP004 keeps it
+            # the single writer of that list)
+            self._breakers.append(self._new_breaker(rid))
+            self._misses.append(0)
+            self._last_ticks.append(-1)
+            self._inflight.append(set())
+            self._set_replica_state(rid, REPLICA_UP, "scale-up boot")
             self.stat_scale_ups += 1
-            self._metrics.router_replicas(self._deployment, len(self.replicas))
+            self._metrics.router_replicas(self._deployment, len(self.live_replicas))
             log.info(
                 "decode autoscale: replica %s up in %.1fs (queue depth %s, "
                 "preseeded entries so far: %s)",
@@ -796,7 +1409,9 @@ class ReplicatedDecodeScheduler:
                 self.autoscale_queue_depth,
                 self.stat_preseeded_entries,
             )
-        except Exception:  # noqa: BLE001 - a failed scale-up must not kill serving
+        except Exception:  # noqa: BLE001 - a failed scale-up must not kill serving — but COUNTED
+            self.stat_boot_failures += 1
+            self._metrics.replica_boot_failure(self._deployment)
             log.exception("decode autoscale: replica boot failed")
         finally:
             self._scaling = False
@@ -805,5 +1420,5 @@ class ReplicatedDecodeScheduler:
     # ------------------------------------------------------------- audits
     def allocator_audits(self) -> None:
         """Per-replica pool-consistency audits (soak/test gate)."""
-        for r in self.replicas:
+        for _, r in self.live_replicas:
             r.pool.alloc.check()

@@ -728,6 +728,7 @@ class _Seq:
         "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
         "cache_prefix", "chunk_idx",
         "slo_deadline", "slo_ok", "slo_sink",
+        "replay", "emit_base",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, spec_k, on_token, future):
@@ -767,6 +768,13 @@ class _Seq:
         self.slo_deadline = 0.0
         self.slo_ok = True
         self.slo_sink = None
+        # migration replay (fleet fault recovery): the tokens a dead
+        # replica already emitted for this request. Positions below
+        # emit_base are teacher-forced from ``replay`` and re-emission is
+        # suppressed — the resumed stream picks up at emit_base with no
+        # duplicate or missing tokens.
+        self.replay: tuple[int, ...] = ()
+        self.emit_base = 0
         # the submitter's trace context(s), captured at submit: the decode
         # loop runs in its OWN task (no ambient request context), so spans
         # are attached to each sequence's originating trace explicitly
@@ -1212,6 +1220,11 @@ class DecodeScheduler:
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
         self._closed = False
+        # decode-tier chaos profile (engine/faults.py install_decode_faults):
+        # consulted at the top of each active round (hang / induced
+        # allocator-OOM), per device readback (stall), and per health probe
+        # (dropped response). None = no faults armed.
+        self._faults = None
 
         # attribution counters (bench/diagnostics; prometheus carries the
         # production twins via metrics.decode_*)
@@ -1661,6 +1674,7 @@ class DecodeScheduler:
         prefill_chunk: int | None = None,
         on_token: OnToken | None = None,
         _slo_sink=None,
+        _replay_tokens=None,
     ) -> np.ndarray:
         """Generate for one prompt [seq_len]; resolves with the full int32
         sequence (prompt echoed, generated ids appended). ``on_token`` is
@@ -1672,7 +1686,10 @@ class DecodeScheduler:
         capturing into the prefix pool (a shared system prompt's length);
         ``prefill_chunk`` tightens (never widens) the deployment's
         per-round prefill chunk — both are ignored when the corresponding
-        tier is disabled."""
+        tier is disabled. ``_replay_tokens`` (fleet migration only) is the
+        token prefix a dead replica already emitted: those positions are
+        teacher-forced and not re-streamed, so the resumed request is
+        bit-identical to an uninterrupted greedy run."""
         if self._closed:
             raise APIException(
                 ErrorCode.ENGINE_MICROSERVICE_ERROR, "decode scheduler closed"
@@ -1701,6 +1718,9 @@ class DecodeScheduler:
         if d is not None:
             seq.slo_deadline = time.perf_counter() + max(d.remaining(), 0.0)
         seq.slo_sink = _slo_sink
+        if _replay_tokens:
+            seq.replay = tuple(int(t) for t in _replay_tokens)
+            seq.emit_base = len(seq.replay)
         if self.spec_tree is not None:
             # per-request branching tighten (meta.tags.spec_tree): per
             # depth min(request, deployment), omitted depths -> 0 (depth
@@ -1746,14 +1766,31 @@ class DecodeScheduler:
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._run())
 
-    def _emit(self, seq: _Seq, tok: int) -> None:
-        """Record one generated token: stream it, time it. Runs under the
-        emit/SLO phase — inside the accept/sampling walks the inner phase
-        wins, so emission cost reads apart from the walk around it."""
+    def _emit(self, seq: _Seq, tok: int) -> int:
+        """Record one generated token: stream it, time it. Returns the
+        EFFECTIVE token — during a migration replay the recorded token
+        overrides the freshly computed one, and every consumer (finish
+        check, next-round input via seq.tokens[-1]) must use the returned
+        value. Runs under the emit/SLO phase — inside the accept/sampling
+        walks the inner phase wins, so emission cost reads apart from the
+        walk around it."""
         with self._phase(P_EMIT_SLO):
-            self._emit_inner(seq, tok)
+            return self._emit_inner(seq, tok)
 
-    def _emit_inner(self, seq: _Seq, tok: int) -> None:
+    def _emit_inner(self, seq: _Seq, tok: int) -> int:
+        idx = len(seq.tokens)
+        if idx < seq.emit_base:
+            # migration replay: teacher-force the token the dead replica
+            # already emitted (and streamed). No metrics, no on_token —
+            # the original emission was the real one; this pass only
+            # rebuilds KV state so generation resumes at emit_base with
+            # the exact context of the uninterrupted run.
+            tok = int(seq.replay[idx])
+            seq.tokens.append(tok)
+            seq.t_last_token = time.perf_counter()
+            if idx == 0:
+                seq.t_first_token = seq.t_last_token
+            return tok
         now = time.perf_counter()
         seq.tokens.append(tok)
         if len(seq.tokens) == 1:
@@ -1806,6 +1843,7 @@ class DecodeScheduler:
                 seq.on_token(tok, len(seq.tokens) - 1)
             except Exception:  # noqa: BLE001 - a slow/broken consumer must not kill the loop
                 log.exception("on_token callback failed")
+        return tok
 
     def _finished(self, seq: _Seq, tok: int) -> bool:
         return tok == self.eos_id or len(seq.tokens) >= seq.max_new
@@ -2037,7 +2075,15 @@ class DecodeScheduler:
         t0 = time.perf_counter_ns()
         self._rb_mark_ns = 0
         try:
-            return await self._device_call(fn)
+            out = await self._device_call(fn)
+            if self._faults is not None:
+                # chaos readback stall: the dispatch completed but the
+                # host-transfer wait drags — attributed to the family's
+                # readback column like a real slow transfer would be
+                stall = self._faults.readback_stall_s()
+                if stall > 0:
+                    await asyncio.sleep(stall)
+            return out
         finally:
             t2 = time.perf_counter_ns()
             mark = self._rb_mark_ns or t2
@@ -2663,8 +2709,8 @@ class DecodeScheduler:
                             start_ns=t2,
                         )
                     )
-                self._emit(seq, int(toks[i]))
-                if self._finished(seq, int(toks[i])):
+                tok = self._emit(seq, int(toks[i]))
+                if self._finished(seq, tok):
                     self._retire(i)
 
     async def _spec_round(
@@ -2865,9 +2911,8 @@ class DecodeScheduler:
                     vs.add_event("accept", ev)
                     vs.end(t1)
                 for j in range(int(acc[i]) + 1):
-                    tok = int(out_t[i, j])
                     seq.pos += 1
-                    self._emit(seq, tok)
+                    tok = self._emit(seq, int(out_t[i, j]))
                     emitted += 1
                     if riding:
                         # only tokens from slots that actually speculated count
@@ -2954,6 +2999,13 @@ class DecodeScheduler:
                         # loop's own, not the queue's silence
                         self._round_reset()
                     continue
+                if self._faults is not None:
+                    # decode-tier chaos (install_decode_faults): a hung
+                    # round sleeps here with slots held — exactly what a
+                    # wedged device dispatch looks like from outside —
+                    # and an induced OOM arms the allocator so this
+                    # round's KV write fails through the REAL error path
+                    await self._chaos_round()
                 # one prefill chunk per round, interleaved with the decode
                 # step below — running slots keep emitting while long
                 # prompts prefill chunk by chunk (with no chunk cap a whole
@@ -3166,9 +3218,8 @@ class DecodeScheduler:
                     for i, seq in enumerate(self._slots):
                         if seq is None or seq.prefilling:
                             continue
-                        tok = int(nxt[i])
                         seq.pos += 1
-                        self._emit(seq, tok)
+                        tok = self._emit(seq, int(nxt[i]))
                         if self._finished(seq, tok):
                             self._retire(i)
                 # reconcile the shadow admissions decided under the flight
@@ -3240,6 +3291,78 @@ class DecodeScheduler:
             except Exception:  # noqa: BLE001 - loop errors already routed to futures
                 pass
             self._task = None
+
+    async def abort(self) -> None:
+        """Hard stop for an EVICTED fleet replica: close() drains, but a
+        hung loop never drains. Cancel the loop task mid-round, cancel any
+        still-unsettled futures (the router has already migrated the live
+        generations — anything left has no consumer), and rebuild the
+        device pool so the post-mortem allocator audit runs against a
+        consistent allocator instead of a torn mid-round snapshot."""
+        self._closed = True
+        self._wake.set()
+        task = self._task
+        self._task = None
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        for seq in list(self._slots) + list(self._waiting):
+            if seq is None:
+                continue
+            for sp in seq.gen_spans:
+                sp.error = True
+                sp.end()
+            seq.gen_spans = []
+            if not seq.future.done():
+                seq.future.cancel()
+        self._slots = [None] * self.n_slots
+        self._free = list(range(self.n_slots - 1, -1, -1))
+        self._waiting.clear()
+        self._reset_device_state()
+
+    # ------------------------------------------------- fleet health / chaos
+    def health_probe(self) -> dict:
+        """In-process liveness probe the fleet health poller calls each
+        interval — the in-process twin of polling GET /decode/health on an
+        out-of-process replica. Raises when a chaos drop is armed (the
+        equivalent of a dropped HTTP response). ``ticks`` is the loop's
+        dispatch counter: a probe that answers while ``active`` slots show
+        no tick progress between polls is a HUNG loop — the probe itself
+        is host-side and survives a wedged dispatch."""
+        if self._faults is not None and self._faults.health_drop():
+            raise TimeoutError(
+                f"chaos: dropped decode health response (replica "
+                f"{self.replica_id})"
+            )
+        return {
+            "replica_id": self.replica_id,
+            "queue_depth": len(self._waiting),
+            "active": self.active,
+            "ticks": int(self._tick),
+            "closed": bool(self._closed),
+        }
+
+    async def _chaos_round(self) -> None:
+        """Apply this round's armed decode fault (top of the active round,
+        before any dispatch)."""
+        d = self._faults.round_decision()
+        if d.action == "hang":
+            log.warning(
+                "chaos: decode replica %d hanging for %.1fs",
+                self.replica_id, d.delay_s,
+            )
+            self._metrics.fault_injected(self._deployment, "decode", "hang")
+            await asyncio.sleep(d.delay_s)
+        elif d.action == "oom":
+            log.warning(
+                "chaos: decode replica %d arming induced allocator OOM",
+                self.replica_id,
+            )
+            self._metrics.fault_injected(self._deployment, "decode", "oom")
+            self.pool.alloc.chaos_oom_writes = 1
 
     # ------------------------------------------------------ message adapter
     def request_params_from_meta(self, meta: Meta) -> dict:
@@ -3563,6 +3686,13 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
             getattr(tpu_spec, "decode_autoscale_queue_depth", 0) or 0
         ),
         spill_store_factory=store_factory,
+        health_poll_ms=float(getattr(tpu_spec, "decode_health_poll_ms", 0.0) or 0.0),
+        health_miss_threshold=int(
+            getattr(tpu_spec, "decode_health_miss_threshold", 3) or 3
+        ),
+        drain_timeout_ms=float(
+            getattr(tpu_spec, "decode_drain_timeout_ms", 5000.0) or 5000.0
+        ),
         metrics=metrics,
         deployment_name=base_name,
         seed=int(getattr(tpu_spec, "decode_seed", 0)),

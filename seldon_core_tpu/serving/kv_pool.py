@@ -102,6 +102,12 @@ class PageAllocator:
         # (batched so the owner — the prefix index — rebuilds its trie
         # once, not once per pin, on the hot decode path)
         self.on_pins_reclaimed = None
+        # chaos hook (engine/faults.py install_decode_faults): when > 0, the
+        # next prepare_write raises as if the page budget were exhausted —
+        # an induced allocator-OOM that exercises the decode loop's error
+        # path without actually corrupting accounting
+        self.chaos_oom_writes = 0
+        self.stat_chaos_ooms = 0
         self.stat_pages_shared = 0
         self.stat_cow_copies = 0
         self.stat_reclaimed_pages = 0
@@ -266,6 +272,13 @@ class PageAllocator:
         end = min(int(start) + int(count), self.pages_per_slot * ps)
         if count <= 0 or start >= end:
             return []
+        if self.chaos_oom_writes > 0:
+            self.chaos_oom_writes -= 1
+            self.stat_chaos_ooms += 1
+            raise RuntimeError(
+                "chaos: induced allocator OOM (page budget exhausted by "
+                f"fault injection) preparing write for slot {slot}"
+            )
         copies: list[tuple[int, int]] = []
         bt = self.block_tables
         for lp in range(int(start) // ps, (end - 1) // ps + 1):

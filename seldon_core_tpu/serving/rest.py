@@ -67,6 +67,41 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
 
         return prometheus_response(request, metrics or getattr(service, "metrics", None))
 
+    # replicated decode fleet operations (serving/affinity_router.py):
+    # GET /decode/fleet the per-arm lifecycle read-out, POST /decode/drain
+    # the graceful scale-down trigger (?replica=n names the arm; without
+    # it the coldest serving replica drains) — the serving-tier twin of
+    # the orchestrator-facing /pause drain hook above
+    async def decode_fleet(request: web.Request) -> web.Response:
+        status = service.decode_fleet_status()
+        if status is None:
+            return web.json_response(
+                {"error": "no replicated decode tier"}, status=404
+            )
+        return web.json_response(status)
+
+    async def decode_drain(request: web.Request) -> web.Response:
+        from seldon_core_tpu.core.errors import APIException
+
+        raw = request.query.get("replica")
+        replica = None
+        if raw is not None:
+            try:
+                replica = int(raw)
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {
+                        "error": "?replica must be an integer arm id",
+                        "param": "replica",
+                        "got": raw,
+                    },
+                    status=400,
+                )
+        try:
+            return web.json_response(await service.drain_decode_replica(replica))
+        except APIException as e:
+            return web.json_response({"error": str(e)}, status=e.error.http_status)
+
     # internal microservice API (reference internal-api.md): the endpoints
     # an engine's RemoteUnit dispatches to when THIS process is a wrapped
     # single-unit microservice; shares the wire core with everything else
@@ -95,4 +130,6 @@ def build_app(service: PredictionService, state: dict | None = None, metrics=Non
         app.router.add_route(method, "/unpause", unpause)
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/prometheus", prometheus)
+    app.router.add_get("/decode/fleet", decode_fleet)
+    app.router.add_post("/decode/drain", decode_drain)
     return app

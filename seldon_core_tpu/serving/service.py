@@ -454,6 +454,47 @@ class PredictionService:
                 trace_id=buf.trace_id if buf is not None else None,
             )
 
+    def decode_fleet_status(self) -> dict | None:
+        """Fleet-tier status for operators (the REST ``GET /decode/fleet``
+        body): per-arm lifecycle state plus the lifecycle counters chaos
+        runs assert on. None when the deployment has no replicated decode
+        tier (single scheduler or no scheduler at all)."""
+        sched = self.decode_scheduler
+        if sched is None or not hasattr(sched, "replica_states"):
+            return None
+        states = sched.replica_states()
+        return {
+            "replicas": [
+                {"replica": i, "state": s} for i, s in enumerate(states)
+            ],
+            "serving": sum(1 for s in states if s == "up"),
+            "evictions": sched.stat_evictions,
+            "recoveries": sched.stat_recoveries,
+            "drains": sched.stat_drains,
+            "migrations": sched.stat_migrations,
+            "health_misses": sched.stat_health_misses,
+        }
+
+    async def drain_decode_replica(self, replica: int | None = None) -> dict:
+        """Operator-triggered graceful scale-down (the REST ``POST
+        /decode/drain`` action): drain one replica — the named arm, or the
+        coldest serving one — migrate its in-flight work, spill its prefix
+        pages, release its device. Raises APIException for deployments
+        without a replicated decode tier and for undrainable arms (last
+        serving replica, unknown/already-down arm)."""
+        sched = self.decode_scheduler
+        if sched is None or not hasattr(sched, "drain_replica"):
+            raise APIException(
+                ErrorCode.ENGINE_INVALID_JSON,
+                "deployment has no replicated decode tier to drain",
+            )
+        try:
+            if replica is None:
+                return await sched.scale_down()
+            return await sched.drain_replica(int(replica))
+        except ValueError as e:
+            raise APIException(ErrorCode.ENGINE_INVALID_JSON, str(e)) from e
+
     async def send_feedback(
         self, feedback: Feedback, *, traceparent: str | None = None
     ) -> SeldonMessage:

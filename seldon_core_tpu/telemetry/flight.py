@@ -421,6 +421,12 @@ class FlightRecorder:
         # queued count)
         self.replica_id = int(replica_id)
         self.queue_depth_source = None
+        # fleet health (serving/affinity_router.py): lifecycle state and
+        # consecutive health-probe misses, written by the router's
+        # _set_replica_state funnel / poll sweep and surfaced through
+        # /decode/health so an operator sees WHY an arm stopped serving
+        self.replica_state = "up"
+        self.consecutive_misses = 0
         self.capacity = int(capacity) or _env_capacity()
         self.enabled = flight_enabled() if enabled is None else bool(enabled)
         self.slo_ttft_ms = float(slo_ttft_ms)
@@ -746,6 +752,8 @@ class FlightRecorder:
             # source when the scheduler registered one, else the last
             # committed frame)
             "replica_id": self.replica_id,
+            "state": self.replica_state,
+            "consecutive_misses": self.consecutive_misses,
             "queue_depth": queue_depth,
             "rounds": rounds,
             "occupancy_mean": round(self.occupancy_sum / rounds, 4) if rounds else 0.0,

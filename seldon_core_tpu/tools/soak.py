@@ -64,6 +64,8 @@ async def soak(
     tp: int = 0,
     replicas: int = 0,
     profile_out: str = "",
+    kill_replica: str = "",
+    drain_replica: str = "",
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -178,6 +180,11 @@ async def soak(
             predictor_extra["tpu"].update(
                 decode_replicas=replicas,
                 decode_router_policy="affinity",
+                # fleet health polling on: the poller feeds live queue
+                # depths to the balancer and drives the breaker
+                # evict/readmit funnel the chaos flags below exercise
+                decode_health_poll_ms=50.0,
+                decode_health_miss_threshold=2,
             )
             # pin headroom on top of the deliberately-tight paged budget:
             # the replica soak asserts the fleet HIT RATE, and a budget
@@ -231,6 +238,34 @@ async def soak(
     port = _free_port()
     fast = await start_fast_server(gateway_routes(gw), "127.0.0.1", port)
 
+    # ---- seeded replica chaos (--kill-replica / --drain-replica n@t) ----
+    def _parse_at(flag: str, raw: str) -> tuple[int, float]:
+        try:
+            n, _, t = raw.partition("@")
+            arm, at_s = int(n), float(t)
+        except ValueError:
+            raise RuntimeError(f"soak {flag}: expected <replica>@<seconds>, got {raw!r}")
+        if not (0 <= arm < max(replicas, 1)) or at_s < 0:
+            raise RuntimeError(
+                f"soak {flag}: replica must be in [0, {replicas}) and the "
+                f"time non-negative, got {raw!r}"
+            )
+        return arm, at_s
+
+    chaos_actions: list[tuple[str, int, float]] = []
+    if kill_replica or drain_replica:
+        if replicas <= 1:
+            raise RuntimeError(
+                "soak --kill-replica/--drain-replica need --replicas > 1 "
+                "(a single scheduler has no surviving arm to migrate onto)"
+            )
+        if kill_replica:
+            chaos_actions.append(("kill", *_parse_at("--kill-replica", kill_replica)))
+        if drain_replica:
+            chaos_actions.append(("drain", *_parse_at("--drain-replica", drain_replica)))
+        chaos_actions.sort(key=lambda a: a[2])
+    chaos_events: list[dict] = []
+
     rss_samples: list[tuple[float, float]] = []
     lag_samples: list[float] = []
     stop = asyncio.Event()
@@ -276,7 +311,47 @@ async def soak(
                 prompt = tail(features)
             return {"data": {"ndarray": [prompt] * batch}}
 
+    async def chaos_driver() -> None:
+        """Fire the scheduled replica chaos actions mid-load. A KILL arms a
+        deterministic induced allocator-OOM on the target's very next
+        decode round (engine/faults.py DecodeFaultSpec) — its loop crashes
+        for real, the router force-opens the breaker, migrates the
+        in-flight generations, and the health poller readmits the replica
+        through the half-open probe once it answers again. A DRAIN calls
+        the graceful path. Either way the load generator above must see
+        ZERO errors — that is the assertion this harness exists for."""
+        from seldon_core_tpu.engine.faults import DecodeFaultSpec, install_decode_faults
+
+        t0 = time.perf_counter()
+        for kind, arm, at_s in chaos_actions:
+            delay = at_s - (time.perf_counter() - t0)
+            if delay > 0:
+                try:
+                    await asyncio.wait_for(stop.wait(), timeout=delay)
+                    return  # load finished before the action came due
+                except asyncio.TimeoutError:
+                    pass
+            sched_ = getattr(server, "decode_scheduler", None)
+            fleet_ = getattr(sched_, "replicas", None)
+            if fleet_ is None or fleet_[arm] is None:
+                continue
+            ev = {"action": kind, "replica": arm, "t_s": round(at_s, 2)}
+            if kind == "kill":
+                install_decode_faults(fleet_[arm], DecodeFaultSpec(oom_at_round=1))
+            else:
+                lookups_ = sched_.stat_prefix_hits + sched_.stat_prefix_misses
+                ev["hit_rate_pre_drain"] = round(
+                    sched_.stat_prefix_hits / max(lookups_, 1), 3
+                )
+                ev["hits_pre"] = sched_.stat_prefix_hits
+                ev["lookups_pre"] = lookups_
+                ev.update(await sched_.drain_replica(arm))
+            chaos_events.append(ev)
+
     sampler_task = asyncio.ensure_future(sampler())
+    chaos_task = (
+        asyncio.ensure_future(chaos_driver()) if chaos_actions else None
+    )
     try:
         stats = await run_load(
             f"http://127.0.0.1:{port}",
@@ -292,6 +367,8 @@ async def soak(
     finally:
         stop.set()
         await sampler_task
+        if chaos_task is not None:
+            await chaos_task
         fast.close()
         await fast.wait_closed()
         if getattr(server, "decode_scheduler", None) is not None:
@@ -348,9 +425,12 @@ async def soak(
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
     fleet = getattr(sched, "replicas", None) if sched is not None else None
+    # drained replicas leave a None tombstone in the fleet list (positional
+    # rendezvous ranks); every aggregation below reads the LIVE ones
+    live_fleet = [r for r in fleet if r is not None] if fleet else None
     paged_stats = None
     if paged and sched is not None:
-        pools = [r.pool for r in fleet] if fleet else [sched.pool]
+        pools = [r.pool for r in live_fleet] if live_fleet else [sched.pool]
         allocs = [p.alloc for p in pools]
         paged_stats = {
             "page_size": pools[0].page_size,
@@ -435,7 +515,7 @@ async def soak(
                     "misses": r.stat_prefix_misses,
                     "queue_depth_end": r.queue_depth,
                 }
-                for r in fleet
+                for r in live_fleet
             ],
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
@@ -444,7 +524,11 @@ async def soak(
         # judged when the mix sent enough shared traffic for the floor to
         # separate from capture-race noise (a sparse short smoke records
         # the numbers without asserting on them).
-        if shared_rows >= 4 * rr_cold and hits > 0:
+        # a chaos leg invalidates the floor: an eviction/drain re-captures
+        # its groups on the surviving replicas (and again on readmission),
+        # so the capture cost the floor models is paid extra times — that
+        # leg is judged by its own zero-error/lifecycle asserts instead
+        if shared_rows >= 4 * rr_cold and hits > 0 and not chaos_actions:
             if not agg_hit > rr_floor:
                 raise RuntimeError(
                     f"soak --replicas: aggregate prefix hit rate {agg_hit:.3f} "
@@ -452,11 +536,11 @@ async def soak(
                     "affinity routing is not keeping sharers co-located"
                 )
     flight_stats = None
-    if generative and fleet is not None:
+    if generative and live_fleet is not None:
         # per-replica flight summaries (each replica owns its recorder;
         # /decode/health serves the same per-replica rows live)
         per_replica = []
-        for r in fleet:
+        for r in live_fleet:
             agg = r.flight.aggregate()
             per_replica.append(
                 {
@@ -553,6 +637,68 @@ async def soak(
             "folded_out": profile_out,
             "top_self": [t["frame"] for t in rep["top"]],
         }
+    chaos_stats = None
+    if chaos_actions:
+        # the fault-tolerance contract, asserted: replica death/drain
+        # under load is INVISIBLE to clients — every in-flight generation
+        # migrated and resumed, zero errors in the load generator's tally
+        if s["errors"] > 0:
+            raise RuntimeError(
+                f"soak replica-chaos: {s['errors']} request error(s) leaked "
+                "to clients — migration/recovery did not absorb the fault"
+            )
+        if not chaos_events:
+            raise RuntimeError(
+                "soak replica-chaos: no scheduled action actually fired "
+                "(action time past --duration, or target already gone) — "
+                "the run proved nothing"
+            )
+        killed = [e for e in chaos_events if e["action"] == "kill"]
+        if killed and sched.stat_evictions < 1:
+            raise RuntimeError(
+                "soak --kill-replica: the induced allocator-OOM never "
+                "evicted the target (no breaker-open observed) — the kill "
+                "was not exercised"
+            )
+        # readmission via the half-open probe: only judged when the kill
+        # left the poller time to recover the replica before shutdown
+        if killed and sched.stat_recoveries < 1 and all(
+            duration_s - e["t_s"] >= 2.0 for e in killed
+        ):
+            raise RuntimeError(
+                "soak --kill-replica: the evicted replica was never "
+                "readmitted (half-open probe did not recover it)"
+            )
+        for e in chaos_events:
+            if e["action"] != "drain":
+                continue
+            lookups_post = (
+                sched.stat_prefix_hits + sched.stat_prefix_misses - e["lookups_pre"]
+            )
+            hits_post = sched.stat_prefix_hits - e["hits_pre"]
+            e["hit_rate_post_drain"] = round(hits_post / max(lookups_post, 1), 3)
+            # the drain acceptance bar (warm-TTFT hit rate within 5% of
+            # pre-drain) — only judged when enough post-drain traffic ran
+            # for the rate to mean anything
+            if (
+                lookups_post >= 100
+                and e["hit_rate_post_drain"] < e["hit_rate_pre_drain"] - 0.05
+            ):
+                raise RuntimeError(
+                    f"soak --drain-replica: post-drain hit rate "
+                    f"{e['hit_rate_post_drain']} fell more than 5% below "
+                    f"pre-drain {e['hit_rate_pre_drain']} — the spill/"
+                    "sibling-push did not keep the working set warm"
+                )
+        chaos_stats = {
+            "events": chaos_events,
+            "replica_states": sched.replica_states(),
+            "evictions": sched.stat_evictions,
+            "recoveries": sched.stat_recoveries,
+            "migrations": sched.stat_migrations,
+            "drains": sched.stat_drains,
+            "health_misses": sched.stat_health_misses,
+        }
     prefix_stats = None
     if prefix_share > 0 and sched is not None:
         lookups = sched.stat_prefix_hits + sched.stat_prefix_misses
@@ -593,6 +739,7 @@ async def soak(
         ) if lag_sorted else None,
         "loop_lag_max_ms": round(max(lag_samples), 2) if lag_samples else None,
         **({"trace_summary": traces} if traces is not None else {}),
+        **({"chaos": chaos_stats} if chaos_stats is not None else {}),
         **({"replicas": replica_stats} if replica_stats is not None else {}),
         **({"flight": flight_stats} if flight_stats is not None else {}),
         **({"profile": profile_stats} if profile_stats is not None else {}),
@@ -695,6 +842,30 @@ def main(argv=None) -> None:
         "no stack was captured — the `make profile-smoke` gate; the "
         "report gains samples/hz/top frames under 'profile'",
     )
+    ap.add_argument(
+        "--kill-replica",
+        default="",
+        metavar="N@T",
+        help="with --replicas: at T seconds into the run, arm a "
+        "deterministic induced allocator-OOM on replica N's next decode "
+        "round — its loop crashes for real, the router evicts it, migrates "
+        "its in-flight generations, and the health poller readmits it via "
+        "the half-open probe; the run FAILS unless clients saw zero "
+        "errors, the eviction fired, and (time permitting) the replica "
+        "was readmitted. The report gains the lifecycle counters under "
+        "'chaos'",
+    )
+    ap.add_argument(
+        "--drain-replica",
+        default="",
+        metavar="N@T",
+        help="with --replicas: at T seconds into the run, gracefully drain "
+        "replica N (stop admission, migrate stragglers, spill its prefix "
+        "pages to the store + push them to their new rendezvous homes, "
+        "release the device); the run FAILS unless clients saw zero "
+        "errors and the post-drain warm hit rate stays within 5%% of "
+        "pre-drain (when enough post-drain traffic ran to judge)",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -743,6 +914,8 @@ def main(argv=None) -> None:
                 tp=args.tp,
                 replicas=args.replicas,
                 profile_out=args.profile,
+                kill_replica=args.kill_replica,
+                drain_replica=args.drain_replica,
             )
         )
 

@@ -6,7 +6,10 @@ foundation (SURVEY C1/C20)."""
 import json
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from seldon_core_tpu.core.codec_json import (
     message_from_dict,

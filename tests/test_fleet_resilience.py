@@ -1,0 +1,602 @@
+"""Fault-tolerant decode fleet (serving/affinity_router.py health poll /
+eviction / drain + engine/faults.py decode-tier injection).
+
+The load-bearing invariants:
+
+- decode fault decisions are a pure function of (spec, call ordinal) —
+  reruns replay the identical fault sequence (the migration oracle's
+  precondition);
+- the health poller evicts a replica after ``health_miss_threshold``
+  consecutive misses (dropped probes AND tick-stagnant hangs both count),
+  excludes it from routing within the same poll, and readmits it through
+  the breaker's half-open probe — every transition visible in metrics;
+- an in-flight generation interrupted at ANY round boundary resumes on a
+  surviving replica and emits the exact token sequence of the
+  uninterrupted run, under both the plain and the pipelined decode loop;
+- a dead poller cannot pin routing on a stale queue-depth spike (TTL
+  decay, tied to the poll interval);
+- drain/scale-down stops admission, migrates stragglers, pushes the
+  refcount-ranked prefix pages to each entry's new rendezvous home among
+  the survivors, tombstones the slot (rendezvous positions are forever),
+  and refuses to drain the last serving replica;
+- lint CP004 holds the lifecycle funnel single-writer.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.analysis import lint_sources
+from seldon_core_tpu.engine.faults import (
+    DecodeFaultSpec,
+    DecodeFaultState,
+    install_decode_faults,
+)
+from seldon_core_tpu.metrics import NullMetrics
+from seldon_core_tpu.models.decoder import generate, init_decoder
+from seldon_core_tpu.serving.affinity_router import (
+    AffinityBalancer,
+    ReplicatedDecodeScheduler,
+    replica_state_value,
+)
+from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
+
+SEQ = 12
+MAX_NEW = 6
+VOCAB = 96
+BLOCK = 4
+
+
+def _params(**kw):
+    return init_decoder(
+        seed=5, vocab=VOCAB, hidden=32, layers=1, ffn=64, max_len=32, **kw
+    )
+
+
+def _fleet(params, n, metrics=None, **kw):
+    def factory(i):
+        return DecodeScheduler(
+            params,
+            seq_len=SEQ,
+            max_new_tokens=MAX_NEW,
+            n_slots=2,
+            prefix_slots=8,
+            kv_page_size=4,
+            deployment_name=f"resil/r{i}",
+            replica_id=i,
+        )
+
+    rep = ReplicatedDecodeScheduler(
+        factory,
+        n,
+        policy="affinity",
+        affinity_block=BLOCK,
+        deployment_name="resil",
+        seed=0,
+        metrics=metrics,
+        **kw,
+    )
+    rep.warmup()
+    return rep
+
+
+def _recording_metrics():
+    class Rec(NullMetrics):
+        def __init__(self):
+            self.breaker_states = []
+            self.replica_states = []
+            self.evictions = 0
+            self.recoveries = 0
+            self.drains = 0
+            self.migrations = 0
+            self.boot_failures = 0
+            self.spill_failures = 0
+
+        def breaker(self, deployment, endpoint, state):
+            self.breaker_states.append((endpoint, state))
+
+        def replica_state(self, deployment, replica, state):
+            self.replica_states.append((replica, state))
+
+        def replica_eviction(self, deployment):
+            self.evictions += 1
+
+        def replica_recovery(self, deployment):
+            self.recoveries += 1
+
+        def replica_drain(self, deployment):
+            self.drains += 1
+
+        def replica_migration(self, deployment, n):
+            self.migrations += n
+
+        def replica_boot_failure(self, deployment):
+            self.boot_failures += 1
+
+        def replica_spill_failure(self, deployment):
+            self.spill_failures += 1
+
+    return Rec()
+
+
+def _prompt_for_arm(rep, arm, seed0=0):
+    """A random prompt whose affinity home is ``arm`` (rendezvous is
+    seed-stable, so scanning seeds is deterministic)."""
+    for s in range(seed0, seed0 + 200):
+        p = np.random.default_rng(s).integers(0, VOCAB, SEQ).astype(np.int32)
+        if rep.route(p)[0] == arm:
+            return p
+    raise AssertionError(f"no prompt routed to arm {arm} in 200 seeds")
+
+
+async def _readmit(rep, arm):
+    """Drive the half-open readmission of an evicted arm (breaker reset is
+    one poll interval — 1ms with the background poller off)."""
+    rep.replicas[arm]._faults = None
+    for _ in range(50):
+        await asyncio.sleep(0.003)
+        rep.poll_fleet_once()
+        if rep.replica_states()[arm] == "up":
+            return
+    raise AssertionError(f"arm {arm} never readmitted: {rep.replica_states()}")
+
+
+# ------------------------------------------------- fault-state determinism
+@pytest.mark.chaos
+def test_decode_fault_decisions_are_pure_functions_of_ordinals():
+    spec = DecodeFaultSpec(
+        hang_at_round=2,
+        hang_s=7.0,
+        oom_at_round=4,
+        readback_stall_ms=50.0,
+        stall_from_round=3,
+        drop_health_from=2,
+        drop_health_count=2,
+    )
+
+    def run():
+        st = DecodeFaultState(spec)
+        rounds = [st.round_decision().action for _ in range(5)]
+        stalls = [st.readback_stall_s() for _ in range(2)]
+        probes = [st.health_drop() for _ in range(5)]
+        return rounds, stalls, probes
+
+    rounds, stalls, probes = run()
+    # 1-based ordinals from installation: round 2 hangs, round 4 OOMs
+    assert rounds == ["ok", "hang", "ok", "oom", "ok"]
+    # the stall applies from stall_from_round onward (rounds is past 3)
+    assert stalls == [0.05, 0.05]
+    # probes 2..3 drop (from=2, count=2), then the window closes
+    assert probes == [False, True, True, False, False]
+    # identical on replay — the reproducibility contract
+    assert run() == (rounds, stalls, probes)
+
+
+@pytest.mark.chaos
+def test_health_probe_drop_window():
+    sched = DecodeScheduler(
+        _params(), seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+        prefix_slots=8, kv_page_size=4, deployment_name="probe-drop",
+    )
+    install_decode_faults(sched, DecodeFaultSpec(drop_health_from=2, drop_health_count=2))
+    h = sched.health_probe()
+    assert h["replica_id"] == 0 and h["queue_depth"] == 0 and not h["closed"]
+    for _ in range(2):
+        with pytest.raises(TimeoutError):
+            sched.health_probe()
+    # the drop window closes: probes answer again (a flapping replica)
+    assert sched.health_probe()["replica_id"] == 0
+
+
+# --------------------------------------------------- poller evict / readmit
+@pytest.mark.chaos
+async def test_poller_evicts_after_threshold_and_halfopen_readmits():
+    params = _params()
+    rec = _recording_metrics()
+    rep = _fleet(params, 2, metrics=rec, health_miss_threshold=2)
+    try:
+        # drop EVERY probe on replica 0 (a crashed out-of-process pod)
+        install_decode_faults(rep.replicas[0], DecodeFaultSpec(drop_health_from=1))
+
+        rep.poll_fleet_once()
+        assert rep.replica_states() == ["up", "up"]  # one miss, under threshold
+        assert rep.replicas[0].flight.consecutive_misses == 1
+        assert rep.stat_health_misses == 1
+
+        rep.poll_fleet_once()  # second consecutive miss -> breaker opens
+        assert rep.replica_states() == ["evicted", "up"]
+        assert rep.stat_evictions == 1 and rec.evictions == 1
+        assert ("decode-replica-0", "open") in rec.breaker_states
+        assert (0, "evicted") in rec.replica_states
+        # excluded from routing IMMEDIATELY: every key lands on arm 1
+        assert rep.balancer.eligible_arms() == [1]
+        for s in range(16):
+            p = np.random.default_rng(s).integers(0, VOCAB, SEQ).astype(np.int32)
+            assert rep.route(p)[0] == 1
+        # the flight recorder exposes the lifecycle fields /decode/health serves
+        assert rep.replicas[0].flight.replica_state == "evicted"
+        assert rep.replicas[0].flight.consecutive_misses >= 2
+
+        await _readmit(rep, 0)
+        assert rep.replica_states() == ["up", "up"]
+        assert rep.stat_recoveries == 1 and rec.recoveries == 1
+        assert ("decode-replica-0", "half_open") in rec.breaker_states
+        assert ("decode-replica-0", "closed") in rec.breaker_states
+        assert rep.balancer.eligible_arms() == [0, 1]
+        assert rep.replicas[0].flight.replica_state == "up"
+    finally:
+        await rep.close()
+
+
+@pytest.mark.chaos
+async def test_tick_stagnation_reads_as_a_miss():
+    """A hung dispatch answers host-side probes while serving nothing: the
+    probe is only healthy when ticks PROGRESS while slots are active."""
+    params = _params()
+    rep = _fleet(params, 2)
+    try:
+        r0 = rep.replicas[0]
+        probe = {"replica_id": 0, "queue_depth": 3, "active": 1, "ticks": 7,
+                 "closed": False}
+        r0.health_probe = lambda: dict(probe)
+        # first sight of ticks=7: no baseline yet, healthy; depth ingested
+        assert rep._probe_ok(0, r0) is True
+        assert rep.balancer.depths[0] == 3
+        # same ticks with active slots: hung
+        assert rep._probe_ok(0, r0) is False
+        # progress resumes: healthy again
+        probe["ticks"] = 8
+        assert rep._probe_ok(0, r0) is True
+        # idle stagnation is NOT a hang (nothing to tick for)
+        probe["active"] = 0
+        assert rep._probe_ok(0, r0) is True
+    finally:
+        await rep.close()
+
+
+@pytest.mark.chaos
+async def test_hung_replica_evicted_by_stagnation_and_aborted():
+    params = _params()
+    rep = _fleet(params, 2, health_miss_threshold=2)
+    prompts = [_prompt_for_arm(rep, a, seed0=40 * a) for a in (0, 1)]
+    oracle = np.asarray(generate(params, jnp.asarray(np.stack(prompts)), MAX_NEW))
+    # replica 0's second active round wedges for 30s (a stuck device
+    # dispatch) — the probe keeps answering, only the ticks stop
+    install_decode_faults(rep.replicas[0], DecodeFaultSpec(hang_at_round=2, hang_s=30.0))
+    tasks = [asyncio.ensure_future(rep.submit(p)) for p in prompts]
+    for _ in range(200):
+        await asyncio.sleep(0.02)
+        rep.poll_fleet_once()
+        if rep.replica_states()[0] == "evicted":
+            break
+    assert rep.replica_states() == ["evicted", "up"]
+    assert rep.stat_migrations >= 1
+    outs = np.stack(await asyncio.gather(*tasks))
+    # the migrated generation is bit-identical to the uninterrupted run
+    assert np.array_equal(outs, oracle)
+    # close() ABORTS the evicted (still-hung) replica instead of draining
+    # it, and rebuilds its device state so the audit runs clean
+    await rep.close()
+    rep.allocator_audits()
+
+
+# ------------------------------------------------ stale-depth TTL (satellite)
+def test_dead_poller_cannot_pin_routing_on_a_stale_spike():
+    bal = AffinityBalancer(2, seed=0, depth_ttl_s=0.05)
+    key = (1, 2, 3, 4)
+    home = bal.pick(key)[0]
+    # the poller's last observation before dying: a huge spike on the home
+    bal.observe_depth(home, 100)
+    shed_arm, reason = bal.pick(key)
+    assert reason == "shed" and shed_arm != home
+    time.sleep(0.06)
+    # past the TTL the spike reads as 0 — routing returns to the warm home
+    assert bal.pick(key) == (home, "affinity")
+
+
+async def test_depth_ttl_tied_to_poll_interval():
+    params = _params()
+    polled = _fleet(params, 2, health_poll_ms=40.0)
+    unpolled = _fleet(params, 2)
+    try:
+        # three missed polls, not the 30s class default
+        assert polled.balancer.depth_ttl_s == pytest.approx(0.12)
+        assert unpolled.balancer.depth_ttl_s == AffinityBalancer.DEPTH_TTL_S
+    finally:
+        await polled.close()
+        await unpolled.close()
+
+
+# ------------------------------------------- migration-correctness oracle
+@pytest.mark.chaos
+@pytest.mark.parametrize("pipeline", ["off", "on"])
+async def test_migration_resumes_bit_identical_at_every_round_boundary(
+    monkeypatch, pipeline
+):
+    """THE recovery oracle: interrupt one generation after exactly k
+    streamed tokens (k = 0..MAX_NEW-1 — every round boundary, including
+    mid-prefill death at k=0), let the router evict the replica and resume
+    on the survivor, and require the client-visible stream to be
+    bit-identical to the uninterrupted run. Runs under both the plain and
+    the PR 13 pipelined decode loop."""
+    from seldon_core_tpu.telemetry import flight as flight_mod
+
+    monkeypatch.setenv(flight_mod.ENGINE_DECODE_PIPELINE, pipeline)
+    params = _params()
+    rec = _recording_metrics()
+    rep = _fleet(params, 2, metrics=rec, health_miss_threshold=2)
+    assert rep.replicas[0]._pipeline_on() is (pipeline == "on")
+    try:
+        rng = np.random.default_rng(3)
+        for k in range(MAX_NEW):
+            prompt = rng.integers(0, VOCAB, SEQ).astype(np.int32)
+            oracle = np.asarray(generate(params, jnp.asarray(prompt[None]), MAX_NEW))[0]
+            arm = rep.route(prompt)[0]
+            victim = rep.replicas[arm]
+            fired = [False]
+
+            def on_token(tok, idx, k=k, victim=victim, fired=fired):
+                # arm the induced allocator-OOM once the k-th token has
+                # streamed: the victim's NEXT KV write fails through the
+                # real error path and kills its loop mid-generation
+                if idx == k - 1 and not fired[0]:
+                    fired[0] = True
+                    install_decode_faults(victim, DecodeFaultSpec(oom_at_round=1))
+
+            if k == 0:
+                # boundary 0: die before ANY token streams (prefill round)
+                fired[0] = True
+                install_decode_faults(victim, DecodeFaultSpec(oom_at_round=1))
+            streamed = []
+            out = await rep.submit(
+                prompt,
+                on_token=lambda t, i, s=streamed, cb=on_token: (
+                    s.append(int(t)), cb(t, i),
+                ),
+            )
+            assert fired[0]
+            assert np.array_equal(out, oracle)
+            # the SSE-visible stream: no duplicated, no missing tokens
+            # across the migration (replayed positions are suppressed)
+            assert streamed == [int(t) for t in oracle[SEQ:]]
+            assert rep.replica_states()[arm] == "evicted"
+            await _readmit(rep, arm)
+        assert rep.stat_evictions == MAX_NEW == rec.evictions
+        assert rep.stat_recoveries == MAX_NEW == rec.recoveries
+        assert rep.stat_migrations == MAX_NEW == rec.migrations
+        rep.allocator_audits()
+    finally:
+        await rep.close()
+
+
+# --------------------------------------------------------- drain/scale-down
+@pytest.mark.chaos
+async def test_drain_pushes_prefix_pages_to_rendezvous_sibling():
+    params = _params()
+    rec = _recording_metrics()
+    rep = _fleet(params, 2, metrics=rec)
+    # one shared-prefix group per arm, warmed with a sharer each
+    heads = {a: _prompt_for_arm(rep, a, seed0=60 * a) for a in (0, 1)}
+    for a, head in heads.items():
+        sharer = head.copy()
+        sharer[-1] = (sharer[-1] + 1) % VOCAB
+        await rep.submit(head)
+        await rep.submit(sharer)
+    sur_hits = rep.replicas[1].stat_prefix_hits
+    assert rep.replicas[0]._prefix_index.entries  # the victim holds state
+
+    res = await rep.drain_replica(0)
+    assert res["replica"] == 0 and res["spilled_entries"] >= 1
+    assert rep.replica_states() == ["down", "up"]
+    assert rep.replicas[0] is None  # tombstone, not removal
+    assert [i for i, _ in rep.live_replicas] == [1]
+    assert rep.stat_drains == 1 == rec.drains
+    assert (0, "down") in rec.replica_states
+
+    # the drained arm's group now serves WARM from the survivor — the
+    # pushed pages, not a recompute
+    sharer2 = heads[0].copy()
+    sharer2[-1] = (sharer2[-1] + 2) % VOCAB
+    arm, _ = rep.route(sharer2)
+    assert arm == 1
+    await rep.submit(sharer2)
+    assert rep.replicas[1].stat_prefix_hits == sur_hits + 1
+    assert rep.stat_preseeded_entries >= 1
+
+    # the last serving replica refuses to drain — and dead arms are errors
+    with pytest.raises(ValueError, match="last serving replica"):
+        await rep.drain_replica(1)
+    with pytest.raises(ValueError, match="does not exist"):
+        await rep.drain_replica(0)
+    with pytest.raises(ValueError, match="does not exist"):
+        await rep.drain_replica(5)
+    rep.allocator_audits()
+    await rep.close()
+
+
+@pytest.mark.chaos
+async def test_scale_down_drains_the_coldest_replica():
+    params = _params()
+    rep = _fleet(params, 2)
+    try:
+        # warm exactly ONE arm: the other is the coldest by prefix hits
+        head = _prompt_for_arm(rep, 1)
+        for bump in (0, 1, 2):
+            p = head.copy()
+            p[-1] = (p[-1] + bump) % VOCAB
+            await rep.submit(p)
+        assert rep.replicas[1].stat_prefix_hits > rep.replicas[0].stat_prefix_hits
+        res = await rep.scale_down()
+        assert res["replica"] == 0
+        assert rep.replica_states() == ["down", "up"]
+        with pytest.raises(ValueError, match="single-replica fleet"):
+            await rep.scale_down()
+    finally:
+        await rep.close()
+
+
+async def test_scale_up_boot_failure_is_counted_not_fatal():
+    params = _params()
+    rec = _recording_metrics()
+    built = []
+
+    def factory(i):
+        if i >= 2:
+            raise RuntimeError("induced boot failure")
+        s = DecodeScheduler(
+            params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            prefix_slots=8, kv_page_size=4,
+            deployment_name=f"boot/r{i}", replica_id=i,
+        )
+        built.append(s)
+        return s
+
+    rep = ReplicatedDecodeScheduler(
+        factory, 2, policy="affinity", affinity_block=BLOCK,
+        deployment_name="boot", seed=0, metrics=rec,
+        autoscale_replicas=3, autoscale_queue_depth=1,
+    )
+    rep.warmup()
+    try:
+        await rep._scale_up()
+        assert rep.stat_boot_failures == 1 == rec.boot_failures
+        assert len(rep.replicas) == 2  # the failed boot never joined
+        # the fleet keeps serving through the failed scale-up
+        out = await rep.submit(np.arange(SEQ).astype(np.int32) % VOCAB)
+        assert len(out) == SEQ + MAX_NEW
+    finally:
+        await rep.close()
+
+
+# ------------------------------------------------------------ CR validation
+def _dep_with_tpu(tpu):
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+
+    return SeldonDeployment.from_dict(
+        {
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {
+                        "name": "p",
+                        "graph": {
+                            "name": "m",
+                            "type": "MODEL",
+                            "implementation": "SIMPLE_MODEL",
+                        },
+                        "tpu": tpu,
+                    }
+                ],
+            }
+        }
+    )
+
+
+def test_validation_fleet_health_knobs():
+    from seldon_core_tpu.graph.validation import ValidationError, validate_deployment
+
+    def bad(tpu, needle):
+        with pytest.raises(ValidationError) as e:
+            validate_deployment(_dep_with_tpu(tpu))
+        assert needle in str(e.value)
+
+    base = {"decode_slots": 2, "decode_replicas": 2}
+    bad({**base, "decode_health_poll_ms": -1.0}, "decode_health_poll_ms must be >= 0")
+    bad({**base, "decode_health_miss_threshold": 0}, "evict on the first poll")
+    bad({**base, "decode_drain_timeout_ms": -5.0}, "decode_drain_timeout_ms must be >= 0")
+    # polling a single-replica fleet has no surviving arm to evict onto
+    bad({"decode_slots": 2, "decode_health_poll_ms": 100.0}, "no surviving arm")
+    # the shipped shape validates
+    validate_deployment(
+        _dep_with_tpu(
+            {**base, "decode_health_poll_ms": 250.0,
+             "decode_health_miss_threshold": 2,
+             "decode_drain_timeout_ms": 2000.0}
+        )
+    )
+
+
+def test_crd_schema_carries_health_knobs():
+    from seldon_core_tpu.operator.crd_schema import deployment_validation_schema
+
+    tpu = deployment_validation_schema()["properties"]["predictors"]["items"][
+        "properties"
+    ]["tpu"]["properties"]
+    for k in (
+        "decode_health_poll_ms",
+        "decode_health_miss_threshold",
+        "decode_drain_timeout_ms",
+    ):
+        assert k in tpu
+
+
+# --------------------------------------------------- CP004 lifecycle funnel
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+CP_LIFECYCLE_CLEAN = """
+class Router:
+    def __init__(self):
+        self._replica_states = ["up"]
+
+    def _set_replica_state(self, arm, state):
+        while len(self._replica_states) <= arm:
+            self._replica_states.append("up")
+        self._replica_states[arm] = state
+
+    def evict(self, arm):
+        self._set_replica_state(arm, "evicted")
+"""
+
+
+def test_cp004_funnel_is_clean():
+    assert lint_sources({"m.py": CP_LIFECYCLE_CLEAN}) == []
+
+
+def test_cp004_flags_bypassing_writers():
+    src = """
+class Router:
+    def __init__(self):
+        self._replica_states = ["up"]
+
+    def _set_replica_state(self, arm, state):
+        self._replica_states[arm] = state
+
+    def evict(self, arm):
+        self._replica_states[arm] = "evicted"
+
+    def grow(self):
+        self._replica_states.append("up")
+"""
+    findings = lint_sources({"m.py": src})
+    assert _rules_of(findings) == {"CP004"}
+    symbols = {f.symbol for f in findings}
+    assert symbols == {"Router.evict", "Router.grow"}
+
+
+def test_cp004_needs_the_funnel_shape():
+    # a class tracking replica states WITHOUT the funnel method is not
+    # subject — CP004 sanctions drift from a declared single-writer, it
+    # does not impose the pattern
+    src = """
+class Tracker:
+    def __init__(self):
+        self._replica_states = []
+
+    def note(self, state):
+        self._replica_states.append(state)
+"""
+    assert lint_sources({"m.py": src}) == []
+
+
+def test_replica_state_gauge_values_are_stable():
+    # the prometheus gauge encodes states as ints — dashboards depend on
+    # the mapping staying put
+    assert [replica_state_value(s) for s in ("up", "draining", "evicted", "down")] \
+        == [0, 1, 2, 3]
+    assert replica_state_value("nonsense") == -1
