@@ -5,12 +5,12 @@ TEST_ENV = PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_dev
 
 IMAGE ?= seldon-core-tpu/platform:latest
 
-.PHONY: lint test test-fast bench dryrun protos native install-bundle image release clean profile-smoke distill-smoke replica-smoke chaos-smoke
+.PHONY: lint test test-fast bench dryrun protos native install-bundle image release clean profile-smoke distill-smoke replica-smoke chaos-smoke kvtier-smoke
 
 lint:  ## invariant linter (trace-safety / commit-point / registry-drift / phase-registry / ladder)
 	$(PY) -m seldon_core_tpu.tools.lint
 
-test: lint profile-smoke distill-smoke replica-smoke chaos-smoke  ## full suite on the 8-device virtual CPU mesh
+test: lint profile-smoke distill-smoke replica-smoke chaos-smoke kvtier-smoke  ## full suite on the 8-device virtual CPU mesh
 	$(PY) -m pytest tests/ -q
 
 profile-smoke:  ## short generative soak: the sampling profiler must capture >=1 stack AND the pipelined loop must hide host work (overlap_of_gap > 0)
@@ -21,6 +21,9 @@ replica-smoke:  ## short replicated-decode soak: 2 replicas behind the affinity 
 
 chaos-smoke:  ## seeded replica-kill mid-soak: induced allocator-OOM crashes one replica's loop under load — zero client errors, eviction + migration + half-open readmission asserted, allocator audits green
 	$(TEST_ENV) $(PY) -m seldon_core_tpu.tools.soak --duration 6 --users 4 --replicas 2 --kill-replica 0@2
+
+kvtier-smoke:  ## short KV-overflow soak: 2-entry device prefix index under an 8-group mix with a host tier below — demotions AND promotions must fire, allocator audit green, zero recompiles
+	$(TEST_ENV) $(PY) -m seldon_core_tpu.tools.soak --duration 3 --users 4 --kv-overflow
 
 distill-smoke:  ## tiny feature-draft distillation through the CLI (the pytest smoke asserts the accept delta + zoo round-trip)
 	$(TEST_ENV) $(PY) -m seldon_core_tpu.training.distill_draft --features --vocab 128 --hidden 64 --layers 2 --ffn 128 --max-len 48 --seq 8 --horizon 24 --batch 8 --steps 30 --log-every 0 --out /tmp/draft_feat_smoke.npz

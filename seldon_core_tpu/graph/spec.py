@@ -353,6 +353,23 @@ class TpuSpec(_Spec):
     # tolerance-close, not bit-identical, to the fp pool. "" keeps the
     # computation dtype.
     decode_kv_dtype: str = ""
+    # Tiered prefix-page hierarchy (serving/kv_host_tier.py): > 0 gives
+    # the prefix cache a host-RAM demotion tier of this byte budget.
+    # Prefix entries the device pool evicts under pressure demote to host
+    # RAM (bytes exactly as stored on device — an int8 pool's quantized
+    # planes verbatim); a device miss at admission promotes the entry
+    # back into pinned free pages instead of recomputing, riding the
+    # pipelined rounds' overlap window. Host-only state: zero recompiles,
+    # greedy output stays bit-identical to a cold prefill. Requests may
+    # opt out (never widen) via meta.tags["kv_tier"] = "off" | "host".
+    # Needs decode_prefix_slots > 0. 0 (default) keeps evictions final.
+    decode_kv_host_bytes: int = 0
+    # Store URL (persistence/state.make_state_store: file:// or redis://)
+    # the host tier's own LRU spills its coldest entries to — the third
+    # tier, shared across replica restarts. Store outages degrade to
+    # skip-store, never abort. "" (default) = no store tier (host-LRU
+    # evictions are final). Needs decode_kv_host_bytes > 0.
+    decode_kv_store_tier: str = ""
     # Tensor-parallel decode over a named device mesh (parallel/tp.py):
     # e.g. {"tp": 4} shards decoder params, the paged KV page pool, and
     # the draft's flat cache on the attention HEAD axis (FFN on its

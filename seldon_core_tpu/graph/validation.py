@@ -372,6 +372,24 @@ def validate_deployment(dep: SeldonDeployment) -> None:
                 "decode_replicas > 1 or decode_autoscale_replicas > 1 (a "
                 "single replica has no surviving arm to evict onto)"
             )
+        if pred.tpu.decode_kv_host_bytes < 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_host_bytes must be >= 0"
+            )
+        if pred.tpu.decode_kv_host_bytes > 0 and pred.tpu.decode_prefix_slots <= 0:
+            # the host tier demotes/promotes PREFIX entries — without the
+            # prefix cache there is nothing to tier
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_host_bytes needs "
+                "decode_prefix_slots > 0 (the host tier holds demoted "
+                "prefix-cache entries)"
+            )
+        if pred.tpu.decode_kv_store_tier and pred.tpu.decode_kv_host_bytes <= 0:
+            problems.append(
+                f"predictor '{pred.name}' decode_kv_store_tier needs "
+                "decode_kv_host_bytes > 0 (the store is fed by the host "
+                "tier's LRU)"
+            )
         if pred.tpu.decode_prefix_ctx > 0 and pred.tpu.decode_prefix_slots == 0:
             problems.append(
                 f"predictor '{pred.name}' decode_prefix_ctx needs "

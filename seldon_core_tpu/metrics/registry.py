@@ -146,6 +146,30 @@ class NullMetrics:
         HBM. tp=1 on single-device deployments."""
         pass
 
+    # tiered prefix-page KV (serving/kv_host_tier.py): the demand-paged
+    # device -> host -> store hierarchy — bytes resident per slow tier,
+    # and the page flows between tiers the capacity multiple rides on
+    def decode_kv_tier_bytes(self, deployment: str, tier: str, nbytes: int) -> None:
+        """Bytes resident in one slow KV tier (``tier`` = host | store)."""
+        pass
+
+    def decode_kv_demotion(self, deployment: str, tier: str, n: int) -> None:
+        """``n`` prefix entries demoted INTO ``tier`` (host = device
+        eviction caught by the host pool, store = host-LRU spill)."""
+        pass
+
+    def decode_kv_promotion(self, deployment: str, tier: str, n: int) -> None:
+        """``n`` prefix entries promoted to the device pool FROM ``tier``
+        (host | store) — each one is a warm admission the device pool
+        alone would have prefilled cold."""
+        pass
+
+    def decode_kv_sibling_pull(self, deployment: str, outcome: str) -> None:
+        """One cross-replica prefix pull from the key's rendezvous home
+        (``outcome`` = hit | miss | error — errors degrade to cold
+        prefill, never fail the request)."""
+        pass
+
     # decode-loop flight telemetry (telemetry/flight.py + the scheduler's
     # per-round commit point): round-level device-busy/host-gap split,
     # the bubble-fraction gauge, goodput tokens, and SLO attainment
@@ -472,6 +496,33 @@ class Metrics(NullMetrics):
             ["deployment_name", "tp"],
             registry=registry,
         )
+        # tiered prefix-page KV (serving/kv_host_tier.py): slow-tier
+        # residency and the inter-tier page flows
+        self._kv_tier_bytes = Gauge(
+            "seldon_tpu_decode_kv_tier_bytes",
+            "Bytes of demoted prefix KV resident per slow tier (host|store)",
+            ["deployment_name", "tier"],
+            registry=registry,
+        )
+        self._kv_demotions = Counter(
+            "seldon_tpu_decode_kv_demotions_total",
+            "Prefix entries demoted into a slow KV tier (host|store)",
+            ["deployment_name", "tier"],
+            registry=registry,
+        )
+        self._kv_promotions = Counter(
+            "seldon_tpu_decode_kv_promotions_total",
+            "Prefix entries promoted to the device pool from a slow tier",
+            ["deployment_name", "tier"],
+            registry=registry,
+        )
+        self._kv_sibling_pulls = Counter(
+            "seldon_tpu_decode_kv_sibling_pulls_total",
+            "Cross-replica prefix pulls from the rendezvous home "
+            "(outcome=hit|miss|error)",
+            ["deployment_name", "outcome"],
+            registry=registry,
+        )
         # decode-loop flight telemetry: where each round's wall time went
         # (device busy vs host bubble), the cumulative bubble fraction, and
         # the goodput/SLO-attainment contract the ROADMAP's SLO-tiered
@@ -726,6 +777,20 @@ class Metrics(NullMetrics):
 
     def decode_kv_per_device(self, deployment, pages, tp):
         self._kv_per_device.labels(deployment, str(tp)).set(pages)
+
+    def decode_kv_tier_bytes(self, deployment, tier, nbytes):
+        self._kv_tier_bytes.labels(deployment, tier).set(nbytes)
+
+    def decode_kv_demotion(self, deployment, tier, n):
+        if n > 0:
+            self._kv_demotions.labels(deployment, tier).inc(n)
+
+    def decode_kv_promotion(self, deployment, tier, n):
+        if n > 0:
+            self._kv_promotions.labels(deployment, tier).inc(n)
+
+    def decode_kv_sibling_pull(self, deployment, outcome):
+        self._kv_sibling_pulls.labels(deployment, outcome).inc()
 
     def decode_round(self, deployment, busy_s, gap_s):
         self._decode_round_busy.labels(deployment).observe(busy_s)

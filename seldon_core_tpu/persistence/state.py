@@ -13,6 +13,7 @@ counts/values — host-side state, never jitted).
 from __future__ import annotations
 
 
+import hashlib
 import logging
 import os
 import pickle
@@ -37,7 +38,10 @@ class FileStateStore:
 
     def _path(self, key: str) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
-        return os.path.join(self.directory, safe + ".pkl")
+        # sanitizing is lossy ("a/b" and "a_b" collide) — a short digest of
+        # the RAW key keeps distinct keys in distinct files
+        digest = hashlib.blake2b(key.encode(), digest_size=4).hexdigest()
+        return os.path.join(self.directory, f"{safe}.{digest}.pkl")
 
     def save(self, key: str, payload: bytes) -> None:
         tmp = self._path(key) + ".tmp"
@@ -54,16 +58,35 @@ class FileStateStore:
 
 
 class RedisStateStore:
+    """Redis-backed store with a bounded socket budget: a hung Redis must
+    degrade to skip-store (save dropped, load misses — both logged), never
+    block the serving loop mid-spill/preseed."""
+
     def __init__(self, url: str):
         import redis  # gated: not in the base image
 
-        self._r = redis.Redis.from_url(url)
+        from seldon_core_tpu.utils.env import redis_timeout_s
+
+        timeout = redis_timeout_s()
+        self._errors = (redis.exceptions.ConnectionError, redis.exceptions.TimeoutError)
+        self._r = redis.Redis.from_url(
+            url,
+            socket_timeout=timeout,
+            socket_connect_timeout=timeout,
+        )
 
     def save(self, key: str, payload: bytes) -> None:
-        self._r.set(key, payload)
+        try:
+            self._r.set(key, payload)
+        except self._errors as e:
+            log.warning("redis save skipped (store unreachable): %s", e)
 
     def load(self, key: str) -> bytes | None:
-        return self._r.get(key)
+        try:
+            return self._r.get(key)
+        except self._errors as e:
+            log.warning("redis load skipped (store unreachable): %s", e)
+            return None
 
 
 def make_state_store(url: str):

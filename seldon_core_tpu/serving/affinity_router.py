@@ -577,6 +577,12 @@ class ReplicatedDecodeScheduler:
         self.stat_boot_failures = 0
         self.stat_spill_failures = 0
         self.stat_health_misses = 0
+        # sibling prefix pulls (tiered-KV fleet economy): in-flight
+        # transfer per (target arm, route key) so a thundering herd of
+        # same-prefix requests issues ONE pull; herd members await it
+        self._pulls: dict[tuple, asyncio.Task] = {}
+        self.stat_sibling_pulls = 0
+        self.stat_sibling_pull_failures = 0
         self._metrics.router_replicas(self._deployment, len(self.replicas))
 
     def _new_breaker(self, arm: int) -> CircuitBreaker:
@@ -816,6 +822,13 @@ class ReplicatedDecodeScheduler:
                     ErrorCode.ENGINE_MICROSERVICE_ERROR,
                     "decode fleet has no serving replicas",
                 )
+            if not rec.tokens:
+                # tiered-KV sibling pull: before this request prefills
+                # cold, ask the key's rendezvous home for the prefix
+                # entry (any of ITS tiers) — bounded, deduped, and
+                # degrade-on-failure inside; resumed legs skip it (their
+                # replay teacher-forces the whole context anyway)
+                await self._maybe_sibling_pull(prompt, arm)
             kw2 = dict(kw)
             if rec.tokens:
                 # resumed leg: teacher-force the already-streamed tokens
@@ -868,6 +881,81 @@ class ReplicatedDecodeScheduler:
                 raise
             finally:
                 self._inflight[arm].discard(rec)
+
+    async def _maybe_sibling_pull(self, prompt, arm: int) -> None:
+        """Fleet-wide prefix economy: when ``arm`` holds the prompt's
+        leading block in NONE of its local tiers (device index, host
+        pool, store index), ask the key's rendezvous home — the replica
+        affinity routing WOULD have sent this prefix to, so the likeliest
+        holder — for the entry before recomputing it cold. Per-(arm, key)
+        in-flight dedup: a thundering herd of same-prefix requests issues
+        one transfer and everyone awaits it. Every failure path degrades
+        to cold prefill; rides the ENGINE_DECODE_REPLICA_PRESEED kill
+        switch (it IS a preseed, request-time instead of boot-time)."""
+        if not preseed_enabled() or len(self.live_replicas) <= 1:
+            return
+        target = self.replicas[arm]
+        if target is None or not getattr(target, "prefix_enabled", False):
+            return
+        probe = getattr(target, "prefix_probe_depth", None)
+        if probe is None:
+            return
+        key = prefix_route_key(
+            prompt, block=self.affinity_block, seq_len=self.seq_len
+        )
+        if not key:
+            return  # keyless prompt (shorter than the affinity block)
+        try:
+            if probe(prompt) >= self.affinity_block:
+                return  # some local tier is already warm for the block
+        except Exception:  # noqa: BLE001 - a probe bug must not block serving
+            return
+        kt = (arm,) + tuple(key)
+        task = self._pulls.get(kt)
+        if task is None:
+            survivors = [
+                i
+                for i, _ in self.live_replicas
+                if i != arm and self._replica_states[i] == REPLICA_UP
+            ]
+            if not survivors:
+                return
+            home = max(survivors, key=lambda a: _key_rank(tuple(key), a))
+            task = asyncio.ensure_future(
+                self._pull_entry(home, arm, np.asarray(prompt, np.int32))
+            )
+            self._pulls[kt] = task
+            task.add_done_callback(lambda _t, kt=kt: self._pulls.pop(kt, None))
+        await task
+
+    async def _pull_entry(self, home_arm: int, target_arm: int, prompt) -> None:
+        """One sibling transfer: export the deepest covering entry from
+        the home's tiers (single-entry ``export_prefix_state`` payload)
+        and preseed it into the target's pool. Never raises — a failed
+        pull costs exactly what not pulling costs (a cold prefill)."""
+        try:
+            home = self.replicas[home_arm]
+            target = self.replicas[target_arm]
+            if home is None or target is None:
+                return
+            payload = home.export_prefix_entry(prompt)
+            if not payload:
+                self._metrics.decode_kv_sibling_pull(self._deployment, "miss")
+                return
+            if target.preseed_prefix_state(payload):
+                self.stat_sibling_pulls += 1
+                self._metrics.decode_kv_sibling_pull(self._deployment, "hit")
+            else:
+                # geometry mismatch / pool pressure / covered in the race
+                # window — the preseed declined, which is fine
+                self._metrics.decode_kv_sibling_pull(self._deployment, "miss")
+        except Exception:  # noqa: BLE001 - pull failure degrades to cold prefill
+            self.stat_sibling_pull_failures += 1
+            self._metrics.decode_kv_sibling_pull(self._deployment, "error")
+            log.warning(
+                "sibling prefix pull %s -> %s failed — cold prefill instead",
+                home_arm, target_arm, exc_info=True,
+            )
 
     async def execute_message(self, msg: SeldonMessage) -> SeldonMessage:
         """Buffered serving entry: every row routes independently (rows of

@@ -191,7 +191,9 @@ from seldon_core_tpu.serving.affinity_router import (
     capture_prefix_len,
     usable_prefix_len,
 )
+from seldon_core_tpu.serving.kv_host_tier import KVHostTier
 from seldon_core_tpu.serving.kv_pool import PagedKVPool
+from seldon_core_tpu.persistence.state import make_state_store
 
 log = logging.getLogger(__name__)
 
@@ -728,7 +730,7 @@ class _Seq:
         "prefilling", "prefill_pos", "prefix_len", "chunk_cap",
         "cache_prefix", "chunk_idx",
         "slo_deadline", "slo_ok", "slo_sink",
-        "replay", "emit_base",
+        "replay", "emit_base", "kv_tier",
     )
 
     def __init__(self, prompt, max_new, temperature, top_k, spec_k, on_token, future):
@@ -775,6 +777,11 @@ class _Seq:
         # duplicate or missing tokens.
         self.replay: tuple[int, ...] = ()
         self.emit_base = 0
+        # tiered-KV opt-out (meta.tags.kv_tier, tighten-only): "" = full
+        # ladder, "host" = no store consult, "off" = cold-only for this
+        # request (device prefix match still applies — the tag governs
+        # PROMOTION, the tiers below the device)
+        self.kv_tier = ""
         # the submitter's trace context(s), captured at submit: the decode
         # loop runs in its OWN task (no ambient request context), so spans
         # are attached to each sequence's originating trace explicitly
@@ -813,6 +820,8 @@ class DecodeScheduler:
         kv_page_size: int = 0,
         kv_pages: int = 0,
         kv_dtype: str = "",
+        kv_host_bytes: int = 0,
+        kv_store_url: str = "",
         mesh_axes: dict | None = None,
         slo_ttft_ms: float = 0.0,
         slo_itl_ms: float = 0.0,
@@ -1059,6 +1068,23 @@ class DecodeScheduler:
         )
         if self.prefix_enabled:
             self.pool.alloc.on_pins_reclaimed = self._on_pins_reclaimed
+        # demand-paged prefix-page tiers below the device pool
+        # (serving/kv_host_tier.py): entries the pool/index evict demote
+        # to host RAM (then the store); admission misses promote back
+        # through preseed_pin-pinned free pages. Host-only state — zero
+        # recompiles, bit-identical greedy output. A bad store URL raises
+        # here (direct construction is strict; scheduler_for_executor
+        # pre-checks and warn-disables).
+        self._host_tier = None
+        if self.prefix_enabled and int(kv_host_bytes) > 0:
+            self._host_tier = KVHostTier(
+                int(kv_host_bytes),
+                page_size=self.pool.page_size,
+                kv_dtype=self.pool.kv_dtype,
+                store=make_state_store(kv_store_url) if kv_store_url else None,
+                deployment=deployment_name or "decode",
+                metrics=metrics,
+            )
         if self.spec_enabled:
             self._dck, self._dcv = self._commit_kv(
                 draft_params, init_slot_cache(draft_params, n_slots, self._draft_ctx, dtype)
@@ -1256,6 +1282,13 @@ class DecodeScheduler:
         self.stat_prefix_capture_skips = 0
         # entries pre-seeded from another replica's spill at warm boot
         self.stat_prefix_preseeded = 0
+        # tiered-KV attribution (serving/kv_host_tier.py holds the tier's
+        # own counters; these track the scheduler's ladder traffic):
+        # device evictions demoted to host/store, misses promoted back,
+        # and how many promotions landed inside a pipeline overlap window
+        self.stat_tier_demotions = 0
+        self.stat_tier_promotions = 0
+        self.stat_tier_promote_overlap = 0
         self.stat_chunk_dispatches = 0
         # paged-pool attribution (the allocator owns the counters; these
         # track what the scheduler itself dispatched/declined)
@@ -1325,6 +1358,11 @@ class DecodeScheduler:
         # pending admits rolled back at reconcile (caller vanished in flight)
         self.stat_pipeline_rollbacks = 0
         self.stat_pipeline_plans_used = 0  # overlap-built chunk plans consumed
+        # whether the loop is currently inside an overlap window — read by
+        # the promotion path to attribute a promotion's transfer cost to
+        # the in-flight dispatch it hid behind (host-only observability
+        # state; single-writer: _overlap_window)
+        self._in_overlap = False
         self._round_reset()
 
     def _commit_kv(self, params, arrs):
@@ -1340,6 +1378,21 @@ class DecodeScheduler:
                 for a in arrs
             )
         return self._place_like(params, arrs)
+
+    @staticmethod
+    def _scatter_preserving_placement(dst, src, pages):
+        """Eagerly write ``src`` into ``dst[:, pages]`` without changing
+        the buffer's placement SIGNATURE — sharding and committed-ness
+        both key the jit caches, so a device_put that merely re-commits
+        an uncommitted pool buffer would force every compiled program
+        (step/chunk/copy) to recompile on the next live round. Only
+        re-place when the eager scatter actually moved the layout."""
+        out = dst.at[:, pages].set(jnp.asarray(src))
+        if out.sharding == dst.sharding and getattr(
+            out, "committed", True
+        ) == getattr(dst, "committed", True):
+            return out
+        return jax.device_put(out, dst.sharding)
 
     @staticmethod
     def _place_like(params, arrs):
@@ -1647,13 +1700,12 @@ class DecodeScheduler:
         )
         for ci, dst in enumerate(state):
             src = np.concatenate(staged_bytes[ci], axis=1)
-            state[ci] = jax.device_put(
-                dst.at[:, pages].set(jnp.asarray(src)), dst.sharding
-            )
+            state[ci] = self._scatter_preserving_placement(dst, src, pages)
         self.pool.state = tuple(state)
         for span, pin in staged:
             _, evicted = self._prefix_index.insert(span, pin.pages, pin.pin_id)
             if evicted is not None:
+                self._demote_entry(evicted)
                 self.pool.alloc.release(evicted.pin_id)
                 self._metrics.decode_prefix_evicted(self._deployment)
         self.stat_prefix_preseeded += len(staged)
@@ -1672,6 +1724,7 @@ class DecodeScheduler:
         spec_tree: str | None = None,
         cache_prefix: int | None = None,
         prefill_chunk: int | None = None,
+        kv_tier: str | None = None,
         on_token: OnToken | None = None,
         _slo_sink=None,
         _replay_tokens=None,
@@ -1754,6 +1807,18 @@ class DecodeScheduler:
                 )
         if self.prefix_enabled and cache_prefix is not None:
             seq.cache_prefix = max(0, min(int(cache_prefix), self.prefix_ctx))
+        if kv_tier is not None:
+            # tighten-only tier opt-out (meta.tags.kv_tier): "off" skips
+            # promotion entirely, "host" stops the consult at host RAM —
+            # a request can narrow the ladder, never widen it. Ignored
+            # (like every tier knob) when the tier is disabled.
+            kt = str(kv_tier)
+            if kt not in ("", "off", "host"):
+                raise APIException(
+                    ErrorCode.ENGINE_INVALID_JSON,
+                    f"meta.tags.kv_tier '{kt}' must be 'off' or 'host'",
+                )
+            seq.kv_tier = kt
         if self.queue_timeout_s > 0:
             seq.deadline = seq.t_enqueued + self.queue_timeout_s
         self._waiting.append(seq)
@@ -1857,11 +1922,186 @@ class DecodeScheduler:
     def _on_pins_reclaimed(self, pin_ids: list[int]) -> None:
         """Allocator callback, once per reclaim wave: pool pressure
         reclaimed prefix pins — drop the index entries that held them
-        (their pages are gone/repurposed)."""
+        (their pages are gone/repurposed). The demotion window: the
+        allocator fires this BEFORE any reclaimed page is repurposed, so
+        a device readback here still yields the entries' exact bytes —
+        the eviction becomes a demotion into the host tier instead of a
+        loss."""
+        if self._host_tier is not None:
+            for pin_id in pin_ids:
+                entry = self._prefix_index.entries.get(pin_id)
+                if entry is not None:
+                    self._demote_entry(entry)
         dropped = self._prefix_index.remove_by_pins(pin_ids)
         for _ in range(dropped):
             self._metrics.decode_prefix_evicted(self._deployment)
         self._metrics.decode_kv_reclaimed(self._deployment, len(pin_ids))
+
+    def _demote_entry(self, entry) -> None:
+        """Demote one evicted prefix entry's pages device → host tier:
+        gather its page columns from every pool component (bytes exactly
+        as stored — an int8 pool's quantized planes + scale/zp verbatim)
+        and hand them to the host tier's byte-budget LRU. Must run while
+        the entry's pages are still intact (before release/repurpose).
+        Failures degrade — a demotion is an optimization, never worth
+        aborting an eviction over."""
+        if self._host_tier is None:
+            return
+        try:
+            pages = jnp.asarray(np.asarray(entry.pages, np.int64), jnp.int32)
+            comps = [np.asarray(comp[:, pages]) for comp in self.pool.state]
+        except Exception:  # noqa: BLE001 - demotion is best-effort by contract
+            log.exception("prefix-entry demotion readback failed")
+            return
+        if self._host_tier.put(entry.tokens, comps):
+            self.stat_tier_demotions += 1
+
+    def _promote(self, seq: _Seq, depth: int) -> bool:
+        """Consult the host (then store) tier for an entry deeper than
+        the device match and promote it into pinned free pages. Runs on
+        both admission paths — serial ``_admit`` and ``_pipeline_admit``
+        under an in-flight dispatch, where the eager page scatter is
+        dataflow-safe (pool.state already points at the round's output
+        futures) and ``preseed_pin`` keeps the reservation invariant.
+        Returns whether the device index gained a deeper entry."""
+        tier = self._host_tier
+        include_store = seq.kv_tier != "host"
+        if tier.probe(seq.prompt, include_store=include_store) <= depth:
+            return False
+        got = tier.fetch(seq.prompt, min_depth=depth, include_store=include_store)
+        if got is None:
+            return False
+        tokens, comps, src_tier = got
+        t0 = telemetry.now_ns()
+        if not self._install_promoted(tokens, comps):
+            return False
+        self.stat_tier_promotions += 1
+        self._rb_promotions += 1
+        if self._in_overlap:
+            self.stat_tier_promote_overlap += 1
+        self._metrics.decode_kv_promotion(self._deployment, src_tier, 1)
+        nbytes = int(sum(int(np.asarray(c).nbytes) for c in comps))
+        for c in seq.trace_ctxs:
+            ms = c.buf.begin(
+                "decode.kv_promote",
+                c.span.span_id,
+                {
+                    "tier": src_tier,
+                    "bytes": nbytes,
+                    "overlap": self._in_overlap,
+                    **self._mesh_attrs,
+                },
+                start_ns=t0,
+            )
+            ms.add_event("promoted", {"tokens": int(np.asarray(tokens).shape[0])})
+            ms.end()
+        return True
+
+    def _install_promoted(self, tokens, comps) -> bool:
+        """Install one promoted entry's bytes into ``preseed_pin``-pinned
+        free pages + the prefix index — the single-entry twin of
+        ``preseed_prefix_state`` (same geometry clamps, same validate-
+        every-axis-before-pinning discipline, same eager scatter
+        re-committed to the resident sharding so warmed program
+        signatures are untouched). False degrades to cold prefill."""
+        state = list(self.pool.state)
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if len(comps) != len(state):
+            return False
+        length = capture_prefix_len(len(tokens), self.prefix_ctx, self.seq_len)
+        length = (length // self.pool.page_size) * self.pool.page_size
+        n_pages = self.pool.alloc.pages_for(length)
+        if n_pages < 1:
+            return False
+        span = tokens[:length]
+        _, depth = self._prefix_index.match(span, touch=False)
+        if depth >= length:
+            return False  # a device entry at least as deep already landed
+        entry_bytes = []
+        for ci, dst in enumerate(state):
+            full = np.asarray(comps[ci])
+            if (
+                full.ndim != len(dst.shape)
+                or full.shape[0] != dst.shape[0]
+                or full.shape[1] < n_pages
+                or full.shape[2:] != tuple(dst.shape[2:])
+                or full.dtype != dst.dtype
+            ):
+                return False
+            entry_bytes.append(full[:, :n_pages])
+        pin = self.pool.alloc.preseed_pin(n_pages)
+        if pin is None:
+            # free-list pressure: a promotion must never trigger the
+            # reclaim ladder it would immediately feed — cold prefill
+            # through the normal reservation path instead
+            return False
+        pages = np.asarray(pin.pages, np.int64)
+        for ci, dst in enumerate(state):
+            state[ci] = self._scatter_preserving_placement(
+                dst, entry_bytes[ci], pages
+            )
+        self.pool.state = tuple(state)
+        _, evicted = self._prefix_index.insert(span, pin.pages, pin.pin_id)
+        if evicted is not None:
+            self._demote_entry(evicted)
+            self.pool.alloc.release(evicted.pin_id)
+            self._metrics.decode_prefix_evicted(self._deployment)
+        return True
+
+    def prefix_probe_depth(self, prompt) -> int:
+        """How deep ANY local tier (device prefix index, host pool, store
+        index) could serve ``prompt`` — the sibling-pull guard's cheap
+        local check. Host-only metadata, no transfers, no LRU touch."""
+        if not self.prefix_enabled:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        _, depth = self._prefix_index.match(prompt, touch=False)
+        if self._host_tier is not None:
+            depth = max(depth, self._host_tier.probe(prompt))
+        return int(depth)
+
+    def export_prefix_entry(self, prompt) -> dict | None:
+        """One-entry spill payload (``export_prefix_state`` schema) for
+        the deepest local-tier entry covering ``prompt`` — what a
+        rendezvous home answers a sibling pull with. A host/store hit
+        reuses the demoted bytes directly; a device hit gathers that one
+        entry's page columns. None when no tier covers the prompt."""
+        if not self.prefix_enabled:
+            return None
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        entry, depth = self._prefix_index.match(prompt, touch=False)
+        host_depth = (
+            self._host_tier.probe(prompt) if self._host_tier is not None else 0
+        )
+        if host_depth > depth:
+            got = self._host_tier.fetch(prompt)
+            if got is not None:
+                tokens, comps, _tier = got
+                return {
+                    "page_size": self.pool.page_size,
+                    "kv_dtype": self.pool.kv_dtype,
+                    "entries": [
+                        {
+                            "tokens": np.asarray(tokens, np.int32).copy(),
+                            "components": [np.asarray(c) for c in comps],
+                        }
+                    ],
+                }
+        if entry is None or depth < 1:
+            return None
+        pages = jnp.asarray(np.asarray(entry.pages, np.int64), jnp.int32)
+        return {
+            "page_size": self.pool.page_size,
+            "kv_dtype": self.pool.kv_dtype,
+            "entries": [
+                {
+                    "tokens": np.asarray(entry.tokens, np.int32).copy(),
+                    "components": [
+                        np.asarray(comp[:, pages]) for comp in self.pool.state
+                    ],
+                }
+            ],
+        }
 
     def _kv_gauges(self) -> None:
         a = self.pool.alloc
@@ -1946,8 +2186,10 @@ class DecodeScheduler:
             return
         _, evicted = self._prefix_index.insert(seq.prompt[:length], pin.pages, pin.pin_id)
         if evicted is not None:
-            # index-cap LRU eviction: release the displaced entry's pin
+            # index-cap LRU eviction: demote the displaced entry to the
+            # host tier while its pages are intact, then release the pin
             # (its pages free unless live readers still map them)
+            self._demote_entry(evicted)
             self.pool.alloc.release(evicted.pin_id)
             self._metrics.decode_prefix_evicted(self._deployment)
         self.stat_prefix_captures += 1
@@ -2037,6 +2279,7 @@ class DecodeScheduler:
         self._rb_overlap = 0
         self._rb_probe = False
         self._rb_widths = ()
+        self._rb_promotions = 0
         # stale shadow admissions (a round error between the overlap
         # window and the reconcile): the normal flow drains the list at
         # _apply_pending before the round commits, so anything still here
@@ -2132,6 +2375,7 @@ class DecodeScheduler:
                     gap, snap["free"], snap["live"], snap["prefix"],
                     self._rb_cow, phase_ns, tuple(self._rb_rdb),
                     self._rb_overlap, self._rb_probe, tuple(self._rb_widths),
+                    self._rb_promotions,
                 )
             )
             if self.spec_enabled:
@@ -2186,6 +2430,19 @@ class DecodeScheduler:
         if self.prefix_enabled:
             with self._phase(P_PREFIX_MATCH):
                 entry, depth = self._prefix_index.match(seq.prompt)
+                # device-pool miss (or shallow hit): consult the tiers
+                # below — a host/store entry deeper than the device match
+                # promotes into pinned free pages and the re-match rides
+                # it. Promotion installs a cache entry (monotone), so the
+                # pipelined path's rollback discipline needs no undo; the
+                # kv_tier tag tightens the consult (off = cold-only,
+                # host = no store).
+                if (
+                    self._host_tier is not None
+                    and seq.kv_tier != "off"
+                    and self._promote(seq, depth)
+                ):
+                    entry, depth = self._prefix_index.match(seq.prompt)
             # the shared prompt->prefix normalization (affinity_router):
             # always leave >= 1 suffix token — the last prompt position's
             # logits are the first generated token's distribution. The
@@ -2354,6 +2611,7 @@ class DecodeScheduler:
         would break sum(phase) <= gap."""
         t0 = time.perf_counter_ns()
         self._phases.begin_overlap()
+        self._in_overlap = True
         try:
             if self._waiting and self._free and self._gate.allow("admit"):
                 g0 = time.perf_counter_ns()
@@ -2372,6 +2630,7 @@ class DecodeScheduler:
             # guaranteed per-round work that the flight hides for free
             self._pipeline_sundries()
         finally:
+            self._in_overlap = False
             self._phases.end_overlap()
             self._rb_overlap += time.perf_counter_ns() - t0
             self.stat_pipelined_rounds += 1
@@ -3396,6 +3655,11 @@ class DecodeScheduler:
             # the deployment tree at submit; ignored on non-tree
             # deployments (the tighten-only contract: nothing to narrow)
             out["spec_tree"] = str(tags["spec_tree"])
+        if "kv_tier" in tags:
+            # tiered-KV opt-out ("off" | "host") — tighten-only: a
+            # request can narrow the promotion ladder, never widen it;
+            # validated at submit
+            out["kv_tier"] = str(tags["kv_tier"])
         return out
 
     async def execute_message(self, msg: SeldonMessage) -> SeldonMessage:
@@ -3580,6 +3844,19 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
                 mesh_axes, "; ".join(problems),
             )
             mesh_axes = {}
+    kv_store_url = str(getattr(tpu_spec, "decode_kv_store_tier", "") or "")
+    if kv_store_url:
+        # pre-check the store URL with the same factory the ctor uses as
+        # a hard error — through serving a bad URL degrades the STORE
+        # tier only (host tier keeps working) with a log line
+        try:
+            make_state_store(kv_store_url)
+        except ValueError as e:
+            log.warning(
+                "decode_kv_store_tier=%r unservable (%s) — store tier "
+                "disabled, host tier only", kv_store_url, e,
+            )
+            kv_store_url = ""
     sched_kwargs = dict(
         seq_len=int(gen["seq"]),
         max_new_tokens=int(gen["max_new_tokens"]),
@@ -3598,6 +3875,8 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
         kv_page_size=int(getattr(tpu_spec, "decode_kv_page_size", 0)),
         kv_pages=int(getattr(tpu_spec, "decode_kv_pages", 0)),
         kv_dtype=str(getattr(tpu_spec, "decode_kv_dtype", "") or ""),
+        kv_host_bytes=int(getattr(tpu_spec, "decode_kv_host_bytes", 0)),
+        kv_store_url=kv_store_url,
         slo_ttft_ms=float(getattr(tpu_spec, "decode_slo_ttft_ms", 0.0)),
         slo_itl_ms=float(getattr(tpu_spec, "decode_slo_itl_ms", 0.0)),
         metrics=metrics,
@@ -3631,7 +3910,6 @@ def scheduler_for_executor(executor, tpu_spec, *, metrics=None, deployment_name=
     import os
 
     from seldon_core_tpu.serving.affinity_router import ReplicatedDecodeScheduler
-    from seldon_core_tpu.persistence.state import make_state_store
     from seldon_core_tpu.utils import env as envmod
 
     base_name = deployment_name or "decode"

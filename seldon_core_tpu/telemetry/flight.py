@@ -292,14 +292,16 @@ class FlightFrame:
     depth-1 recovery probe while degraded, the full-shape width probe
     while narrowed) — aggregates report these apart so exploration is
     never read as genuine accept degradation; ``spec_widths`` the tuned
-    per-depth width ceiling the round ran under (tree rounds only)."""
+    per-depth width ceiling the round ran under (tree rounds only);
+    ``promotions`` the prefix entries promoted device-ward from the slow
+    KV tiers (host/store/sibling) during the round's admissions."""
 
     __slots__ = (
         "seq", "t_ns", "mode", "active", "prefilling", "queued",
         "admitted", "retired", "blocked", "tokens", "accepted", "proposed",
         "spec_depth", "busy_ns", "gap_ns", "kv_free", "kv_live",
         "kv_prefix", "cow", "phase_ns", "rdb_ns", "overlap_ns",
-        "probe", "spec_widths",
+        "probe", "spec_widths", "promotions",
     )
 
     def __init__(
@@ -307,7 +309,7 @@ class FlightFrame:
         retired, blocked, tokens, accepted, proposed, spec_depth,
         busy_ns, gap_ns, kv_free, kv_live, kv_prefix, cow,
         phase_ns=_ZERO_PHASES, rdb_ns=_ZERO_FAMILIES, overlap_ns=0,
-        probe=False, spec_widths=(),
+        probe=False, spec_widths=(), promotions=0,
     ):
         self.seq = seq
         self.t_ns = t_ns
@@ -333,6 +335,7 @@ class FlightFrame:
         self.overlap_ns = overlap_ns
         self.probe = probe
         self.spec_widths = spec_widths
+        self.promotions = promotions
 
     def to_dict(self) -> dict:
         d: dict = {
@@ -388,6 +391,8 @@ class FlightFrame:
             d["probe"] = True
         if self.cow:
             d["cow"] = self.cow
+        if self.promotions:
+            d["promotions"] = self.promotions
         return d
 
 
@@ -444,6 +449,7 @@ class FlightRecorder:
         self.occupancy_sum = 0.0
         self.admitted_total = 0
         self.retired_total = 0
+        self.promotions_total = 0
         self.blocked_rounds: dict[str, int] = {}
         self.accepted_total = 0
         self.proposed_total = 0
@@ -499,6 +505,7 @@ class FlightRecorder:
         self.occupancy_sum += frame.active / self.n_slots
         self.admitted_total += frame.admitted
         self.retired_total += frame.retired
+        self.promotions_total += frame.promotions
         if frame.blocked:
             self.blocked_rounds[frame.blocked] = (
                 self.blocked_rounds.get(frame.blocked, 0) + 1
@@ -574,6 +581,7 @@ class FlightRecorder:
         gap = 0
         overlap = 0
         tokens = admitted = retired = accepted = proposed = 0
+        promotions = 0
         occ = 0.0
         modes: dict[str, int] = {}
         blocked: dict[str, int] = {}
@@ -591,6 +599,7 @@ class FlightRecorder:
             tokens += f.tokens
             admitted += f.admitted
             retired += f.retired
+            promotions += f.promotions
             accepted += f.accepted
             proposed += f.proposed
             occ += f.active / self.n_slots
@@ -651,6 +660,8 @@ class FlightRecorder:
             "retired": retired,
             "blocked_rounds": blocked,
         }
+        if promotions:
+            out["promotions"] = promotions
         if proposed:
             # accept_rate excludes PROBE rounds: a depth-1 recovery probe
             # or a full-shape width probe accepts badly BY DESIGN (that is

@@ -66,6 +66,7 @@ async def soak(
     profile_out: str = "",
     kill_replica: str = "",
     drain_replica: str = "",
+    kv_overflow: bool = False,
 ) -> dict:
     from seldon_core_tpu.graph.defaulting import default_deployment
     from seldon_core_tpu.graph.spec import SeldonDeployment
@@ -101,9 +102,19 @@ async def soak(
         paged = True
         if prefix_share <= 0:
             prefix_share = 0.6
+    if kv_overflow:
+        # the kv-overflow soak's point is the demote/promote churn of the
+        # host tier under sustained load: a paged pool, a DELIBERATELY
+        # tiny device prefix index, and a multi-group shared-prefix mix
+        # wide enough to overflow it — every capture evicts (demotes) and
+        # revisited groups promote back, all while the allocator audit
+        # and zero-recompile gates run as usual
+        paged = True
+        if prefix_share <= 0:
+            prefix_share = 0.6
     generative = (
         spec_k > 0 or bool(spec_tree) or prefix_share > 0 or paged or tp > 1
-        or replicas > 1
+        or replicas > 1 or kv_overflow
     )
     if generative:
         if model != "iris_mlp":
@@ -175,6 +186,16 @@ async def soak(
                 decode_kv_page_size=ps,
                 decode_kv_pages=budget,
                 decode_prefill_chunk=ps,
+            )
+        if kv_overflow:
+            # squeeze the device prefix index down to TWO entries and hang
+            # a host tier below it: with ~8 distinct shared-prefix groups
+            # in the mix, every capture evicts an older group (demotion)
+            # and every revisit of an evicted group promotes it back —
+            # sustained demote/promote churn over the full soak duration
+            predictor_extra["tpu"].update(
+                decode_prefix_slots=2,
+                decode_kv_host_bytes=32 << 20,
             )
         if replicas > 1:
             predictor_extra["tpu"].update(
@@ -286,6 +307,11 @@ async def soak(
     payload_fn = None
     shared_sent = {"n": 0}
     n_groups = 4 * replicas if replicas > 1 else 1
+    if kv_overflow:
+        # 4× the 2-entry device index: the working set of distinct shared
+        # prefixes CANNOT fit on device, so overflow (and the host tier
+        # underneath it) is guaranteed, not load-dependent
+        n_groups = max(n_groups, 8)
     if prefix_share > 0:
         # prompt mix: `prefix_share` of requests open with a fixed system
         # prefix (half the prompt bucket) + a random tail, the rest are
@@ -306,7 +332,15 @@ async def soak(
             if rng.random() < prefix_share:
                 shared_sent["n"] += 1
                 g = rng.randrange(n_groups)
-                prompt = prefixes[g] + tail(features - shared_len)
+                if kv_overflow:
+                    # group-DETERMINISTIC full prompts: the host tier holds
+                    # whole page-aligned spans (entry must prefix the
+                    # prompt), so a revisit only promotes when it replays
+                    # the captured span exactly — random tails would bury
+                    # the shared head inside never-rehit entries
+                    prompt = [7 + g] * features
+                else:
+                    prompt = prefixes[g] + tail(features - shared_len)
             else:
                 prompt = tail(features)
             return {"data": {"ndarray": [prompt] * batch}}
@@ -711,6 +745,39 @@ async def soak(
             "chunk_dispatches": sched.stat_chunk_dispatches,
             "recompiles_after_warmup": sched.recompiles_since_warmup(),
         }
+    kvtier_stats = None
+    if kv_overflow and sched is not None:
+        tier = getattr(sched, "_host_tier", None)
+        kvtier_stats = {
+            "groups": n_groups,
+            "prefix_slots": 2,
+            "demotions": sched.stat_tier_demotions,
+            "promotions": sched.stat_tier_promotions,
+            "promote_overlap": sched.stat_tier_promote_overlap,
+            "sent_shared": shared_sent["n"],
+            "recompiles_after_warmup": sched.recompiles_since_warmup(),
+            **({"host_tier": tier.snapshot()} if tier is not None else {}),
+        }
+        # with 8 distinct groups and a 2-entry device index, every capture
+        # past the second must evict-and-demote — zero demotions means the
+        # tier was never wired in and the soak proved nothing
+        if shared_sent["n"] >= n_groups and kvtier_stats["demotions"] < 1:
+            raise RuntimeError(
+                "soak --kv-overflow: the device prefix index never demoted "
+                "to the host tier — overflow was not exercised"
+            )
+        # revisited groups must come back WARM from the host tier; enough
+        # shared traffic makes a revisit-of-evicted statistically certain
+        if shared_sent["n"] >= 4 * n_groups and kvtier_stats["promotions"] < 1:
+            raise RuntimeError(
+                "soak --kv-overflow: no evicted prefix was ever promoted "
+                "back from the host tier — the ladder is one-way"
+            )
+        if kvtier_stats["recompiles_after_warmup"] != 0:
+            raise RuntimeError(
+                "soak --kv-overflow: promotion churn recompiled a decode "
+                "program — tier traffic must never touch compiled signatures"
+            )
     return {
         "duration_s": duration_s,
         "users": users,
@@ -746,6 +813,7 @@ async def soak(
         **({"spec": spec_stats} if spec_stats is not None else {}),
         **({"prefix": prefix_stats} if prefix_stats is not None else {}),
         **({"paged": paged_stats} if paged_stats is not None else {}),
+        **({"kv_tier": kvtier_stats} if kvtier_stats is not None else {}),
         **({"tp": tp_stats} if tp_stats is not None else {}),
     }
 
@@ -866,6 +934,17 @@ def main(argv=None) -> None:
         "errors and the post-drain warm hit rate stays within 5%% of "
         "pre-drain (when enough post-drain traffic ran to judge)",
     )
+    ap.add_argument(
+        "--kv-overflow",
+        action="store_true",
+        help="run the soak against a generative deployment whose device "
+        "prefix index is squeezed to TWO entries under an 8-group shared-"
+        "prefix mix, with a host-RAM KV tier hung below it — sustained "
+        "evict/demote + revisit/promote churn with the allocator audit and "
+        "zero-recompile gate live; the run FAILS unless demotions AND "
+        "promotions both fired and no decode program recompiled; the "
+        "report gains the tier counters under 'kv_tier' (implies --paged)",
+    )
     ap.add_argument("--fault-seed", type=int, default=1337)
     ap.add_argument("--fault-error-rate", type=float, default=0.3)
     ap.add_argument("--fault-latency-ms", type=float, default=0.0)
@@ -916,6 +995,7 @@ def main(argv=None) -> None:
                 profile_out=args.profile,
                 kill_replica=args.kill_replica,
                 drain_replica=args.drain_replica,
+                kv_overflow=args.kv_overflow,
             )
         )
 

@@ -26,6 +26,12 @@ SELDON_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
 # state persistence for wrapped user objects (serving/microservice.py):
 # store URL consumed by persistence/state.make_state_store
 PERSISTENCE_STORE = "PERSISTENCE_STORE"  # default file://./.seldon_state
+# redis state-store socket budget (persistence/state.RedisStateStore):
+# connect AND per-op timeout in ms. A hung Redis must never wedge the
+# serving loop mid-spill/preseed — operations past the budget degrade to
+# skip-store (save dropped, load misses), matching the spill path's
+# "store outage degrades, never aborts" contract.
+PERSISTENCE_REDIS_TIMEOUT_MS = "PERSISTENCE_REDIS_TIMEOUT_MS"  # default 2000
 # control-plane / tooling (not injected by the operator; read by humans'
 # shells and CI): kubectl-proxy style API endpoint for the k8s watcher,
 # the PYTHON_CLASS capability gate, and the release registry prefix
@@ -101,6 +107,21 @@ def rest_timeouts(env: dict | None = None) -> tuple[float, float]:
             value = default
         out.append(value if value > 0 else default)
     return out[0], out[1]
+
+
+def redis_timeout_s(env: dict | None = None) -> float:
+    """Redis socket/connect timeout in SECONDS (redis-py's unit), from the
+    PERSISTENCE_REDIS_TIMEOUT_MS env var. Falls back to the 2000 ms default
+    on unset OR unparsable values — a typo'd timeout must not take state
+    persistence down at boot."""
+    env = env if env is not None else os.environ
+    try:
+        ms = float(env.get(PERSISTENCE_REDIS_TIMEOUT_MS, 2000.0))
+    except (TypeError, ValueError):
+        ms = 2000.0
+    if ms <= 0:
+        ms = 2000.0
+    return ms / 1000.0
 
 
 def encode_b64_json(obj: Any) -> str:

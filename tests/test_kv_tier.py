@@ -1,0 +1,580 @@
+"""Tiered prefix-page KV economy (serving/kv_host_tier.py + the
+decode-scheduler/router integration).
+
+The load-bearing invariants:
+
+- a device eviction (allocator pin reclaim OR index-cap LRU) DEMOTES the
+  entry's pages to the host tier with its exact bytes, and a later
+  device-pool miss PROMOTES it back through preseed_pin-pinned free pages
+  — greedy output stays bit-identical to a cold prefill (fp and int8,
+  plain and tree-spec, pipelined and serial) and nothing recompiles;
+- the host tier's own LRU spills its coldest entries to the persistence
+  store, promotion climbs back THROUGH the tiers, and store corruption /
+  outages degrade to cold prefill, never abort;
+- meta.tags.kv_tier is tighten-only ("off" = cold-only, "host" = no store
+  consult);
+- a replica missing all local tiers pulls the entry from the key's
+  rendezvous home (one transfer per (arm, key) herd) instead of
+  recomputing;
+- the allocator's consistency audit stays green under a 10k-op random
+  demote/promote/pull interleaving (the PageAllocator.check() soak).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.decoder import generate, init_decoder
+from seldon_core_tpu.persistence.state import FileStateStore
+from seldon_core_tpu.serving.decode_scheduler import DecodeScheduler
+from seldon_core_tpu.serving.kv_host_tier import KVHostTier, tier_store_key
+from seldon_core_tpu.serving.kv_pool import PageAllocator
+
+SEQ = 8
+MAX_NEW = 6
+VOCAB = 128
+HOST_BUDGET = 1 << 26  # ample host budget for the tiny test pools
+
+
+def _params(**kw):
+    return init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=2, ffn=128, max_len=64, **kw
+    )
+
+
+def _oracle(params, ids, max_new=MAX_NEW):
+    return np.asarray(generate(params, jnp.asarray(ids), max_new))
+
+
+def _scheduler(params, n_slots=2, **kw) -> DecodeScheduler:
+    s = DecodeScheduler(
+        params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=n_slots, **kw
+    )
+    s.warmup()
+    return s
+
+
+def _prompts(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, VOCAB, (n, SEQ)).astype(np.int32)
+
+
+# ------------------------------------------------------------- tier unit
+
+
+def _fake_comps(n_pages, fill=1.0):
+    # one pool-component lookalike [L, n_pages, h, page_size, hd]
+    return [np.full((2, n_pages, 2, 4, 4), fill, np.float32)]
+
+
+def test_host_tier_put_probe_fetch_and_lru_spill(tmp_path):
+    store = FileStateStore(str(tmp_path))
+    entry_bytes = _fake_comps(1)[0].nbytes
+    tier = KVHostTier(
+        2 * entry_bytes, page_size=4, store=store, deployment="t"
+    )
+    a = np.arange(4, dtype=np.int32)
+    b = np.arange(4, 8, dtype=np.int32)
+    c = np.arange(8, 12, dtype=np.int32)
+    assert tier.put(a, _fake_comps(1, 1.0))
+    assert tier.put(b, _fake_comps(1, 2.0))
+    assert len(tier) == 2 and tier.host_bytes == 2 * entry_bytes
+    # probe is longest-covering-span, prefix semantics
+    assert tier.probe(np.concatenate([a, a])) == 4
+    assert tier.probe(c) == 0
+    # refresh a, then overflow: b (the LRU) spills to the store
+    assert tier.fetch(a) is not None
+    assert tier.put(c, _fake_comps(1, 3.0))
+    assert len(tier) == 2 and tier.store_entries == 1
+    assert tier.probe(b) == 4  # still serveable — via the store index
+    assert tier.probe(b, include_store=False) == 0
+    # fetch climbs through the store and re-admits into the host pool
+    got = tier.fetch(b)
+    assert got is not None
+    tokens, comps, src = got
+    assert src == "store"
+    np.testing.assert_array_equal(tokens, b)
+    np.testing.assert_array_equal(comps[0], _fake_comps(1, 2.0)[0])
+    assert tier.stat_promotions_store == 1
+    # a covered (host-resident) span is skipped, a deeper one admits
+    ab = np.concatenate([a, np.arange(100, 104, dtype=np.int32)])
+    assert not tier.put(b, _fake_comps(1))
+    assert tier.put(ab, _fake_comps(2))
+    assert tier.probe(ab) == 8
+
+
+def test_host_tier_no_store_evicts_and_corrupt_store_degrades(tmp_path):
+    # no store: LRU overflow drops entries (evictions are final)
+    entry_bytes = _fake_comps(1)[0].nbytes
+    tier = KVHostTier(entry_bytes, page_size=4, deployment="t")
+    a = np.arange(4, dtype=np.int32)
+    b = np.arange(4, 8, dtype=np.int32)
+    assert tier.put(a, _fake_comps(1))
+    assert tier.put(b, _fake_comps(1))
+    assert len(tier) == 1 and tier.stat_evictions == 1
+    assert tier.probe(a) == 0
+    # corrupt store payload: fetch drops the index entry, returns None
+    store = FileStateStore(str(tmp_path))
+    tier2 = KVHostTier(entry_bytes, page_size=4, store=store, deployment="t")
+    assert tier2.put(a, _fake_comps(1))
+    assert tier2.put(b, _fake_comps(1))  # a spills to the store
+    assert tier2.store_entries == 1
+    store.save(tier_store_key("t", a), b"not a pickle")
+    assert tier2.fetch(a) is None
+    assert tier2.store_entries == 0 and tier2.stat_store_drops == 1
+    # geometry mismatch is dropped the same way
+    assert tier2.put(a, _fake_comps(1))
+    assert tier2.put(b, _fake_comps(1))
+    store.save(
+        tier_store_key("t", a),
+        pickle.dumps(
+            {"page_size": 999, "kv_dtype": "", "tokens": a,
+             "components": _fake_comps(1)}
+        ),
+    )
+    assert tier2.fetch(a) is None and tier2.stat_store_drops == 2
+
+
+def test_partial_page_spans_clamp_down():
+    tier = KVHostTier(1 << 20, page_size=4, deployment="t")
+    assert not tier.put(np.arange(3, dtype=np.int32), _fake_comps(1))
+    assert tier.put(np.arange(6, dtype=np.int32), _fake_comps(1))
+    assert tier.probe(np.arange(6, dtype=np.int32)) == 4  # page boundary
+
+
+# ---------------------------------------- allocator + tier property soak
+
+
+def test_allocator_tier_soak_demote_promote_pull_invariants():
+    """10k random demote/promote/pull operations against the allocator's
+    full consistency audit: captures release through a demotion, misses
+    promote through preseed_pin (which must keep the reservation
+    invariant — promotion during admission pressure), and a second tier
+    receives sibling pulls. check() green throughout, clean drain."""
+    rng = np.random.default_rng(7)
+    n_slots, ps, pps = 4, 4, 5
+    alloc = PageAllocator(
+        n_pages=3 * pps + 2, page_size=ps, n_slots=n_slots, pages_per_slot=pps
+    )
+    tier = KVHostTier(1 << 16, page_size=ps, deployment="soak")
+    sibling = KVHostTier(1 << 16, page_size=ps, deployment="soak2")
+    cursor = [-1] * n_slots
+    forked = [False] * n_slots
+    pins: list = []  # (pin, token span) — demotable device entries
+    known: list = []  # token spans the tier may hold
+    serial = [0]
+
+    def _span(n_tokens):
+        serial[0] += 1
+        return np.full(n_tokens, serial[0] % (1 << 30), np.int32)
+
+    ops = 0
+    for step in range(10_000):
+        ops += 1
+        free_slots = [s for s in range(n_slots) if cursor[s] < 0]
+        busy = [s for s in range(n_slots) if cursor[s] >= 0]
+        r = rng.random()
+        if r < 0.22 and free_slots:
+            slot = int(rng.choice(free_slots))
+            if pins and rng.random() < 0.5:
+                pin, _ = pins[int(rng.integers(len(pins)))]
+                reuse = int(rng.integers(1, len(pin.pages) * ps + 1))
+                ok = alloc.try_admit(slot, pin.pages, reuse, extra_reserve=1)
+                start = reuse
+            else:
+                ok = alloc.try_admit(slot, (), 0, extra_reserve=1)
+                start = 0
+            if ok:
+                cursor[slot] = start
+                forked[slot] = False
+        elif r < 0.47 and busy:
+            slot = int(rng.choice(busy))
+            count = int(rng.integers(1, ps + 2))
+            alloc.prepare_write(slot, cursor[slot], count)
+            cursor[slot] = min(cursor[slot] + count, pps * ps)
+        elif r < 0.60 and busy:
+            slot = int(rng.choice(busy))
+            upto = min(cursor[slot], 12)
+            if upto >= 1 and not forked[slot]:
+                pin = alloc.capture(slot, int(rng.integers(1, upto + 1)))
+                if pin is not None:
+                    pins.append((pin, _span(len(pin.pages) * ps)))
+                    forked[slot] = True
+        elif r < 0.72 and pins:
+            # DEMOTE: eviction path — readback-shaped put, then release
+            pin, span = pins.pop(int(rng.integers(len(pins))))
+            if pin.pin_id in alloc._pins:
+                tier.put(span, _fake_comps(len(pin.pages)))
+                known.append(span)
+                alloc.release(pin.pin_id)
+        elif r < 0.84 and known:
+            # PROMOTE: a tier hit pins free pages — must never break the
+            # reservation invariant under whatever is currently admitted
+            span = known[int(rng.integers(len(known)))]
+            got = tier.fetch(span)
+            if got is not None:
+                tokens, comps, _src = got
+                n = len(tokens) // ps
+                pin = alloc.preseed_pin(n)
+                if pin is not None:
+                    pins.append((pin, _span(n * ps)))
+        elif r < 0.92 and busy:
+            slot = int(rng.choice(busy))
+            alloc.retire(slot)
+            cursor[slot] = -1
+        elif known:
+            # SIBLING PULL: export from this tier, preseed the sibling's
+            span = known[int(rng.integers(len(known)))]
+            got = tier.fetch(span)
+            if got is not None:
+                tokens, comps, _src = got
+                sibling.put(tokens, comps)
+        if step % 50 == 0:
+            pins = [(p, t) for p, t in pins if p.pin_id in alloc._pins]
+            alloc.check()
+    pins = [(p, t) for p, t in pins if p.pin_id in alloc._pins]
+    alloc.check()
+    for slot in range(n_slots):
+        if cursor[slot] >= 0:
+            alloc.retire(slot)
+    for pin, _ in pins:
+        alloc.release(pin.pin_id)
+    alloc.check()
+    assert alloc.free_pages == alloc.n_pages - 1, "pages leaked after drain"
+    assert ops == 10_000
+    assert tier.stat_demotions_host > 0 and tier.stat_promotions_host > 0
+    assert len(sibling) > 0
+
+
+# --------------------------------- bit-identity: warm-from-host == cold
+
+
+async def _evict_then_resubmit(sched, ids, oracle, **resubmit_kw):
+    """Drive the demotion window: submit A (auto-captured at retirement),
+    then B with prefix_slots=1 (its capture LRU-evicts A's entry, which
+    demotes to the host tier), then A again (device miss -> promotion)."""
+    np.testing.assert_array_equal(await sched.submit(ids[0]), oracle[0])
+    np.testing.assert_array_equal(await sched.submit(ids[1]), oracle[1])
+    assert sched.stat_tier_demotions >= 1, "eviction did not demote"
+    out = await sched.submit(ids[0], **resubmit_kw)
+    return out
+
+
+@pytest.mark.parametrize("pipelined", [True, False])
+async def test_warm_from_host_bit_identical_greedy_fp(pipelined):
+    params = _params()
+    ids = _prompts(2, seed=11)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(
+        params, prefix_slots=1, kv_page_size=4, kv_host_bytes=HOST_BUDGET
+    )
+    sched.pipeline_enabled = pipelined
+    out = await _evict_then_resubmit(sched, ids, oracle)
+    np.testing.assert_array_equal(out, oracle[0])
+    assert sched.stat_tier_promotions >= 1, "device miss did not promote"
+    assert sched.stat_prefix_hits >= 1  # the promoted entry served warm
+    assert sched.flight.promotions_total >= 1  # flight frame attribution
+    assert sched.recompiles_since_warmup() == 0, sched.compile_counts()
+    await sched.close()
+
+
+async def test_warm_from_host_int8_matches_own_cold_output():
+    """int8 pools are tolerance-close to fp, but warm-from-host must be
+    BIT-identical to the same scheduler's cold output — the demoted
+    scale/zp planes ride verbatim, no quantization round-trip."""
+    params = _params()
+    ids = _prompts(2, seed=13)
+    sched = _scheduler(
+        params, prefix_slots=1, kv_page_size=4, kv_dtype="int8",
+        kv_host_bytes=HOST_BUDGET,
+    )
+    cold0 = await sched.submit(ids[0])
+    await sched.submit(ids[1])  # capture evicts + demotes entry 0
+    assert sched.stat_tier_demotions >= 1
+    warm0 = await sched.submit(ids[0])
+    np.testing.assert_array_equal(warm0, cold0)
+    assert sched.stat_tier_promotions >= 1
+    assert sched.recompiles_since_warmup() == 0, sched.compile_counts()
+    await sched.close()
+
+
+async def test_warm_from_host_tree_spec_bit_identical():
+    tgt = _params(resid_scale=0.1)
+    drf = init_decoder(
+        seed=3, vocab=VOCAB, hidden=64, layers=1, ffn=128, max_len=64,
+        resid_scale=0.1,
+    )
+    ids = _prompts(2, seed=17)
+    oracle = _oracle(tgt, ids)
+    sched = _scheduler(
+        tgt, draft_params=drf, spec_tree="2,1", prefix_slots=1,
+        kv_page_size=4, kv_host_bytes=HOST_BUDGET,
+    )
+    out = await _evict_then_resubmit(sched, ids, oracle)
+    np.testing.assert_array_equal(out, oracle[0])
+    assert sched.stat_tier_promotions >= 1
+    assert sched.stat_spec_dispatches > 0
+    assert sched.recompiles_since_warmup() == 0, sched.compile_counts()
+    await sched.close()
+
+
+async def test_store_tier_promotes_through_and_kv_tier_tag(tmp_path):
+    """kv_host_bytes=1: every demotion falls straight through to the
+    store tier; a resubmit promotes store -> device and stays
+    bit-identical. kv_tier="host" skips the store consult; kv_tier="off"
+    skips promotion entirely; a junk value is a client error."""
+    from seldon_core_tpu.core.errors import APIException
+
+    params = _params()
+    ids = _prompts(2, seed=19)
+    oracle = _oracle(params, ids)
+    sched = _scheduler(
+        params, prefix_slots=1, kv_page_size=4, kv_host_bytes=1,
+        kv_store_url=f"file://{tmp_path}",
+    )
+    np.testing.assert_array_equal(await sched.submit(ids[0]), oracle[0])
+    np.testing.assert_array_equal(await sched.submit(ids[1]), oracle[1])
+    # device index: B; store: A (evicted, too big for the 1-byte host pool)
+    assert sched._host_tier.stat_demotions_store >= 1
+    # tighten-only consult: "host" can't see the store, "off" sees nothing
+    # (each cold resubmit re-captures, evicting the other prompt to store)
+    out = await sched.submit(ids[0], kv_tier="host")
+    np.testing.assert_array_equal(out, oracle[0])
+    assert sched.stat_tier_promotions == 0
+    out = await sched.submit(ids[1], kv_tier="off")
+    np.testing.assert_array_equal(out, oracle[1])
+    assert sched.stat_tier_promotions == 0
+    # the full ladder promotes through the store (device index holds B,
+    # A is store-resident after the kv_tier="off" recapture evicted it)
+    out = await sched.submit(ids[0])
+    np.testing.assert_array_equal(out, oracle[0])
+    assert sched.stat_tier_promotions >= 1
+    assert sched._host_tier.stat_promotions_store >= 1
+    with pytest.raises(APIException):
+        await sched.submit(ids[0], kv_tier="bogus")
+    assert sched.recompiles_since_warmup() == 0, sched.compile_counts()
+    await sched.close()
+
+
+async def test_pool_pressure_reclaim_demotes_not_loses():
+    """The allocator-pressure eviction path (_on_pins_reclaimed): a tight
+    page budget reclaims prefix pins to admit new work — with the host
+    tier on, the reclaimed entries demote instead of vanishing, and a
+    resubmit of the reclaimed prompt promotes back bit-identically."""
+    params = _params()
+    ids = _prompts(4, seed=23)
+    oracle = _oracle(params, ids)
+    # budget sized so slots + a couple prefix pins oversubscribe: serving
+    # the full set MUST reclaim pinned prefix pages at some point
+    sched = _scheduler(
+        params, n_slots=2, prefix_slots=8, kv_page_size=4, kv_pages=10,
+        kv_host_bytes=HOST_BUDGET,
+    )
+    for i, row in enumerate(ids):
+        np.testing.assert_array_equal(await sched.submit(row), oracle[i])
+    assert sched.stat_tier_demotions >= 1, "pressure reclaim did not demote"
+    out = await sched.submit(ids[0])
+    np.testing.assert_array_equal(out, oracle[0])
+    assert sched.stat_tier_promotions >= 1
+    sched.pool.alloc.check()
+    assert sched.recompiles_since_warmup() == 0, sched.compile_counts()
+    await sched.close()
+
+
+# ------------------------------------------------- sibling pull (fleet)
+
+
+async def test_warm_from_sibling_export_preseed_bit_identical():
+    """The transfer primitive the router's pull rides: export the deepest
+    covering entry from one scheduler's tiers, preseed it into a sibling,
+    and the sibling's first request admits warm and bit-identical."""
+    params = _params()
+    ids = _prompts(2, seed=29)
+    oracle = _oracle(params, ids)
+    s1 = _scheduler(params, prefix_slots=4, kv_page_size=4,
+                    kv_host_bytes=HOST_BUDGET)
+    s2 = _scheduler(params, prefix_slots=4, kv_page_size=4,
+                    kv_host_bytes=HOST_BUDGET)
+    np.testing.assert_array_equal(await s1.submit(ids[0]), oracle[0])
+    assert s1.prefix_probe_depth(ids[0]) > 0
+    assert s2.prefix_probe_depth(ids[0]) == 0
+    payload = s1.export_prefix_entry(ids[0])
+    assert payload and len(payload["entries"]) == 1
+    assert s2.preseed_prefix_state(payload) == 1
+    out = await s2.submit(ids[0])
+    np.testing.assert_array_equal(out, oracle[0])
+    assert s2.stat_prefix_hits == 1
+    # export also serves from the HOST tier after a device eviction
+    np.testing.assert_array_equal(await s1.submit(ids[1]), oracle[1])
+    for pin_id in list(s1._prefix_index.entries):
+        s1._demote_entry(s1._prefix_index.entries[pin_id])
+        s1._prefix_index.remove_by_pins([pin_id])
+        s1.pool.alloc.release(pin_id)
+    assert s1.export_prefix_entry(ids[0]) is not None
+    await s1.close()
+    await s2.close()
+
+
+async def test_router_sibling_pull_end_to_end():
+    """Round-robin routing (the control policy whose hit rate collapses
+    without pulls) over 2 replicas: requests landing on the cold arm pull
+    the group's entry from its rendezvous home — output bit-identical,
+    one transfer per (arm, key), and the cold arm serves warm."""
+    from seldon_core_tpu.serving.affinity_router import (
+        ReplicatedDecodeScheduler,
+    )
+
+    params = init_decoder(
+        seed=5, vocab=VOCAB, hidden=32, layers=1, ffn=64, max_len=32
+    )
+    rng = np.random.default_rng(2)
+    head = rng.integers(0, VOCAB, 4).astype(np.int32)
+    prompts = [
+        np.concatenate([head, rng.integers(0, VOCAB, SEQ - 4)]).astype(np.int32)
+        for _ in range(6)
+    ]
+    oracle = np.asarray(generate(params, jnp.asarray(np.stack(prompts)), MAX_NEW))
+
+    def factory(i):
+        return DecodeScheduler(
+            params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=2,
+            prefix_slots=8, kv_page_size=4, kv_host_bytes=HOST_BUDGET,
+            deployment_name=f"pulls/r{i}", replica_id=i,
+        )
+
+    rep = ReplicatedDecodeScheduler(
+        factory, 2, policy="round_robin", affinity_block=4,
+        deployment_name="pulls", seed=0,
+    )
+    rep.warmup()
+    outs = []
+    for p in prompts:  # sequential: round-robin alternates arms
+        outs.append(await rep.submit(p))
+    np.testing.assert_array_equal(np.stack(outs), oracle)
+    # the second arm pulled the shared entry instead of recomputing it:
+    # exactly one cold capture fleet-wide (the PR 16 round-robin control
+    # paid one per replica)
+    assert rep.stat_sibling_pulls >= 1
+    assert rep.stat_prefix_misses == 1
+    assert rep.stat_prefix_hits == len(prompts) - 1
+    await rep.close()
+
+
+# ------------------------------------------------------- knobs/validation
+
+
+def test_validation_rejects_bad_tier_knobs():
+    from seldon_core_tpu.graph.spec import SeldonDeployment
+    from seldon_core_tpu.graph.validation import ValidationError, validate_deployment
+
+    from seldon_core_tpu.graph.defaulting import default_deployment
+
+    def _dep(**tpu):
+        return default_deployment(
+            SeldonDeployment.from_dict(
+                {
+                    "spec": {
+                        "name": "d",
+                        "predictors": [
+                            {
+                                "name": "p",
+                                "graph": {
+                                    "name": "m",
+                                    "type": "MODEL",
+                                    "implementation": "JAX_MODEL",
+                                },
+                                "tpu": tpu,
+                            }
+                        ],
+                    }
+                }
+            )
+        )
+
+    ok = _dep(
+        decode_slots=2, decode_prefix_slots=4,
+        decode_kv_host_bytes=1 << 20,
+        decode_kv_store_tier="file:///tmp/kvtier",
+    )
+    validate_deployment(ok)
+    with pytest.raises(ValidationError, match="decode_kv_host_bytes"):
+        validate_deployment(_dep(decode_slots=2, decode_kv_host_bytes=-1))
+    with pytest.raises(ValidationError, match="needs decode_prefix_slots"):
+        validate_deployment(_dep(decode_slots=2, decode_kv_host_bytes=1024))
+    with pytest.raises(ValidationError, match="needs decode_kv_host_bytes"):
+        validate_deployment(
+            _dep(
+                decode_slots=2, decode_prefix_slots=4,
+                decode_kv_store_tier="file:///tmp/x",
+            )
+        )
+
+
+async def test_serving_wiring_strict_ctor_degrading_executor():
+    """Direct construction is strict about the store URL; through the
+    TpuSpec -> scheduler_for_executor path a bad URL disables the STORE
+    tier only (warn-disable precedent) and the host tier keeps working.
+    meta.tags.kv_tier plumbs through request_params_from_meta."""
+    from seldon_core_tpu.core.message import Meta
+    from seldon_core_tpu.graph.spec import PredictorSpec
+    from seldon_core_tpu.serving.server import PredictorServer
+
+    params = _params()
+    with pytest.raises(ValueError, match="unknown state store url"):
+        DecodeScheduler(
+            params, seq_len=SEQ, max_new_tokens=MAX_NEW, n_slots=1,
+            prefix_slots=2, kv_host_bytes=1024, kv_store_url="bogus://x",
+        )
+    pred = PredictorSpec.model_validate(
+        {
+            "name": "p",
+            "graph": {
+                "name": "gpt",
+                "type": "MODEL",
+                "implementation": "JAX_MODEL",
+                "parameters": [
+                    {"name": "model", "value": "tiny_gpt", "type": "STRING"},
+                    {"name": "seq", "value": str(SEQ), "type": "INT"},
+                    {"name": "max_new_tokens", "value": "6", "type": "INT"},
+                    {"name": "vocab", "value": str(VOCAB), "type": "INT"},
+                ],
+            },
+            "tpu": {
+                "max_batch": 4, "batch_buckets": [4], "decode_slots": 2,
+                "decode_prefix_slots": 4, "decode_kv_page_size": 4,
+                "decode_kv_host_bytes": 1 << 20,
+                "decode_kv_store_tier": "bogus://nope",
+            },
+        }
+    )
+    server = PredictorServer(pred, deployment_name="d")
+    sched = server.decode_scheduler
+    assert sched is not None
+    assert sched._host_tier is not None  # host tier survived
+    assert sched._host_tier.store is None  # store tier disabled, not fatal
+    out = sched.request_params_from_meta(Meta(tags={"kv_tier": "off"}))
+    assert out == {"kv_tier": "off"}
+    await sched.close()
+
+
+def test_flight_frame_promotions_aggregate():
+    from seldon_core_tpu.telemetry.flight import FlightFrame, FlightRecorder
+
+    rec = FlightRecorder(n_slots=2, name="t", capacity=8, enabled=True)
+    base = dict(
+        seq=0, t_ns=1, mode="step", active=1, prefilling=0, queued=0,
+        admitted=0, retired=0, blocked="", tokens=1, accepted=0, proposed=0,
+        spec_depth=0, busy_ns=(0, 100, 0, 0, 0), gap_ns=50, kv_free=3,
+        kv_live=2, kv_prefix=0, cow=0,
+    )
+    rec.record(FlightFrame(**base, promotions=2))
+    rec.record(FlightFrame(**{**base, "seq": 1}))
+    assert rec.promotions_total == 2
+    agg = rec.aggregate()
+    assert agg["promotions"] == 2
+    frames = rec.snapshot()
+    assert frames[0].to_dict()["promotions"] == 2
+    assert "promotions" not in frames[1].to_dict()  # zero is elided

@@ -224,3 +224,87 @@ def test_multi_predictor_units_get_separate_keys(tmp_path):
     running = m.get("abdep")
     assert set(running.persister._units) == {"main.eg", "canary.eg"}
     m.delete("abdep")
+
+def test_file_store_sanitized_key_collision_regression(tmp_path):
+    """Sanitizing is lossy ("a/b" and "a_b" both sanitize to "a_b") — the
+    raw-key digest suffix must keep distinct keys in distinct files. The
+    kv store tier hands the store slash-free digest keys, but router
+    units are free-form names; before the digest a late writer silently
+    overwrote the earlier key's snapshot."""
+    store = FileStateStore(str(tmp_path))
+    store.save("a/b", b"slash")
+    store.save("a_b", b"underscore")
+    assert store._path("a/b") != store._path("a_b")
+    assert store.load("a/b") == b"slash"
+    assert store.load("a_b") == b"underscore"
+    # round-trips still work for plain keys and survive re-open
+    assert FileStateStore(str(tmp_path)).load("a/b") == b"slash"
+
+
+def test_redis_timeout_env_parsing():
+    from seldon_core_tpu.utils.env import PERSISTENCE_REDIS_TIMEOUT_MS, redis_timeout_s
+
+    assert redis_timeout_s({}) == 2.0  # default: 2000 ms
+    assert redis_timeout_s({PERSISTENCE_REDIS_TIMEOUT_MS: "500"}) == 0.5
+    assert redis_timeout_s({PERSISTENCE_REDIS_TIMEOUT_MS: "garbage"}) == 2.0
+    assert redis_timeout_s({PERSISTENCE_REDIS_TIMEOUT_MS: "-10"}) == 2.0
+    assert redis_timeout_s({PERSISTENCE_REDIS_TIMEOUT_MS: "0"}) == 2.0
+
+
+def test_redis_store_bounded_timeouts_and_degrade(monkeypatch):
+    """The redis store passes the env-bounded socket budget to the client
+    and degrades (skip save, miss load) on connection/timeout errors —
+    a hung Redis must never block the serving loop mid-spill."""
+    import sys
+    import types
+
+    calls = {}
+
+    class _ConnErr(Exception):
+        pass
+
+    class _TimeoutErr(Exception):
+        pass
+
+    class _FakeClient:
+        def __init__(self, fail=False):
+            self.fail = fail
+            self.data = {}
+
+        def set(self, key, payload):
+            if self.fail:
+                raise _ConnErr("down")
+            self.data[key] = payload
+
+        def get(self, key):
+            if self.fail:
+                raise _TimeoutErr("slow")
+            return self.data.get(key)
+
+    fake = types.ModuleType("redis")
+    fake.exceptions = types.SimpleNamespace(
+        ConnectionError=_ConnErr, TimeoutError=_TimeoutErr
+    )
+
+    class _Redis:
+        @staticmethod
+        def from_url(url, **kw):
+            calls.update(kw, url=url)
+            return _FakeClient()
+
+    fake.Redis = _Redis
+    monkeypatch.setitem(sys.modules, "redis", fake)
+    monkeypatch.setenv("PERSISTENCE_REDIS_TIMEOUT_MS", "750")
+
+    from seldon_core_tpu.persistence.state import RedisStateStore
+
+    store = make_state_store("redis://localhost:6379/0")
+    assert isinstance(store, RedisStateStore)
+    assert calls["socket_timeout"] == 0.75
+    assert calls["socket_connect_timeout"] == 0.75
+    store.save("k", b"v")
+    assert store.load("k") == b"v"
+    # outage: both directions degrade to skip-store, no exception escapes
+    store._r.fail = True
+    store.save("k", b"v2")  # dropped, logged
+    assert store.load("k") is None
